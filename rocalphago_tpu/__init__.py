@@ -1,0 +1,27 @@
+"""rocalphago_tpu — a TPU-native rebuild of the RocAlphaGo AlphaGo pipeline.
+
+A complete, from-scratch JAX/XLA framework with the capability surface of
+the reference (``vaporized/RocAlphaGo``): a Go rules engine, the 48-plane
+AlphaGo feature encoder, policy/value/rollout convnets, supervised /
+REINFORCE / value trainers, batched APV-MCTS, SGF data pipeline and a GTP
+interface — redesigned TPU-first:
+
+* the game engine is a pure-functional JAX program (``engine.jaxgo``):
+  state is a pytree of fixed-shape arrays, ``step`` is jittable and
+  ``vmap``-able over thousands of concurrent boards;
+* the feature encoder runs on device with no per-cell Python
+  (``features``), using dense liberty-set bitmaps instead of per-move
+  board simulation;
+* networks are Flax modules in NHWC bfloat16-friendly layout (``models``);
+* trainers are data-parallel over a ``jax.sharding.Mesh`` with gradients
+  ``psum``-reduced over ICI (``training``, ``parallel``);
+* MCTS batches leaf evaluation through a single jitted policy+value
+  evaluator (``search``).
+
+Layer map parity with the reference is documented per-module; see
+SURVEY.md at the repo root for the blueprint. The reference mount was
+empty this round, so citations are at file/symbol granularity
+(e.g. ``AlphaGo/go.py::GameState``) per SURVEY.md's provenance protocol.
+"""
+
+__version__ = "0.1.0"

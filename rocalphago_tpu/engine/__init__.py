@@ -1,0 +1,21 @@
+"""Go rules engines.
+
+Two implementations with identical rules semantics:
+
+* :mod:`rocalphago_tpu.engine.pygo` — a host-side pure-Python oracle,
+  mirroring the reference engine's API (``AlphaGo/go.py::GameState``).
+  Used for SGF replay, GTP bookkeeping, and as the correctness oracle
+  for the device engine.
+* :mod:`rocalphago_tpu.engine.jaxgo` — the TPU-native engine: a pure
+  functional ``step(state, action)`` over a fixed-shape array pytree,
+  jittable and vmappable. This is the centerpiece of the rebuild
+  (SURVEY.md §2a) and replaces the reference's Python/Cython board.
+"""
+
+from rocalphago_tpu.engine.pygo import (  # noqa: F401
+    BLACK,
+    EMPTY,
+    PASS_MOVE,
+    WHITE,
+    GameState,
+)

@@ -1,0 +1,10 @@
+"""Shared small utilities (coordinate flattening, dihedral symmetries).
+
+Parity: the reference's ``AlphaGo/util.py`` (``flatten_idx`` /
+``unflatten_idx``; SGF helpers live in :mod:`rocalphago_tpu.data.sgf`).
+"""
+
+from rocalphago_tpu.utils.coords import (  # noqa: F401
+    flatten_idx,
+    unflatten_idx,
+)

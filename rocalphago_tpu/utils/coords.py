@@ -1,0 +1,22 @@
+"""Board-coordinate helpers (reference: ``AlphaGo/util.py``).
+
+Convention: a point is ``(x, y)`` with ``x`` the row index into the
+board array; the flat action space is ``x * size + y`` with the extra
+index ``size * size`` meaning pass (device-side engines use the flat
+form exclusively — fixed shapes, no tuples).
+"""
+
+from __future__ import annotations
+
+
+def flatten_idx(position, size: int) -> int:
+    x, y = position
+    return x * size + y
+
+
+def unflatten_idx(idx: int, size: int):
+    return divmod(idx, size)
+
+
+def pass_idx(size: int) -> int:
+    return size * size

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh (SURVEY.md §4 "multi-node
+testing") so data-parallel training, collectives, and shardings are
+exercised in CI without TPU hardware. Must run before ``import jax``,
+hence the env mutation at module import time (pytest imports conftest
+before test modules).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_CHECKS", "1")
