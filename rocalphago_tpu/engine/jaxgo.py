@@ -1,0 +1,487 @@
+"""TPU-native Go engine: pure-functional, fixed-shape, jit/vmap-able.
+
+This replaces the reference's Python/Cython board (``AlphaGo/go.py::
+GameState``; SURVEY.md §2a "the centerpiece of the rebuild") with a
+design that maps onto XLA:
+
+* game state is a pytree of fixed-shape arrays (:class:`GoState`);
+* ``step(cfg, state, action)`` is a pure function — thousands of
+  concurrent games run as ``jax.vmap(step)`` with zero host round-trips;
+* connected groups come from an iterative min-label flood fill under
+  ``lax.while_loop`` (no dynamic shapes);
+* liberties are dense bitmaps ``[groups, points]`` built with four
+  scatters — one matrix yields liberty counts, capture detection, and
+  the feature encoder's exact capture-size / liberties-after planes
+  without simulating any candidate move;
+* positional superko is *exact and vectorized*: the Zobrist hash of the
+  position after any candidate move is ``hash ^ z[p] ^ xor(captured
+  groups)``, where per-group Zobrist XORs come from a GF(2) parity
+  matmul that runs on the MXU.
+
+Rules semantics are identical to :mod:`rocalphago_tpu.engine.pygo`
+(differential-tested in ``tests/test_jaxgo.py``): suicide illegal,
+simple ko always, optional positional superko, two passes end the game,
+area scoring with komi.
+
+Actions are flat indices ``0..N*N-1`` plus ``N*N`` for pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BLACK = 1
+WHITE = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class GoConfig:
+    """Static engine parameters (hashable → usable as a jit static arg)."""
+
+    size: int = 19
+    komi: float = 7.5
+    enforce_superko: bool = False
+    # ring-buffer length for positional-superko hashes; >= max game
+    # length gives exact superko (games are capped by move limits at the
+    # agent layer, reference uses ~500)
+    max_history: int = 512
+
+    @property
+    def num_points(self) -> int:
+        return self.size * self.size
+
+    @property
+    def pass_action(self) -> int:
+        return self.size * self.size
+
+
+class GoState(NamedTuple):
+    """One game. Batch by ``vmap``-ing the engine functions.
+
+    All arrays are fixed-shape; ``N = size * size``.
+    """
+
+    board: jax.Array        # int8 [N]   0 empty, +1 black, -1 white
+    turn: jax.Array         # int8 []    player to move (+1/-1)
+    ko: jax.Array           # int32 []   point banned by simple ko, -1 none
+    pass_count: jax.Array   # int8 []    consecutive passes
+    done: jax.Array         # bool []
+    step_count: jax.Array   # int32 []   moves played (incl. passes)
+    hash: jax.Array         # uint32 [2] Zobrist hash of current position
+    hash_history: jax.Array  # uint32 [H, 2] ring buffer of position hashes
+    stone_ages: jax.Array   # int32 [N]  step at which stone placed, -1 empty
+    prisoners: jax.Array    # int32 [2]  stones captured from [black, white]
+
+
+class GroupData(NamedTuple):
+    """Whole-board group analysis — shared by step, legality and features.
+
+    ``G = N + 1`` rows: one per possible group root (= min flat index of
+    the group) plus a sentinel row ``N`` for empty/off-board.
+    """
+
+    labels: jax.Array       # int32 [N]  group root per point (N for empty)
+    sizes: jax.Array        # int32 [G]  stones per group
+    lib_map: jax.Array      # bool  [G, N]  lib_map[g, p]: p is a liberty of g
+    lib_counts: jax.Array   # int32 [G]  distinct liberties per group
+    zxor: jax.Array         # uint32 [G, 2]  XOR of member stones' Zobrist keys
+
+
+# --------------------------------------------------------------------------
+# static per-size tables (host-side, cached)
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _tables(size: int):
+    """(neighbors [N,4], diagonals [N,4], zobrist [N,2,2]) as numpy.
+
+    Neighbor/diagonal entries are ``N`` (sentinel) when off-board.
+    Zobrist keys: ``zobrist[p, color_idx, 2xuint32]`` with color_idx
+    0=black, 1=white; fixed seed → reproducible hashes across processes.
+    """
+    n = size * size
+    neighbors = np.full((n, 4), n, dtype=np.int32)
+    diagonals = np.full((n, 4), n, dtype=np.int32)
+    for x in range(size):
+        for y in range(size):
+            p = x * size + y
+            for k, (dx, dy) in enumerate(((1, 0), (-1, 0), (0, 1), (0, -1))):
+                nx, ny = x + dx, y + dy
+                if 0 <= nx < size and 0 <= ny < size:
+                    neighbors[p, k] = nx * size + ny
+            for k, (dx, dy) in enumerate(((1, 1), (1, -1), (-1, 1), (-1, -1))):
+                nx, ny = x + dx, y + dy
+                if 0 <= nx < size and 0 <= ny < size:
+                    diagonals[p, k] = nx * size + ny
+    rng = np.random.default_rng(20260729)
+    zobrist = rng.integers(0, 2**32, size=(n, 2, 2), dtype=np.uint32)
+    return neighbors, diagonals, zobrist
+
+
+def neighbors_for(size: int) -> jax.Array:
+    return jnp.asarray(_tables(size)[0])
+
+
+def diagonals_for(size: int) -> jax.Array:
+    return jnp.asarray(_tables(size)[1])
+
+
+def zobrist_for(size: int) -> jax.Array:
+    return jnp.asarray(_tables(size)[2])
+
+
+def _color_idx(color) -> jax.Array:
+    """±1 color → 0/1 index into the Zobrist table."""
+    return ((1 - color) // 2).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# state construction
+# --------------------------------------------------------------------------
+
+
+def new_state(cfg: GoConfig) -> GoState:
+    n = cfg.num_points
+    return GoState(
+        board=jnp.zeros((n,), jnp.int8),
+        turn=jnp.int8(BLACK),
+        ko=jnp.int32(-1),
+        pass_count=jnp.int8(0),
+        done=jnp.bool_(False),
+        step_count=jnp.int32(0),
+        hash=jnp.zeros((2,), jnp.uint32),
+        hash_history=jnp.zeros((cfg.max_history, 2), jnp.uint32),
+        stone_ages=jnp.full((n,), -1, jnp.int32),
+        prisoners=jnp.zeros((2,), jnp.int32),
+    )
+
+
+def new_states(cfg: GoConfig, batch: int) -> GoState:
+    """A batch of fresh games (leading axis on every leaf)."""
+    one = new_state(cfg)
+    return jax.tree.map(lambda x: jnp.broadcast_to(x, (batch,) + x.shape), one)
+
+
+# --------------------------------------------------------------------------
+# group analysis
+# --------------------------------------------------------------------------
+
+
+def compute_labels(cfg: GoConfig, board: jax.Array) -> jax.Array:
+    """Connected-component root (min flat index) per point; N for empty.
+
+    Iterative min-label propagation over same-color neighbors under
+    ``lax.while_loop``; converges in O(longest group diameter) cheap
+    [N,4] steps — XLA-friendly, no dynamic shapes (SURVEY.md §7 hard
+    part #1).
+    """
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    has_stone = board != 0
+    init = jnp.where(has_stone, jnp.arange(n, dtype=jnp.int32), n)
+
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    same = (board_pad[nbrs] == board[:, None]) & has_stone[:, None] & (
+        nbrs < n)
+
+    def body(labels):
+        lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
+        nbr_labels = jnp.where(same, lab_pad[nbrs], n)
+        return jnp.minimum(labels, nbr_labels.min(axis=1))
+
+    def cond(carry):
+        labels, prev = carry
+        return jnp.any(labels != prev)
+
+    def step_fn(carry):
+        labels, _ = carry
+        return body(labels), labels
+
+    labels, _ = lax.while_loop(cond, step_fn, (body(init), init))
+    return labels
+
+
+def group_data(cfg: GoConfig, board: jax.Array) -> GroupData:
+    """Full group analysis of a board (one flood fill + four scatters)."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    zob = zobrist_for(cfg.size)
+    labels = compute_labels(cfg, board)
+    empty = board == 0
+
+    sizes = jnp.zeros((n + 1,), jnp.int32).at[labels].add(
+        (~empty).astype(jnp.int32))
+
+    # lib_map[g, p]: empty point p adjacent to a stone of group g.
+    lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
+    points = jnp.arange(n, dtype=jnp.int32)
+    lib_map = jnp.zeros((n + 1, n), jnp.bool_)
+    for k in range(4):
+        rows = jnp.where(empty, lab_pad[nbrs[:, k]], n)
+        lib_map = lib_map.at[rows, points].max(empty)
+    lib_map = lib_map.at[n].set(False)  # sentinel row carries nothing
+    lib_counts = lib_map.sum(axis=1).astype(jnp.int32)
+
+    # Per-group XOR of member Zobrist keys via GF(2) parity matmul (MXU).
+    member = jnp.zeros((n + 1, n), jnp.bool_).at[labels, points].max(~empty)
+    member = member.at[n].set(False)
+    key_per_point = jnp.where(
+        (board == BLACK)[:, None], zob[:, 0], zob[:, 1])  # uint32 [N, 2]
+    key_bits = _unpack_bits(key_per_point)                # bool [N, 64]
+    parity = (member.astype(jnp.int32) @ key_bits.astype(jnp.int32)) % 2
+    zxor = _pack_bits(parity.astype(jnp.bool_))           # uint32 [G, 2]
+    return GroupData(labels, sizes, lib_map, lib_counts, zxor)
+
+
+def _unpack_bits(words: jax.Array) -> jax.Array:
+    """uint32 [..., W] → bool [..., W*32] (little-endian bit order)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1], -1).astype(jnp.bool_)
+
+
+def _pack_bits(bits: jax.Array) -> jax.Array:
+    """bool [..., W*32] → uint32 [..., W]."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    words = bits.reshape(*bits.shape[:-1], -1, 32).astype(jnp.uint32)
+    return (words << shifts).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _xor_reduce_masked(keys: jax.Array, mask: jax.Array) -> jax.Array:
+    """XOR of ``keys[i]`` (uint32 [..., 2]) where ``mask[i]`` — via bit
+    parity, since XLA lacks a segment-XOR."""
+    bits = _unpack_bits(keys) & mask[..., None]
+    parity = bits.sum(axis=-2) % 2
+    return _pack_bits(parity.astype(jnp.bool_))
+
+
+def _dedup_mask(roots: jax.Array) -> jax.Array:
+    """For a small [K] int vector: True at the first occurrence of each
+    value (used to dedup ≤4 neighbor group roots)."""
+    k = roots.shape[0]
+    eq = roots[:, None] == roots[None, :]
+    earlier = jnp.tril(jnp.ones((k, k), jnp.bool_), k=-1)
+    return ~(eq & earlier).any(axis=1)
+
+
+# --------------------------------------------------------------------------
+# legality
+# --------------------------------------------------------------------------
+
+
+def legal_mask(cfg: GoConfig, state: GoState,
+               gd: GroupData | None = None) -> jax.Array:
+    """Boolean mask over the ``N+1`` actions (last = pass, always legal
+    while the game is live).
+
+    Matches ``pygo.GameState.is_legal`` exactly, including positional
+    superko when ``cfg.enforce_superko`` (candidate hashes via the
+    group-XOR trick — no per-candidate simulation).
+    """
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    if gd is None:
+        gd = group_data(cfg, state.board)
+    board, me = state.board, state.turn
+    empty = board == 0
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    valid_nbr = nbrs < n
+
+    nbr_color = board_pad[nbrs]                      # int8 [N, 4]
+    nbr_root = jnp.concatenate(
+        [gd.labels, jnp.full((1,), n, jnp.int32)])[nbrs]
+    nbr_libs = gd.lib_counts[nbr_root]
+
+    has_empty_nbr = (valid_nbr & (nbr_color == 0)).any(axis=1)
+    own_safe = (valid_nbr & (nbr_color == me) & (nbr_libs >= 2)).any(axis=1)
+    captures = valid_nbr & (nbr_color == -me) & (nbr_libs == 1)
+    not_suicide = has_empty_nbr | own_safe | captures.any(axis=1)
+
+    ok = empty & not_suicide
+    ok = ok & (jnp.arange(n) != state.ko)
+
+    if cfg.enforce_superko:
+        zob = zobrist_for(cfg.size)
+        ci = _color_idx(me)
+        uniq = jax.vmap(_dedup_mask)(nbr_root)       # [N, 4]
+        cap_xor = _xor_reduce_masked(
+            gd.zxor[nbr_root], captures & uniq)      # [N, 2]
+        cand = state.hash[None, :] ^ zob[:, ci, :] ^ cap_xor
+        seen = (cand[:, None, :] == state.hash_history[None, :, :]).all(
+            axis=-1).any(axis=1)
+        ok = ok & ~seen
+
+    live = ~state.done
+    return jnp.concatenate([ok & live, jnp.ones((1,), jnp.bool_) & live])
+
+
+# --------------------------------------------------------------------------
+# step
+# --------------------------------------------------------------------------
+
+
+def step(cfg: GoConfig, state: GoState, action: jax.Array,
+         gd: GroupData | None = None) -> GoState:
+    """Play ``action`` (flat index, ``N`` = pass) for the player to move.
+
+    Pure function of (state, action); assumes the action is legal (use
+    :func:`legal_mask` — sampling already needs it). Occupied-point
+    actions degrade to a pass rather than corrupting state. A finished
+    game is frozen: any action returns the state unchanged.
+
+    Pass ``gd`` (the :func:`group_data` of ``state.board``) to reuse the
+    analysis :func:`legal_mask` already computed — inside one jitted
+    sample-and-step program this halves the per-move engine cost.
+    """
+    n = cfg.num_points
+    new = lax.cond(
+        state.done,
+        lambda s: s,
+        lambda s: lax.cond(
+            (action >= n) | (s.board[jnp.minimum(action, n - 1)] != 0),
+            functools.partial(_step_pass, cfg),
+            functools.partial(_step_place, cfg, action=action, gd=gd),
+            s),
+        state)
+    return new
+
+
+def _step_pass(cfg: GoConfig, state: GoState) -> GoState:
+    pc = state.pass_count + 1
+    return state._replace(
+        turn=-state.turn,
+        ko=jnp.int32(-1),
+        pass_count=pc,
+        done=pc >= 2,
+        step_count=state.step_count + 1,
+        hash_history=state.hash_history.at[
+            state.step_count % cfg.max_history].set(state.hash),
+    )
+
+
+def _step_place(cfg: GoConfig, state: GoState, action,
+                gd: GroupData | None = None) -> GoState:
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    zob = zobrist_for(cfg.size)
+    board, me = state.board, state.turn
+    if gd is None:
+        gd = group_data(cfg, board)
+
+    my_nbrs = nbrs[action]                               # [4]
+    nbr_color = jnp.concatenate(
+        [board, jnp.zeros((1,), board.dtype)])[my_nbrs]
+    nbr_root = jnp.concatenate(
+        [gd.labels, jnp.full((1,), n, jnp.int32)])[my_nbrs]
+
+    # opponent neighbor groups in atari (their single liberty is `action`)
+    cap_roots = jnp.where(
+        (nbr_color == -me) & (gd.lib_counts[nbr_root] == 1), nbr_root, -2)
+    captured = (gd.labels[:, None] == cap_roots[None, :]).any(axis=1)
+    num_captured = captured.sum(dtype=jnp.int32)
+
+    board2 = jnp.where(captured, 0, board).at[action].set(me)
+
+    # simple ko: lone new stone, exactly one capture, one liberty left
+    placed_alone = ~(nbr_color == me).any()
+    board2_pad = jnp.concatenate([board2, jnp.ones((1,), board2.dtype)])
+    p_libs = (board2_pad[my_nbrs] == 0).sum(dtype=jnp.int32)
+    ko_point = jnp.argmax(captured).astype(jnp.int32)
+    ko = jnp.where(
+        (num_captured == 1) & placed_alone & (p_libs == 1), ko_point, -1)
+
+    ci = _color_idx(me)
+    cap_keys = jnp.where((me == BLACK), zob[:, 1, :], zob[:, 0, :])
+    new_hash = (state.hash ^ zob[action, ci, :]
+                ^ _xor_reduce_masked(cap_keys, captured))
+
+    prisoners = state.prisoners.at[_color_idx(-me)].add(num_captured)
+    return state._replace(
+        board=board2,
+        turn=-me,
+        ko=ko,
+        pass_count=jnp.int8(0),
+        step_count=state.step_count + 1,
+        hash=new_hash,
+        hash_history=state.hash_history.at[
+            state.step_count % cfg.max_history].set(new_hash),
+        stone_ages=jnp.where(captured, -1, state.stone_ages).at[action].set(
+            state.step_count),
+        prisoners=prisoners,
+    )
+
+
+# --------------------------------------------------------------------------
+# scoring
+# --------------------------------------------------------------------------
+
+
+def area_scores(cfg: GoConfig, state: GoState) -> tuple[jax.Array, jax.Array]:
+    """Area (Chinese) scores ``(black, white_plus_komi)`` — empty regions
+    bordering exactly one color count for it. Same flood-fill machinery
+    as group labels, run on the empty graph."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    board = state.board
+    empty = board == 0
+
+    # label empty regions: treat empty as the "color"
+    region = compute_labels(cfg, jnp.where(empty, jnp.int8(9), jnp.int8(0)))
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    nbr_color = board_pad[nbrs]
+    touches_b_pt = empty & (nbr_color == BLACK).any(axis=1)
+    touches_w_pt = empty & (nbr_color == WHITE).any(axis=1)
+    touches_b = jnp.zeros((n + 1,), jnp.bool_).at[region].max(touches_b_pt)
+    touches_w = jnp.zeros((n + 1,), jnp.bool_).at[region].max(touches_w_pt)
+
+    terr_b = (empty & touches_b[region] & ~touches_w[region]).sum()
+    terr_w = (empty & touches_w[region] & ~touches_b[region]).sum()
+    black = (board == BLACK).sum() + terr_b
+    white = (board == WHITE).sum() + terr_w
+    return black.astype(jnp.float32), white.astype(jnp.float32) + cfg.komi
+
+
+def winner(cfg: GoConfig, state: GoState) -> jax.Array:
+    """+1 black wins, -1 white wins, 0 draw."""
+    b, w = area_scores(cfg, state)
+    return jnp.sign(b - w).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# convenience wrapper
+# --------------------------------------------------------------------------
+
+
+class GoEngine:
+    """Jitted single-game and batched closures over a fixed config.
+
+    ``step/legal_mask/...`` operate on one game; the ``v``-prefixed
+    variants are ``vmap``-ed over a leading batch axis — the rebuild's
+    self-play scaling axis (SURVEY.md §2b "environment parallelism").
+    """
+
+    def __init__(self, cfg: GoConfig):
+        self.cfg = cfg
+        self.init = jax.jit(functools.partial(new_state, cfg))
+        self.step = jax.jit(functools.partial(step, cfg))
+        self.legal_mask = jax.jit(
+            lambda state: legal_mask(cfg, state))
+        self.area_scores = jax.jit(functools.partial(area_scores, cfg))
+        self.winner = jax.jit(functools.partial(winner, cfg))
+        self.group_data = jax.jit(
+            lambda board: group_data(cfg, board))
+        self.vstep = jax.jit(jax.vmap(functools.partial(step, cfg)))
+        self.vlegal_mask = jax.jit(
+            jax.vmap(lambda state: legal_mask(cfg, state)))
+        self.vwinner = jax.jit(jax.vmap(functools.partial(winner, cfg)))
+
+    def init_batch(self, batch: int) -> GoState:
+        return new_states(self.cfg, batch)

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Run the test suite on CPU with the 8-fake-device mesh.
+#
+# PALLAS_AXON_POOL_IPS= skips the axon TPU-session claim that
+# /root/.axon_site/sitecustomize.py performs at interpreter startup —
+# that claim can intermittently block for minutes and CPU tests don't
+# need the chip. conftest.py still forces JAX_PLATFORMS=cpu and the
+# fake-device XLA flag for harnesses that invoke pytest directly.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest tests/ "$@"
