@@ -85,13 +85,18 @@ class GroupData(NamedTuple):
 
     ``G = N + 1`` rows: one per possible group root (= min flat index of
     the group) plus a sentinel row ``N`` for empty/off-board.
+
+    ``member`` and ``zxor`` are optional (``None`` unless requested):
+    the hot step/legality path only needs the cheap [N,4]-scatter
+    fields, while the feature encoder asks for the dense membership
+    bitmap and superko legality for the per-group Zobrist XORs.
     """
 
     labels: jax.Array       # int32 [N]  group root per point (N for empty)
     sizes: jax.Array        # int32 [G]  stones per group
-    lib_map: jax.Array      # bool  [G, N]  lib_map[g, p]: p is a liberty of g
     lib_counts: jax.Array   # int32 [G]  distinct liberties per group
-    zxor: jax.Array         # uint32 [G, 2]  XOR of member stones' Zobrist keys
+    member: jax.Array | None  # bool [G, N]  member[g, p]: stone p in group g
+    zxor: jax.Array | None  # uint32 [G, 2] XOR of member stones' Zobrist keys
 
 
 # --------------------------------------------------------------------------
@@ -170,6 +175,60 @@ def new_states(cfg: GoConfig, batch: int) -> GoState:
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (batch,) + x.shape), one)
 
 
+def from_pygo(cfg: GoConfig, st) -> GoState:
+    """Bridge a host-side :class:`pygo.GameState` into engine state.
+
+    Used at the GTP/SGF boundary where positions are built move-by-move
+    on the host. The position hash is recomputed from the board; the
+    superko history carries the positions pygo recorded (up to
+    ``cfg.max_history``, most recent kept).
+    """
+    n = cfg.num_points
+    zob = _tables(cfg.size)[2]
+    board = np.asarray(st.board, dtype=np.int8).reshape(-1)
+
+    def pos_hash(flat_board):
+        h = np.zeros(2, np.uint32)
+        for p in range(n):
+            if flat_board[p] == BLACK:
+                h ^= zob[p, 0]
+            elif flat_board[p] == WHITE:
+                h ^= zob[p, 1]
+        return h
+
+    # Place historical hashes so that the engine's future writes (at
+    # slot ``step_count % H``, then ``step_count+1 % H``, ...) evict the
+    # *oldest* entries first: newest-seen position sits at slot
+    # ``(step_count - 1) % H``. ``_position_history`` is insertion-
+    # ordered (dict), so the suffix really is the most recent positions.
+    hist = np.zeros((cfg.max_history, 2), np.uint32)
+    seen = [np.frombuffer(b, dtype=np.int8)
+            for b in st._position_history.keys()]
+    recent = seen[-cfg.max_history:]
+    for i, flat in enumerate(reversed(recent)):
+        hist[(st.turns_played - 1 - i) % cfg.max_history] = pos_hash(flat)
+
+    ko = -1 if st.ko is None else st.ko[0] * cfg.size + st.ko[1]
+    passes = 0
+    if st.history and st.history[-1] is None:
+        passes = 2 if (len(st.history) > 1 and st.history[-2] is None) else 1
+    return GoState(
+        board=jnp.asarray(board),
+        turn=jnp.int8(st.current_player),
+        ko=jnp.int32(ko),
+        pass_count=jnp.int8(passes),
+        done=jnp.bool_(st.is_end_of_game),
+        step_count=jnp.int32(st.turns_played),
+        hash=jnp.asarray(pos_hash(board)),
+        hash_history=jnp.asarray(hist),
+        stone_ages=jnp.asarray(
+            np.asarray(st.stone_ages, np.int32).reshape(-1)),
+        prisoners=jnp.asarray(
+            np.array([st.num_black_prisoners, st.num_white_prisoners],
+                     np.int32)),
+    )
+
+
 # --------------------------------------------------------------------------
 # group analysis
 # --------------------------------------------------------------------------
@@ -209,36 +268,66 @@ def compute_labels(cfg: GoConfig, board: jax.Array) -> jax.Array:
     return labels
 
 
-def group_data(cfg: GoConfig, board: jax.Array) -> GroupData:
-    """Full group analysis of a board (one flood fill + four scatters)."""
+def neighbor_analysis(cfg: GoConfig, board: jax.Array, labels: jax.Array):
+    """Per-point padded neighbor lookup shared by legality, stepping and
+    the feature encoder: ``(nbr_color [N,4], nbr_root [N,4], uniq [N,4],
+    valid [N,4])``. Off-board neighbors read color 0 and the sentinel
+    root ``N``; ``uniq`` is True at the first occurrence of each root
+    among a point's ≤4 neighbors (the dedup convention every caller
+    must share)."""
     n = cfg.num_points
     nbrs = neighbors_for(cfg.size)
-    zob = zobrist_for(cfg.size)
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
+    return (board_pad[nbrs], lab_pad[nbrs],
+            jax.vmap(_dedup_mask)(lab_pad[nbrs]), nbrs < n)
+
+
+def group_data(cfg: GoConfig, board: jax.Array, *,
+               with_member: bool = False,
+               with_zxor: bool = False) -> GroupData:
+    """Group analysis of a board (one flood fill + small scatters).
+
+    Liberty counts are *distinct* empty points per group, computed with
+    a deduped [N,4] scatter-add (each empty point contributes once per
+    distinct neighboring group) — no dense [G,N] intermediate in the
+    hot path. Request ``with_member`` (feature encoder) or
+    ``with_zxor`` (superko legality) explicitly.
+    """
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
     labels = compute_labels(cfg, board)
     empty = board == 0
 
     sizes = jnp.zeros((n + 1,), jnp.int32).at[labels].add(
         (~empty).astype(jnp.int32))
 
-    # lib_map[g, p]: empty point p adjacent to a stone of group g.
-    lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
-    points = jnp.arange(n, dtype=jnp.int32)
-    lib_map = jnp.zeros((n + 1, n), jnp.bool_)
-    for k in range(4):
-        rows = jnp.where(empty, lab_pad[nbrs[:, k]], n)
-        lib_map = lib_map.at[rows, points].max(empty)
-    lib_map = lib_map.at[n].set(False)  # sentinel row carries nothing
-    lib_counts = lib_map.sum(axis=1).astype(jnp.int32)
+    # each empty point adds 1 liberty to each *distinct* adjacent group
+    _, nbr_root, uniq, _ = neighbor_analysis(cfg, board, labels)
+    contrib = empty[:, None] & uniq & (nbr_root < n)
+    lib_counts = jnp.zeros((n + 1,), jnp.int32).at[
+        jnp.where(contrib, nbr_root, n)].add(contrib.astype(jnp.int32))
+    lib_counts = lib_counts.at[n].set(0)
 
-    # Per-group XOR of member Zobrist keys via GF(2) parity matmul (MXU).
-    member = jnp.zeros((n + 1, n), jnp.bool_).at[labels, points].max(~empty)
-    member = member.at[n].set(False)
-    key_per_point = jnp.where(
-        (board == BLACK)[:, None], zob[:, 0], zob[:, 1])  # uint32 [N, 2]
-    key_bits = _unpack_bits(key_per_point)                # bool [N, 64]
-    parity = (member.astype(jnp.int32) @ key_bits.astype(jnp.int32)) % 2
-    zxor = _pack_bits(parity.astype(jnp.bool_))           # uint32 [G, 2]
-    return GroupData(labels, sizes, lib_map, lib_counts, zxor)
+    member = None
+    zxor = None
+    if with_member or with_zxor:
+        points = jnp.arange(n, dtype=jnp.int32)
+        member = jnp.zeros((n + 1, n), jnp.bool_).at[labels, points].max(
+            ~empty)
+        member = member.at[n].set(False)
+    if with_zxor:
+        # Per-group XOR of member Zobrist keys via GF(2) parity matmul
+        # (rides the MXU; XLA has no segment-XOR).
+        zob = zobrist_for(cfg.size)
+        key_per_point = jnp.where(
+            (board == BLACK)[:, None], zob[:, 0], zob[:, 1])  # uint32 [N,2]
+        key_bits = _unpack_bits(key_per_point)                # bool [N,64]
+        parity = (member.astype(jnp.int32) @ key_bits.astype(jnp.int32)) % 2
+        zxor = _pack_bits(parity.astype(jnp.bool_))           # uint32 [G,2]
+        if not with_member:
+            member = None
+    return GroupData(labels, sizes, lib_counts, member, zxor)
 
 
 def _unpack_bits(words: jax.Array) -> jax.Array:
@@ -287,17 +376,12 @@ def legal_mask(cfg: GoConfig, state: GoState,
     group-XOR trick — no per-candidate simulation).
     """
     n = cfg.num_points
-    nbrs = neighbors_for(cfg.size)
     if gd is None:
-        gd = group_data(cfg, state.board)
+        gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko)
     board, me = state.board, state.turn
     empty = board == 0
-    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
-    valid_nbr = nbrs < n
-
-    nbr_color = board_pad[nbrs]                      # int8 [N, 4]
-    nbr_root = jnp.concatenate(
-        [gd.labels, jnp.full((1,), n, jnp.int32)])[nbrs]
+    nbr_color, nbr_root, uniq, valid_nbr = neighbor_analysis(
+        cfg, board, gd.labels)
     nbr_libs = gd.lib_counts[nbr_root]
 
     has_empty_nbr = (valid_nbr & (nbr_color == 0)).any(axis=1)
@@ -311,7 +395,6 @@ def legal_mask(cfg: GoConfig, state: GoState,
     if cfg.enforce_superko:
         zob = zobrist_for(cfg.size)
         ci = _color_idx(me)
-        uniq = jax.vmap(_dedup_mask)(nbr_root)       # [N, 4]
         cap_xor = _xor_reduce_masked(
             gd.zxor[nbr_root], captures & uniq)      # [N, 2]
         cand = state.hash[None, :] ^ zob[:, ci, :] ^ cap_xor
@@ -477,7 +560,8 @@ class GoEngine:
         self.area_scores = jax.jit(functools.partial(area_scores, cfg))
         self.winner = jax.jit(functools.partial(winner, cfg))
         self.group_data = jax.jit(
-            lambda board: group_data(cfg, board))
+            lambda board: group_data(cfg, board, with_member=True,
+                                     with_zxor=True))
         self.vstep = jax.jit(jax.vmap(functools.partial(step, cfg)))
         self.vlegal_mask = jax.jit(
             jax.vmap(lambda state: legal_mask(cfg, state)))

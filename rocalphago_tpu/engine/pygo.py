@@ -63,7 +63,7 @@ class GameState:
         self.stone_ages = np.full((size, size), -1, dtype=np.int32)
         self.turns_played = 0
         # byte-serialized board positions seen so far (for superko)
-        self._position_history = {self.board.tobytes()}
+        self._position_history = dict.fromkeys([self.board.tobytes()])
         self.handicaps: list = []
 
     # ---------------------------------------------------------------- basics
@@ -81,7 +81,7 @@ class GameState:
         other.passes_white = self.passes_white
         other.stone_ages = self.stone_ages.copy()
         other.turns_played = self.turns_played
-        other._position_history = set(self._position_history)
+        other._position_history = dict(self._position_history)
         other.handicaps = list(self.handicaps)
         return other
 
@@ -248,7 +248,7 @@ class GameState:
         self.stone_ages[action] = self.turns_played
         self.turns_played += 1
         self.history.append(action)
-        self._position_history.add(board.tobytes())
+        self._position_history[board.tobytes()] = None
         self.current_player = -color
         return False
 
@@ -265,7 +265,7 @@ class GameState:
             self.board[p] = BLACK
             self.stone_ages[p] = 0
             self.handicaps.append(p)
-        self._position_history.add(self.board.tobytes())
+        self._position_history[self.board.tobytes()] = None
         self.current_player = WHITE
 
     # --------------------------------------------------------------- scoring

@@ -1,0 +1,18 @@
+"""48-plane AlphaGo feature encoding, device-native.
+
+Parity target: the reference's ``AlphaGo/preprocessing/preprocess.py``
+(SURVEY.md §1 L1). Public surface:
+
+* :class:`Preprocess` — jitted encoder (``state_to_tensor``,
+  ``output_dim``), NHWC layout;
+* :data:`DEFAULT_FEATURES` / :data:`FEATURE_PLANES` — the feature-name
+  ⇄ plane-count contract shared with saved model specs;
+* :mod:`pyfeatures` — the slow host oracle used by tests.
+"""
+
+from rocalphago_tpu.features.api import Preprocess  # noqa: F401
+from rocalphago_tpu.features.pyfeatures import (  # noqa: F401
+    DEFAULT_FEATURES,
+    FEATURE_PLANES,
+    output_planes,
+)

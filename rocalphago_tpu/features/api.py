@@ -1,0 +1,58 @@
+"""Feature-encoder API: the reference's ``Preprocess`` contract, TPU-side.
+
+Parity: ``AlphaGo/preprocessing/preprocess.py::Preprocess``
+(``Preprocess(feature_list)``, ``.state_to_tensor(state)``,
+``.output_dim``; SURVEY.md §1 L1) — except tensors are NHWC
+``[B, size, size, F]`` float32 (TPU conv layout) instead of the
+reference's Theano NCHW, and states are the device engine's
+:class:`~rocalphago_tpu.engine.jaxgo.GoState` (use
+:func:`~rocalphago_tpu.engine.jaxgo.from_pygo` at host boundaries).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from rocalphago_tpu.engine.jaxgo import GoConfig, GoState
+from rocalphago_tpu.features.planes import encode
+from rocalphago_tpu.features.pyfeatures import (
+    DEFAULT_FEATURES,
+    FEATURE_PLANES,
+    output_planes,
+)
+
+
+class Preprocess:
+    """Jitted encoder over a fixed feature list and board config.
+
+    ``feature_list`` entries name plane groups (see
+    ``pyfeatures.FEATURE_PLANES``); the full default set is the 48-plane
+    AlphaGo encoding.
+    """
+
+    def __init__(self, feature_list=DEFAULT_FEATURES,
+                 cfg: GoConfig = GoConfig(),
+                 ladder_depth: int = 40, ladder_lanes: int = 16):
+        unknown = [f for f in feature_list if f not in FEATURE_PLANES]
+        if unknown:
+            raise KeyError(f"unknown features: {unknown}")
+        if not feature_list:
+            raise ValueError("feature_list must name at least one feature")
+        self.feature_list = tuple(feature_list)
+        self.cfg = cfg
+        self.output_dim = output_planes(self.feature_list)
+        fn = functools.partial(
+            encode, cfg, features=self.feature_list,
+            ladder_depth=ladder_depth, ladder_lanes=ladder_lanes)
+        self._one = jax.jit(fn)
+        self._batch = jax.jit(jax.vmap(fn))
+
+    def state_to_tensor(self, state: GoState) -> jax.Array:
+        """One state → ``[1, size, size, F]`` float32."""
+        return self._one(state)[None]
+
+    def states_to_tensor(self, states: GoState) -> jax.Array:
+        """Batched states (leading axis) → ``[B, size, size, F]``."""
+        return self._batch(states)

@@ -1,0 +1,245 @@
+"""Jitted ladder reading for the ladder_capture / ladder_escape planes.
+
+The reference reads ladders with a recursive Python search around
+``AlphaGo/preprocessing/preprocess.py``. Recursion with data-dependent
+branching doesn't map to XLA, so the TPU design (SURVEY.md §7 hard part
+#2) is:
+
+* **candidate compaction** — only (move, prey-group) pairs satisfying
+  the ladder precondition are simulated. ``jnp.nonzero(size=K)``
+  compacts them into a fixed ``K`` lanes (static shape; overflow beyond
+  ``K`` truncates — real boards have few simultaneous ladders);
+* **two-ply lockstep reading** — one ``lax.while_loop`` iteration plays
+  a full ladder rung: each chaser option (the prey's two liberties) is
+  scored by the *forced escaper response* (extend at the last liberty,
+  or counter-capture an adjacent chasing group in atari), and the
+  chaser takes the best outcome. This 2-ply evaluation is what makes
+  the read exact on standard ladder zigzags, where a 1-ply greedy
+  chaser picks the wrong side; it remains an approximation vs the
+  oracle's full branching on pathological shapes (tests use positions
+  where both agree);
+* ko inside the read is ignored (as in the reference's reader).
+
+All functions are pure and vmap over games.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rocalphago_tpu.engine.jaxgo import (
+    neighbor_analysis,
+    GoConfig,
+    GoState,
+    GroupData,
+    _dedup_mask,
+    group_data,
+    neighbors_for,
+)
+
+# per-option ladder outcomes, ordered so the chaser minimises
+_CAPTURED, _CONTINUE, _ESCAPED = 0, 1, 2
+
+
+def _place(cfg: GoConfig, board, gd: GroupData, action, color):
+    """Light move application using the *pre-move* group analysis:
+    resolves captures, flags suicide/occupied as invalid (board
+    unchanged). Ko is deliberately not tracked."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    lab_pad = jnp.concatenate([gd.labels, jnp.full((1,), n, jnp.int32)])
+    my_nbrs = nbrs[action]
+    nbr_color = board_pad[my_nbrs]
+    nbr_root = lab_pad[my_nbrs]
+    valid = my_nbrs < n
+    uniq = _dedup_mask(nbr_root)
+
+    cap_k = valid & uniq & (nbr_color == -color) & (
+        gd.lib_counts[nbr_root] == 1)
+    captured = (gd.labels[:, None] == jnp.where(
+        cap_k, nbr_root, -2)[None, :]).any(axis=1)
+
+    has_empty = (valid & (nbr_color == 0)).any()
+    own_safe = (valid & (nbr_color == color) & (
+        gd.lib_counts[nbr_root] >= 2)).any()
+    ok = (board[action] == 0) & (has_empty | own_safe | cap_k.any())
+
+    new_board = jnp.where(captured, 0, board).at[action].set(color)
+    return jnp.where(ok, new_board, board), ok
+
+
+def _prey_libs(cfg: GoConfig, board, prey_pt):
+    gd = group_data(cfg, board)
+    libs = gd.lib_counts[gd.labels[prey_pt]]
+    return jnp.where(board[prey_pt] == 0, 0, libs), gd
+
+
+def _escaper_response(cfg: GoConfig, board, prey_pt, prey_color):
+    """Best forced response of a prey in atari: extend at the last
+    liberty or counter-capture an adjacent chasing group in atari.
+    Returns (libs_after_best, board_after_best); libs -1 if no legal
+    response exists."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    libs0, gd = _prey_libs(cfg, board, prey_pt)
+    lab_pad = jnp.concatenate([gd.labels, jnp.full((1,), n, jnp.int32)])
+    root = gd.labels[prey_pt]
+    empty = board == 0
+    adj_prey = (lab_pad[nbrs] == root).any(axis=1)
+
+    ext = jnp.argmax(empty & adj_prey).astype(jnp.int32)
+
+    chaser_atari = (board == -prey_color) & adj_prey & (
+        gd.lib_counts[gd.labels] == 1)
+    have_cap = chaser_atari.any()
+    cap_root = gd.labels[jnp.argmax(chaser_atari)]
+    cap_adj = (lab_pad[nbrs] == cap_root).any(axis=1)
+    cap_pt = jnp.argmax(empty & cap_adj).astype(jnp.int32)
+
+    def try_move(pt, enabled):
+        b1, ok = _place(cfg, board, gd, pt, prey_color)
+        L, _ = _prey_libs(cfg, b1, prey_pt)
+        return jnp.where(enabled & ok, L, -1), b1
+
+    L1, B1 = try_move(ext, libs0 >= 1)
+    L2, B2 = try_move(cap_pt, have_cap)
+    take1 = L1 >= L2
+    return jnp.where(take1, L1, L2), jnp.where(take1, B1, B2)
+
+
+def _chase(cfg: GoConfig, board0, prey_pt, depth: int) -> jax.Array:
+    """Chaser to move against a two-liberty prey; True if prey is
+    ladder-captured. Each iteration = one full rung (chaser move +
+    forced escaper response)."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    prey_color = board0[prey_pt].astype(jnp.int8)
+
+    class Carry(NamedTuple):
+        board: jax.Array
+        done: jax.Array
+        captured: jax.Array
+        rung: jax.Array
+
+    def option_outcome(board, gd, lib_pt, enabled):
+        """Chaser fills ``lib_pt``; returns (outcome, board after the
+        escaper's forced response)."""
+        b1, ok = _place(cfg, board, gd, lib_pt, -prey_color)
+        preyL, _ = _prey_libs(cfg, b1, prey_pt)
+        respL, b2 = _escaper_response(cfg, b1, prey_pt, prey_color)
+        resp_logic = jnp.where(
+            respL <= 1, _CAPTURED,
+            jnp.where(respL >= 3, _ESCAPED, _CONTINUE))
+        # an option only matters if it's a legal move that keeps atari
+        outcome = jnp.where(enabled & ok & (preyL == 1),
+                            resp_logic, _ESCAPED)
+        return outcome, b2
+
+    def body(c: Carry) -> Carry:
+        board = c.board
+        L, gd = _prey_libs(cfg, board, prey_pt)
+        lab_pad = jnp.concatenate(
+            [gd.labels, jnp.full((1,), n, jnp.int32)])
+        root = gd.labels[prey_pt]
+        empty = board == 0
+        lib_pts = empty & (lab_pad[nbrs] == root).any(axis=1)
+        l1 = jnp.argmax(lib_pts).astype(jnp.int32)
+        l2 = jnp.argmax(lib_pts & (jnp.arange(n) != l1)).astype(jnp.int32)
+
+        o1, b1 = option_outcome(board, gd, l1, L == 2)
+        o2, b2 = option_outcome(board, gd, l2, L == 2)
+        pick1 = o1 <= o2
+        o = jnp.where(pick1, o1, o2)
+        nb = jnp.where(pick1, b1, b2)
+
+        # prey already captured / in atari / safe before we move
+        pre = jnp.where(
+            board[prey_pt] == 0, _CAPTURED,
+            jnp.where(L >= 3, _ESCAPED,
+                      jnp.where(L == 1, _CAPTURED, -1)))
+        o = jnp.where(pre >= 0, pre, o)
+        advance = (pre < 0) & (o == _CONTINUE)
+
+        out_of_depth = c.rung + 1 >= depth
+        return Carry(
+            board=jnp.where(advance, nb, board),
+            done=c.done | (o != _CONTINUE) | out_of_depth,
+            captured=jnp.where(c.done, c.captured, o == _CAPTURED),
+            rung=c.rung + 1,
+        )
+
+    init = Carry(board0, jnp.bool_(False), jnp.bool_(False), jnp.int32(0))
+    final = lax.while_loop(lambda c: ~c.done, body, init)
+    return final.captured
+
+
+def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
+                     legal, prey_libs: int, prey_is_opp: bool,
+                     lanes: int):
+    """Compact (move, prey) pairs matching the precondition into K
+    lanes. Returns (move_pt [K], prey_pt [K], valid [K])."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    nbr_color, nbr_root, uniq, _ = neighbor_analysis(
+        cfg, state.board, gd.labels)
+
+    want = -state.turn if prey_is_opp else state.turn
+    cand = (legal[:, None] & uniq & (nbr_color == want)
+            & (gd.lib_counts[nbr_root] == prey_libs))   # [N, 4]
+    (flat_idx,) = jnp.nonzero(cand.reshape(-1), size=lanes,
+                              fill_value=4 * n)
+    valid = flat_idx < 4 * n
+    safe = jnp.where(valid, flat_idx, 0)
+    move_pt = (safe // 4).astype(jnp.int32)
+    prey_pt = nbrs[move_pt, safe % 4]
+    return move_pt, prey_pt, valid
+
+
+def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
+                         legal, depth: int = 40,
+                         lanes: int = 16) -> jax.Array:
+    """bool [N]: legal moves that ladder-capture an adjacent two-liberty
+    opponent group."""
+    n = cfg.num_points
+    me = state.turn
+    move_pt, prey_pt, valid = _candidate_lanes(
+        cfg, state, gd, legal, prey_libs=2, prey_is_opp=True, lanes=lanes)
+
+    def lane(mv, pr, ok):
+        board1, placed = _place(cfg, state.board, gd, mv, me)
+        # prey is now in atari; its forced response decides the opening
+        respL, board2 = _escaper_response(cfg, board1, pr, -me)
+        captured = jnp.where(
+            respL <= 1, True,
+            jnp.where(respL >= 3, False, _chase(cfg, board2, pr, depth)))
+        return jnp.where(ok & placed, captured, False)
+
+    captured = jax.vmap(lane)(move_pt, prey_pt, valid)
+    return jnp.zeros((n,), jnp.bool_).at[move_pt].max(captured & valid)
+
+
+def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
+                        legal, depth: int = 40,
+                        lanes: int = 16) -> jax.Array:
+    """bool [N]: legal moves that rescue an own group in atari from a
+    ladder (extension at its last liberty that survives the read)."""
+    n = cfg.num_points
+    me = state.turn
+    move_pt, prey_pt, valid = _candidate_lanes(
+        cfg, state, gd, legal, prey_libs=1, prey_is_opp=False, lanes=lanes)
+
+    def lane(mv, pr, ok):
+        board1, placed = _place(cfg, state.board, gd, mv, me)
+        L, _ = _prey_libs(cfg, board1, pr)
+        captured = jnp.where(
+            L <= 1, True,
+            jnp.where(L >= 3, False, _chase(cfg, board1, pr, depth)))
+        return jnp.where(ok & placed, ~captured, False)
+
+    escaped = jax.vmap(lane)(move_pt, prey_pt, valid)
+    return jnp.zeros((n,), jnp.bool_).at[move_pt].max(escaped & valid)

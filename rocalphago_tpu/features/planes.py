@@ -1,0 +1,184 @@
+"""Device-side 48-plane encoder: pure jitted function of engine state.
+
+The reference encoder (``AlphaGo/preprocessing/preprocess.py``) loops
+over board cells in Python and *simulates each candidate move* with
+``state.copy() + do_move`` for the capture-size / self-atari /
+liberties-after planes — its famous hot spot (SURVEY.md §3.2). Here the
+same planes are **exact** but come from dense bitmap algebra on the
+engine's :class:`~rocalphago_tpu.engine.jaxgo.GroupData`:
+
+* a candidate's captures are its ≤4 deduped neighbor groups in atari —
+  sizes come from ``gd.sizes``, captured stones from ``gd.member``;
+* the merged own group after the move is ``{p} ∪ own neighbor groups``
+  (bitmap OR), its liberties ``|dilate(M) ∩ new_empty|`` where
+  ``new_empty`` adds the captured points — one [N,4,N] gather instead
+  of N board simulations.
+
+Everything vmaps over games; no per-cell Python anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from rocalphago_tpu.engine.jaxgo import (
+    neighbor_analysis,
+    GoConfig,
+    GoState,
+    GroupData,
+    _dedup_mask,
+    diagonals_for,
+    group_data,
+    legal_mask,
+    neighbors_for,
+)
+
+
+class CandidateInfo(NamedTuple):
+    """Per-candidate-move analysis (valid where the move is legal)."""
+
+    capture_size: jax.Array     # int32 [N] opponent stones captured
+    own_size_after: jax.Array   # int32 [N] own merged-group size
+    libs_after: jax.Array       # int32 [N] own merged-group liberties
+    legal: jax.Array            # bool  [N] board moves only (no pass)
+
+
+def candidate_info(cfg: GoConfig, state: GoState,
+                   gd: GroupData) -> CandidateInfo:
+    """Exact capture/merge/liberty analysis of every candidate move.
+
+    Requires ``gd`` built with ``with_member=True``.
+    """
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    board, me = state.board, state.turn
+    empty = board == 0
+
+    nbr_color, nbr_root, uniq, _ = neighbor_analysis(cfg, board, gd.labels)
+
+    own_k = uniq & (nbr_color == me)
+    cap_k = uniq & (nbr_color == -me) & (gd.lib_counts[nbr_root] == 1)
+
+    capture_size = (cap_k * gd.sizes[nbr_root]).sum(axis=1)
+    own_size_after = 1 + (own_k * gd.sizes[nbr_root]).sum(axis=1)
+
+    # member rows of the ≤4 neighbor groups: [N, 4, N]
+    nbr_member = gd.member[nbr_root]
+    eye = jnp.eye(n, dtype=jnp.bool_)
+    merged = eye | (nbr_member & own_k[:, :, None]).any(axis=1)   # [N, N]
+    cap_pts = (nbr_member & cap_k[:, :, None]).any(axis=1)        # [N, N]
+    new_empty = (empty[None, :] & ~eye) | cap_pts
+
+    # dilate merged group: q ∈ D[p] iff q ∈ M[p] or a neighbor of q is
+    merged_pad = jnp.concatenate(
+        [merged, jnp.zeros((n, 1), jnp.bool_)], axis=1)
+    dilated = merged | merged_pad[:, nbrs].any(axis=2)
+    libs_after = (dilated & new_empty).sum(axis=1).astype(jnp.int32)
+
+    legal = legal_mask(cfg, state, gd)[:n]
+    return CandidateInfo(capture_size.astype(jnp.int32),
+                         own_size_after.astype(jnp.int32),
+                         libs_after, legal)
+
+
+def true_eyes(cfg: GoConfig, state: GoState, owner) -> jax.Array:
+    """bool [N]: empty points that are true eyes of ``owner`` (same
+    diagonal rule as ``pygo.GameState.is_eye``)."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    diags = diagonals_for(cfg.size)
+    board = state.board
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    empty = board == 0
+
+    valid_n = nbrs < n
+    eyeish = empty & ((board_pad[nbrs] == owner) | ~valid_n).all(axis=1)
+    valid_d = diags < n
+    bad = (valid_d & (board_pad[diags] == -owner)).sum(axis=1)
+    off_board = 4 - valid_d.sum(axis=1)
+    return eyeish & jnp.where(off_board > 0, bad == 0, bad <= 1)
+
+
+def _one_hot8(value: jax.Array, lo: int, active: jax.Array) -> jax.Array:
+    """[N] int → [N, 8] one-hot of ``clip(value - lo, 0, 7)``, zeroed
+    where ``active`` is False."""
+    idx = jnp.clip(value - lo, 0, 7)
+    return (jax.nn.one_hot(idx, 8, dtype=jnp.float32)
+            * active[:, None].astype(jnp.float32))
+
+
+def encode(cfg: GoConfig, state: GoState,
+           features: tuple = None,
+           ladder_depth: int = 40,
+           ladder_lanes: int = 16) -> jax.Array:
+    """Encode one game state → float32 ``[size, size, F]`` (NHWC).
+
+    ``features`` is a tuple of plane-group names (static under jit);
+    default is the full 48-plane AlphaGo set.
+    """
+    from rocalphago_tpu.features import ladders as _ladders
+    from rocalphago_tpu.features.pyfeatures import (
+        DEFAULT_FEATURES,
+        FEATURE_PLANES,
+    )
+
+    if features is None:
+        features = DEFAULT_FEATURES
+    n = cfg.num_points
+    board, me = state.board, state.turn
+    empty = board == 0
+    has_stone = ~empty
+
+    need_member = any(f in ("capture_size", "self_atari_size",
+                            "liberties_after") for f in features)
+    gd = group_data(cfg, board, with_member=need_member,
+                    with_zxor=cfg.enforce_superko)
+    ci = None
+    if need_member:
+        ci = candidate_info(cfg, state, gd)
+        legal = ci.legal
+    else:
+        legal = legal_mask(cfg, state, gd)[:n]
+
+    out = []
+    for name in features:
+        if name == "board":
+            f = jnp.stack([(board == me), (board == -me), empty],
+                          axis=-1).astype(jnp.float32)
+        elif name == "ones":
+            f = jnp.ones((n, 1), jnp.float32)
+        elif name == "turns_since":
+            age = state.step_count - 1 - state.stone_ages
+            f = _one_hot8(age, 0, has_stone & (state.stone_ages >= 0))
+        elif name == "liberties":
+            libs = gd.lib_counts[gd.labels]
+            f = _one_hot8(libs, 1, has_stone)
+        elif name == "capture_size":
+            f = _one_hot8(ci.capture_size, 0, legal)
+        elif name == "self_atari_size":
+            f = _one_hot8(ci.own_size_after, 1, legal & (ci.libs_after == 1))
+        elif name == "liberties_after":
+            f = _one_hot8(ci.libs_after, 1, legal)
+        elif name == "ladder_capture":
+            cap = _ladders.ladder_capture_plane(
+                cfg, state, gd, legal, depth=ladder_depth,
+                lanes=ladder_lanes)
+            f = cap.astype(jnp.float32)[:, None]
+        elif name == "ladder_escape":
+            esc = _ladders.ladder_escape_plane(
+                cfg, state, gd, legal, depth=ladder_depth,
+                lanes=ladder_lanes)
+            f = esc.astype(jnp.float32)[:, None]
+        elif name == "sensibleness":
+            f = (legal & ~true_eyes(cfg, state, me)).astype(
+                jnp.float32)[:, None]
+        elif name == "zeros":
+            f = jnp.zeros((n, 1), jnp.float32)
+        else:
+            raise KeyError(f"unknown feature {name!r}")
+        out.append(f)
+    flat = jnp.concatenate(out, axis=-1)
+    return flat.reshape(cfg.size, cfg.size, -1)
