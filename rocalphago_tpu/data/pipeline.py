@@ -1,0 +1,177 @@
+"""Host→device input pipeline over converted shards.
+
+Replaces the reference's ``shuffled_hdf5_batch_generator`` (h5py chunk
+reads + per-sample numpy transforms on host; SURVEY.md §3.1 HOT) with:
+
+* memory-mapped/sharded loads on host,
+* index-level shuffling with a persistable permutation (the reference's
+  ``shuffle.npz`` resume trick),
+* double-buffered ``jax.device_put`` prefetch so the TPU never waits on
+  the host,
+* dihedral augmentation deferred to the *device* (see
+  ``training.symmetries``), not done per-sample on host.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+
+import numpy as np
+
+
+class ShardedDataset:
+    """Random-access view over ``prefix-NNNNN.npz`` shards."""
+
+    def __init__(self, prefix: str):
+        with open(f"{prefix}-manifest.json") as f:
+            self.manifest = json.load(f)
+        self.prefix = prefix
+        counts = self.manifest["shard_counts"]
+        self._starts = np.cumsum([0] + counts)
+        self.num_positions = int(self._starts[-1])
+        self._cache: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+    def __len__(self) -> int:
+        return self.num_positions
+
+    @property
+    def planes(self) -> int:
+        return int(self.manifest["planes"])
+
+    @property
+    def board_size(self) -> int:
+        return int(self.manifest["board_size"])
+
+    def _shard(self, i: int):
+        if i not in self._cache:
+            z = np.load(f"{self.prefix}-{i:05d}.npz")
+            self._cache[i] = (z["states"], z["actions"])
+            # keep at most 4 shards resident
+            while len(self._cache) > 4:
+                self._cache.pop(next(iter(self._cache)))
+        return self._cache[i]
+
+    def gather(self, indices: np.ndarray):
+        """(states [b,s,s,F] uint8, actions [b] int32) for global
+        indices (any order)."""
+        states = None
+        actions = np.empty(len(indices), np.int32)
+        shard_ids = np.searchsorted(self._starts, indices, "right") - 1
+        for sid in np.unique(shard_ids):
+            s_states, s_actions = self._shard(int(sid))
+            sel = shard_ids == sid
+            local = indices[sel] - self._starts[sid]
+            if states is None:
+                states = np.empty(
+                    (len(indices),) + s_states.shape[1:], s_states.dtype)
+            states[sel] = s_states[local]
+            actions[sel] = s_actions[local]
+        return states, actions
+
+
+def load_hdf5(path: str):
+    """Reference-layout HDF5 → (states uint8 NHWC, actions int32).
+    Interchange reader for corpora converted by the reference stack."""
+    import h5py
+    with h5py.File(path, "r") as h5:
+        states = np.asarray(h5["states"], np.uint8).transpose(0, 2, 3, 1)
+        actions = np.asarray(h5["actions"], np.int32)
+    return states, actions
+
+
+def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
+                  path: str | None = None):
+    """Shuffled train/val/test index split; persisted to ``path`` (npz)
+    so interrupted runs resume with the identical split (the
+    reference's ``shuffle.npz`` behavior)."""
+    if path is not None:
+        try:
+            z = np.load(path)
+        except (OSError, KeyError):
+            z = None
+        if z is not None:
+            tr, va, te = z["train"], z["val"], z["test"]
+            total = len(tr) + len(va) + len(te)
+            if total != n:
+                raise ValueError(
+                    f"persisted split at {path} covers {total} positions "
+                    f"but the dataset has {n}; the corpus changed — "
+                    "delete the split file to reshuffle (this breaks "
+                    "resume reproducibility) or restore the old corpus")
+            return tr, va, te
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_train = int(n * fractions[0])
+    n_val = int(n * fractions[1])
+    train = perm[:n_train]
+    val = perm[n_train:n_train + n_val]
+    test = perm[n_train + n_val:]
+    if path is not None:
+        np.savez(path, train=train, val=val, test=test)
+    return train, val, test
+
+
+def batch_iterator(dataset, indices: np.ndarray, batch_size: int,
+                   rng: np.random.Generator, epochs: int | None = None,
+                   drop_remainder: bool = True):
+    """Yield host (states, actions) batches, reshuffling every epoch."""
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(indices)
+        end = (len(order) // batch_size) * batch_size if drop_remainder \
+            else len(order)
+        for i in range(0, end, batch_size):
+            yield dataset.gather(order[i:i + batch_size])
+        epoch += 1
+
+
+def device_prefetch(host_iter, size: int = 2):
+    """Stage host batches onto the device ahead of consumption.
+
+    A small thread keeps ``size`` batches in flight (``jax.device_put``
+    is async, so staging overlaps with the current train step). Worker
+    exceptions propagate to the consumer; closing the generator early
+    (the normal case — ``batch_iterator`` is infinite by default)
+    releases the worker and its staged batches instead of deadlocking
+    on the full queue.
+    """
+    import jax
+
+    q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in host_iter:
+                if not put(jax.device_put(item)):
+                    return
+            put(_END)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        while not q.empty():
+            q.get_nowait()

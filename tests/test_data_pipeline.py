@@ -1,0 +1,183 @@
+"""SGF parsing, corpus conversion and input-pipeline tests (reference
+strategy: ``tests/test_game_converter.py``, SURVEY.md §4)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.data import pipeline, sgf as sgflib
+from rocalphago_tpu.data.convert import GameConverter
+from rocalphago_tpu.engine import pygo
+
+DATA = os.path.join(os.path.dirname(__file__), "test_data")
+FEATURES = ("board", "ones", "turns_since", "liberties", "sensibleness")
+
+
+class TestSGF:
+    def test_parse_roundtrip(self):
+        text = open(os.path.join(DATA, "game0.sgf")).read()
+        g = sgflib.parse(text)
+        assert g.size == 9 and g.komi == 5.5
+        assert g.result == "W+R" and g.winner == pygo.WHITE
+        assert len(g.moves) >= 30
+        # render → parse → identical moves
+        g2 = sgflib.parse(sgflib.render(g))
+        assert g2.moves == g.moves
+        assert g2.size == g.size
+
+    def test_replay_yields_states_before_moves(self):
+        g = sgflib.parse(open(os.path.join(DATA, "game0.sgf")).read())
+        steps = 0
+        for st, move, player in sgflib.replay(g):
+            assert st.current_player == player
+            assert st.board[move] == 0
+            steps += 1
+        assert steps == len(g.moves)
+
+    def test_handicap_replay(self):
+        g = sgflib.parse(open(os.path.join(DATA, "handicap.sgf")).read())
+        assert g.setup_black == [(2, 2), (6, 6)]
+        first = next(iter(sgflib.replay(g)))
+        st, move, player = first
+        assert st.board[2, 2] == pygo.BLACK
+        assert player == pygo.WHITE  # white moves first after handicap
+
+    def test_variation_keeps_main_line(self):
+        # first child subtree is the main line; the second is a variation
+        g = sgflib.parse(
+            "(;GM[1]SZ[9];B[aa](;W[bb];B[cc];W[dd])(;W[ee]))")
+        assert [m for _, m in g.moves] == [(0, 0), (1, 1), (2, 2), (3, 3)]
+
+    def test_free_setup_ages_and_turn(self):
+        g = sgflib.parse("(;GM[1]SZ[9]AB[cc]AW[gg];W[dd];B[ee])")
+        it = sgflib.replay(g)
+        st, move, player = next(it)
+        assert st.board[2, 2] == pygo.BLACK
+        assert st.board[6, 6] == pygo.WHITE
+        assert st.stone_ages[2, 2] == 0 and st.stone_ages[6, 6] == 0
+        assert player == pygo.WHITE and st.current_player == pygo.WHITE
+
+    def test_pass_and_bad_points(self):
+        g = sgflib.parse("(;GM[1]SZ[9];B[dd];W[];B[tt])")
+        assert g.moves[1] == (pygo.WHITE, None)
+        assert g.moves[2] == (pygo.BLACK, None)
+        with pytest.raises(sgflib.SGFError):
+            sgflib.parse("(;GM[1]SZ[9];B[zz])")
+        with pytest.raises(sgflib.SGFError):
+            sgflib.parse("hello world")
+
+
+class TestConverter:
+    @pytest.fixture(scope="class")
+    def conv(self):
+        return GameConverter(FEATURES, board_size=9)
+
+    def test_convert_game_shapes(self, conv):
+        text = open(os.path.join(DATA, "game0.sgf")).read()
+        states, actions = conv.convert_game(text)
+        g = sgflib.parse(text)
+        n_board_moves = sum(1 for _, m in g.moves if m is not None)
+        assert states.shape == (n_board_moves, 9, 9, conv.pre.output_dim)
+        assert states.dtype == np.uint8
+        assert actions.shape == (n_board_moves,)
+        assert (actions >= 0).all() and (actions < 81).all()
+        # first position: empty board, black to move, action = first move
+        first = g.moves[0][1]
+        assert actions[0] == first[0] * 9 + first[1]
+        assert states[0, :, :, 0].sum() == 0  # no own stones yet
+
+    def test_sgfs_to_shards_skips_corrupt(self, conv, tmp_path):
+        files = sorted(glob.glob(os.path.join(DATA, "*.sgf")))
+        prefix = str(tmp_path / "corpus")
+        with pytest.warns(UserWarning):
+            manifest = conv.sgfs_to_shards(files, prefix, shard_size=64)
+        assert manifest["num_games"] == 5  # 4 games + handicap
+        assert len(manifest["errors"]) == 2  # corrupt + notsgf
+        assert manifest["num_positions"] == sum(manifest["shard_counts"])
+        assert manifest["num_shards"] == len(
+            glob.glob(prefix + "-*.npz"))
+
+    def test_hdf5_roundtrip(self, conv, tmp_path):
+        files = [os.path.join(DATA, "game0.sgf")]
+        out = str(tmp_path / "corpus.h5")
+        n = conv.sgfs_to_hdf5(files, out)
+        states, actions = pipeline.load_hdf5(out)
+        direct_s, direct_a = conv.convert_game(open(files[0]).read())
+        assert states.shape == direct_s.shape  # NHWC after reader
+        assert np.array_equal(states, direct_s)
+        assert np.array_equal(actions, direct_a)
+        assert n == len(actions)
+
+
+class TestPipeline:
+    @pytest.fixture(scope="class")
+    def dataset(self, tmp_path_factory):
+        conv = GameConverter(FEATURES, board_size=9)
+        files = sorted(glob.glob(os.path.join(DATA, "game*.sgf")))
+        prefix = str(tmp_path_factory.mktemp("ds") / "corpus")
+        conv.sgfs_to_shards(files, prefix, shard_size=50)
+        return pipeline.ShardedDataset(prefix)
+
+    def test_gather_cross_shard(self, dataset):
+        assert dataset.manifest["num_shards"] >= 2
+        idx = np.array([0, 1, len(dataset) - 1, len(dataset) // 2])
+        states, actions = dataset.gather(idx)
+        assert states.shape[0] == 4 and actions.shape == (4,)
+        # gather respects order: re-gather reversed
+        s2, a2 = dataset.gather(idx[::-1])
+        assert np.array_equal(a2, actions[::-1])
+        assert np.array_equal(s2, states[::-1])
+
+    def test_split_indices_persist(self, dataset, tmp_path):
+        path = str(tmp_path / "shuffle.npz")
+        tr, va, te = pipeline.split_indices(len(dataset), seed=1, path=path)
+        assert len(tr) + len(va) + len(te) == len(dataset)
+        assert len(np.intersect1d(tr, va)) == 0
+        tr2, va2, te2 = pipeline.split_indices(len(dataset), seed=999,
+                                               path=path)
+        assert np.array_equal(tr, tr2)  # resumed from file, seed ignored
+
+    def test_split_rejects_size_mismatch(self, dataset, tmp_path):
+        path = str(tmp_path / "shuffle.npz")
+        pipeline.split_indices(len(dataset), seed=1, path=path)
+        with pytest.raises(ValueError, match="corpus changed"):
+            pipeline.split_indices(len(dataset) + 5, seed=1, path=path)
+
+    def test_prefetch_propagates_worker_error(self):
+        def bad_iter():
+            yield (np.zeros(1), np.zeros(1))
+            raise OSError("shard vanished")
+        it = pipeline.device_prefetch(bad_iter())
+        next(it)
+        with pytest.raises(OSError, match="shard vanished"):
+            next(it)
+
+    def test_prefetch_early_close_releases_worker(self, dataset):
+        rng = np.random.default_rng(0)
+        idx = np.arange(len(dataset))
+        it = pipeline.device_prefetch(
+            pipeline.batch_iterator(dataset, idx, 8, rng))  # infinite
+        next(it)
+        it.close()  # must not deadlock the worker
+        import threading
+        import time
+        time.sleep(0.3)
+        workers = [t for t in threading.enumerate()
+                   if t.name.startswith("Thread-") and t.is_alive()]
+        # the worker either exited or is about to (stop flag set);
+        # closing again is a no-op
+        it.close()
+
+    def test_batch_iterator_and_prefetch(self, dataset):
+        rng = np.random.default_rng(0)
+        idx = np.arange(len(dataset))
+        it = pipeline.batch_iterator(dataset, idx, 16, rng, epochs=1)
+        batches = list(pipeline.device_prefetch(it))
+        assert len(batches) == len(dataset) // 16
+        s, a = batches[0]
+        assert s.shape == (16, 9, 9, dataset.planes)
+        import jax
+        assert isinstance(s, jax.Array)
