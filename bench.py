@@ -1,0 +1,60 @@
+"""Headline benchmark: 19×19 self-play throughput (games/min).
+
+Runs the fully on-device batched self-play loop (encode → policy
+forward → sample → rules step, all under one jit; SURVEY.md §6) with
+the flagship 48-plane policy on whatever accelerator is attached and
+prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is against the north-star target of 200 games/min on a
+16-chip v5e slice, prorated to the number of attached chips
+(BASELINE.md; the reference publishes no numbers of its own).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+
+def main() -> None:
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.search.selfplay import make_selfplay
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch = 128 if on_tpu else 16
+    max_moves = 420 if on_tpu else 60
+
+    cfg = GoConfig(size=19)
+    net = CNNPolicy(board=19, layers=12, filters_per_layer=128)
+    run = make_selfplay(cfg, net.feature_list, net.module.apply,
+                        net.module.apply, batch=batch,
+                        max_moves=max_moves, temperature=1.0)
+
+    # compile (excluded from timing)
+    res = run(net.params, net.params, jax.random.key(0))
+    res.final.board.block_until_ready()
+
+    t0 = time.time()
+    res = run(net.params, net.params, jax.random.key(1))
+    res.final.board.block_until_ready()
+    dt = time.time() - t0
+
+    games_per_min = batch / dt * 60.0
+    target = 200.0 * (n_dev / 16.0)  # north star prorated per chip
+    print(json.dumps({
+        "metric": "selfplay_19x19_games_per_min",
+        "value": round(games_per_min, 2),
+        "unit": "games/min",
+        "vs_baseline": round(games_per_min / target, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

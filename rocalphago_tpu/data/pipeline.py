@@ -89,10 +89,10 @@ def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
     if path is not None:
         try:
             z = np.load(path)
-        except (OSError, KeyError):
-            z = None
-        if z is not None:
             tr, va, te = z["train"], z["val"], z["test"]
+        except (OSError, KeyError):
+            tr = None
+        if tr is not None:
             total = len(tr) + len(va) + len(te)
             if total != n:
                 raise ValueError(
@@ -115,11 +115,31 @@ def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
 
 def batch_iterator(dataset, indices: np.ndarray, batch_size: int,
                    rng: np.random.Generator, epochs: int | None = None,
-                   drop_remainder: bool = True):
-    """Yield host (states, actions) batches, reshuffling every epoch."""
+                   drop_remainder: bool = True,
+                   shard_window: int | None = 4):
+    """Yield host (states, actions) batches, reshuffling every epoch.
+
+    Shuffling is two-level when the corpus spans many shards: shard
+    visit order is permuted per epoch, then indices are fully permuted
+    inside windows of ``shard_window`` shards — so a minibatch only
+    touches shards the dataset cache holds resident (a global
+    permutation would decompress nearly every shard per minibatch).
+    ``shard_window=None`` restores the global permutation.
+    """
+    starts = getattr(dataset, "_starts", None)
     epoch = 0
     while epochs is None or epoch < epochs:
-        order = rng.permutation(indices)
+        if shard_window is None or starts is None or len(starts) <= 2:
+            order = rng.permutation(indices)
+        else:
+            shard_of = np.searchsorted(starts, indices, "right") - 1
+            shard_ids = rng.permutation(np.unique(shard_of))
+            chunks = []
+            for w in range(0, len(shard_ids), shard_window):
+                window = shard_ids[w:w + shard_window]
+                pool = indices[np.isin(shard_of, window)]
+                chunks.append(rng.permutation(pool))
+            order = np.concatenate(chunks)
         end = (len(order) // batch_size) * batch_size if drop_remainder \
             else len(order)
         for i in range(0, end, batch_size):

@@ -1,0 +1,9 @@
+"""Checkpointing (Orbax), metadata.json, and metrics (SURVEY.md §5)."""
+
+from rocalphago_tpu.io.checkpoint import (  # noqa: F401
+    MetadataWriter,
+    TrainCheckpointer,
+    pack_rng,
+    unpack_rng,
+)
+from rocalphago_tpu.io.metrics import MetricsLogger  # noqa: F401

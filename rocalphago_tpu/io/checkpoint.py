@@ -1,0 +1,98 @@
+"""Checkpoint / resume via Orbax.
+
+Parity: the reference's per-epoch Keras weight dumps
+(``weights.NNNNN.hdf5``) + ``metadata.json`` progress file +
+``shuffle.npz`` persisted split (SURVEY.md §5 "Checkpoint / resume").
+Here a checkpoint is one Orbax step directory holding the full training
+pytree — params, optimizer state, step, PRNG key bits, data cursor — so
+resume is exact (same shuffle order, same augmentation stream), and
+saves are async so the TPU never idles on serialization.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def pack_rng(key: jax.Array) -> jax.Array:
+    """New-style PRNG key → raw uint32 bits (checkpointable)."""
+    return jax.random.key_data(key)
+
+
+def unpack_rng(bits) -> jax.Array:
+    import jax.numpy as jnp
+    return jax.random.wrap_key_data(jnp.asarray(bits, jnp.uint32))
+
+
+class TrainCheckpointer:
+    """Orbax ``CheckpointManager`` with a pytree per step."""
+
+    def __init__(self, directory: str, max_to_keep: int | None = None):
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=True))
+
+    def save(self, step: int, tree, wait: bool = False) -> None:
+        self.manager.save(step, args=ocp.args.StandardSave(tree))
+        if wait:
+            self.manager.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def restore(self, template, step: int | None = None):
+        """Restore into the structure/shardings of ``template``
+        (pass the freshly-initialized training pytree)."""
+        if step is None:
+            step = self.manager.latest_step()
+        if step is None:
+            return None, None
+        restored = self.manager.restore(
+            step, args=ocp.args.StandardRestore(template))
+        return restored, step
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()
+
+
+class MetadataWriter:
+    """Append-per-epoch ``metadata.json`` (reference
+    ``MetadataWriterCallback`` parity — tooling reads this file)."""
+
+    def __init__(self, path: str, header: dict | None = None):
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                self.data = json.load(f)
+        else:
+            self.data = dict(header or {})
+            self.data.setdefault("epochs", [])
+            self._flush()
+
+    def record_epoch(self, entry: dict) -> None:
+        entry = dict(entry, wall_time=time.time())
+        self.data["epochs"].append(entry)
+        self._flush()
+
+    def update(self, **fields) -> None:
+        self.data.update(fields)
+        self._flush()
+
+    def _flush(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.data, f, indent=2)
+        os.replace(tmp, self.path)

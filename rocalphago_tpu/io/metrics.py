@@ -1,0 +1,43 @@
+"""Structured training metrics: JSONL stream + stdout.
+
+Parity: the reference's observability is the Keras progress bar plus
+``metadata.json`` (SURVEY.md §5 "Metrics / logging"). The rebuild logs
+one JSON object per event to ``metrics.jsonl`` (step, loss, accuracy,
+games/min, …) — greppable, plottable, and the format ``bench.py``
+reuses. TensorBoard is intentionally not a dependency; the JSONL is
+trivially convertible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class MetricsLogger:
+    def __init__(self, path: str | None, echo: bool = True):
+        self.path = path
+        self.echo = echo
+        if path:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+        else:
+            self._f = None
+
+    def log(self, event: str, **fields) -> None:
+        rec = {"event": event, "time": time.time(), **fields}
+        if self._f:
+            self._f.write(json.dumps(rec) + "\n")
+        if self.echo:
+            shown = " ".join(
+                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in fields.items())
+            print(f"[{event}] {shown}", flush=True)
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None

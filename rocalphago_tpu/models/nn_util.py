@@ -1,0 +1,184 @@
+"""Model base: JSON spec ⇄ network contract, registry, save/load.
+
+Parity: ``AlphaGo/models/nn_util.py`` (``NeuralNetBase`` with JSON model
+spec + HDF5 weights, the ``@neuralnet`` subclass registry, and the
+per-position ``Bias`` Keras layer; SURVEY.md §2 "NN base / registry").
+TPU-native differences:
+
+* networks are Flax modules; parameters live in a pytree, serialized
+  with Flax msgpack (``*.flax.msgpack``) instead of Keras HDF5 — but
+  the load-bearing idea is kept: a small JSON spec records the class
+  name, the **feature list** (the feature⇄network contract the GTP
+  server needs to rebuild the encoder), and the architecture kwargs;
+* the per-position learned bias is a parameter of the Flax modules
+  (see ``policy.PolicyNet``), not a custom layer class;
+* ``forward`` is a jitted apply (the reference compiled a raw
+  ``K.function`` to bypass Keras predict overhead — ``jax.jit`` is the
+  equivalent and better);
+* evaluation is batched and device-resident; host-facing ``eval_state``
+  accepts either a host ``pygo.GameState`` or a device ``GoState``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from rocalphago_tpu.engine import jaxgo, pygo
+from rocalphago_tpu.features import DEFAULT_FEATURES, Preprocess
+
+NEURALNETS: dict[str, type] = {}
+
+
+def neuralnet(cls):
+    """Class decorator registering a network for spec-based loading."""
+    NEURALNETS[cls.__name__] = cls
+    return cls
+
+
+class NeuralNetBase:
+    """Holds (module, params, preprocess) and the spec (de)serializer.
+
+    Subclasses define ``create_network(**kwargs) -> flax.linen.Module``
+    and evaluation helpers. ``self.spec_kwargs`` is everything needed to
+    rebuild the module from JSON.
+    """
+
+    module = None  # flax module, set by subclass __init__
+
+    def __init__(self, feature_list=DEFAULT_FEATURES, *, board: int = 19,
+                 init_weights: bool = True, seed: int = 0, **kwargs):
+        self.cfg = jaxgo.GoConfig(size=board)
+        self.preprocess = Preprocess(feature_list, cfg=self.cfg)
+        self.feature_list = tuple(feature_list)
+        self.board = board
+        self.spec_kwargs = dict(kwargs)
+        self.module = self.create_network(
+            board=board, input_planes=self.preprocess.output_dim, **kwargs)
+        self.params = None
+        if init_weights:
+            dummy = jnp.zeros(
+                (1, board, board, self.preprocess.output_dim), jnp.float32)
+            self.params = self.module.init(jax.random.key(seed), dummy)
+        self._apply = jax.jit(self.module.apply)
+
+    # ------------------------------------------------------------- forward
+
+    def forward(self, planes: jax.Array) -> jax.Array:
+        """Jitted apply on encoded planes ``[B, s, s, F]``."""
+        return self._apply(self.params, planes)
+
+    def _states_to_planes(self, states) -> jax.Array:
+        """Host ``pygo.GameState`` list / single device ``GoState`` /
+        batched ``GoState`` / list of either → ``[B, s, s, F]``."""
+        if isinstance(states, jaxgo.GoState):
+            if states.board.ndim == 2:  # already batched
+                return self.preprocess.states_to_tensor(states)
+            return self.preprocess.state_to_tensor(states)
+        if isinstance(states, pygo.GameState):
+            states = [states]
+        dev = [s if isinstance(s, jaxgo.GoState)
+               else jaxgo.from_pygo(self.cfg, s) for s in states]
+        batched = jax.tree.map(lambda *xs: jnp.stack(xs), *dev)
+        return self.preprocess.states_to_tensor(batched)
+
+    @staticmethod
+    def _as_state_list(states):
+        """Normalize eval inputs to a list of single-game states
+        (splits a batched ``GoState`` into per-game views)."""
+        if isinstance(states, pygo.GameState):
+            return [states]
+        if isinstance(states, jaxgo.GoState):
+            if states.board.ndim == 1:
+                return [states]
+            b = states.board.shape[0]
+            return [jax.tree.map(lambda x: x[i], states) for i in range(b)]
+        return list(states)
+
+    # ------------------------------------------------------ spec save/load
+
+    def save_model(self, json_file: str, weights_file: str | None = None):
+        """Write the JSON spec (+ weights beside it unless given)."""
+        spec = {
+            "class": type(self).__name__,
+            "feature_list": list(self.feature_list),
+            "board": self.board,
+            "kwargs": self.spec_kwargs,
+        }
+        if weights_file is None:
+            weights_file = os.path.splitext(json_file)[0] + ".flax.msgpack"
+        spec["weights_file"] = os.path.relpath(
+            weights_file, os.path.dirname(json_file) or ".")
+        parent = os.path.dirname(json_file)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(json_file, "w") as f:
+            json.dump(spec, f, indent=2)
+        self.save_weights(weights_file)
+
+    def save_weights(self, weights_file: str):
+        parent = os.path.dirname(weights_file)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(weights_file, "wb") as f:
+            f.write(serialization.to_bytes(self.params))
+
+    def load_weights(self, weights_file: str):
+        with open(weights_file, "rb") as f:
+            self.params = serialization.from_bytes(self.params, f.read())
+
+    @staticmethod
+    def load_model(json_file: str) -> "NeuralNetBase":
+        """Rebuild any registered network from its JSON spec."""
+        with open(json_file) as f:
+            spec = json.load(f)
+        cls = NEURALNETS.get(spec.get("class"))
+        if cls is None:
+            raise ValueError(
+                f"unknown network class {spec.get('class')!r}; "
+                f"registered: {sorted(NEURALNETS)}")
+        net = cls(tuple(spec["feature_list"]), board=int(spec["board"]),
+                  **spec.get("kwargs", {}))
+        weights = spec.get("weights_file")
+        if weights:
+            path = os.path.join(os.path.dirname(json_file) or ".", weights)
+            net.load_weights(path)
+        return net
+
+    @staticmethod
+    def create_network(**kwargs):
+        raise NotImplementedError
+
+
+@functools.partial(jax.jit, static_argnames=("temperature_is_one",))
+def masked_probs(logits: jax.Array, legal: jax.Array,
+                 temperature: jax.Array | float = 1.0,
+                 temperature_is_one: bool = False) -> jax.Array:
+    """Softmax over legal board points only, with optional temperature
+    (probability exponentiation ``p^(1/T)`` as in the reference's
+    ``ProbabilisticPolicyPlayer``). ``legal`` is bool ``[B, N]`` over
+    board points; all-illegal rows return zeros."""
+    neg = jnp.finfo(logits.dtype).min
+    masked = jnp.where(legal, logits, neg)
+    if not temperature_is_one:
+        masked = masked / temperature
+    p = jax.nn.softmax(masked, axis=-1)
+    p = jnp.where(legal, p, 0.0)
+    denom = p.sum(axis=-1, keepdims=True)
+    return jnp.where(denom > 0, p / jnp.maximum(denom, 1e-30), 0.0)
+
+
+def legal_moves_mask_host(state: pygo.GameState) -> np.ndarray:
+    """Bool [N] legality over board points for a host GameState
+    (sensible moves excluded at the agent layer, not here)."""
+    n = state.size * state.size
+    mask = np.zeros((n,), bool)
+    for (x, y) in state.get_legal_moves(include_eyes=True):
+        mask[x * state.size + y] = True
+    return mask

@@ -1,0 +1,107 @@
+"""SL/RL policy network.
+
+Parity: ``AlphaGo/models/policy.py::CNNPolicy`` (``create_network`` with
+``layers=12, filters_per_layer=128..192, filter_width_1=5,
+filter_width_K=3``, conv trunk + 1×1 conv + per-position bias + softmax
+over board points; ``eval_state`` / ``batch_eval_state`` /
+``_select_moves_and_normalize``; SURVEY.md §2 "SL policy net").
+
+TPU-native design: NHWC bfloat16 convs (MXU-friendly), logits returned
+(softmax fused into the loss / sampling site), per-position bias as a
+plain ``[N]`` parameter. The output space is the ``size²`` board
+points; pass is handled at the agent layer, as in the reference.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocalphago_tpu.engine import jaxgo, pygo
+from rocalphago_tpu.models.nn_util import (
+    NeuralNetBase,
+    legal_moves_mask_host,
+    masked_probs,
+    neuralnet,
+)
+
+
+class PolicyNet(nn.Module):
+    """Conv trunk → 1×1 conv → per-position bias → logits ``[B, N]``."""
+
+    board: int = 19
+    input_planes: int = 48
+    layers: int = 12
+    filters_per_layer: int = 128
+    filter_width_1: int = 5
+    filter_width_K: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        for i in range(self.layers - 1):
+            w = self.filter_width_1 if i == 0 else self.filter_width_K
+            x = nn.Conv(self.filters_per_layer, (w, w), padding="SAME",
+                        dtype=self.dtype, name=f"conv{i + 1}")(x)
+            x = nn.relu(x)
+        x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
+                    name=f"conv{self.layers}")(x)
+        n = self.board * self.board
+        logits = x.reshape((x.shape[0], n)).astype(jnp.float32)
+        bias = self.param("position_bias", nn.initializers.zeros, (n,))
+        return logits + bias
+
+
+@neuralnet
+class CNNPolicy(NeuralNetBase):
+    """Move-probability network over board points."""
+
+    @staticmethod
+    def create_network(board: int = 19, input_planes: int = 48,
+                       layers: int = 12, filters_per_layer: int = 128,
+                       filter_width_1: int = 5,
+                       filter_width_K: int = 3) -> PolicyNet:
+        return PolicyNet(board=board, input_planes=input_planes,
+                         layers=layers,
+                         filters_per_layer=filters_per_layer,
+                         filter_width_1=filter_width_1,
+                         filter_width_K=filter_width_K)
+
+    # -------------------------------------------------- host-facing eval
+
+    def eval_state(self, state, moves=None):
+        """Distribution over legal moves of one state →
+        ``[((x, y), prob), ...]`` (the reference's
+        ``_select_moves_and_normalize`` semantics). ``moves`` optionally
+        restricts the support."""
+        return self.batch_eval_state([state], [moves] if moves else None)[0]
+
+    def batch_eval_state(self, states, moves_lists=None):
+        """Lockstep evaluation of many states (one device call)."""
+        states = self._as_state_list(states)
+        planes = self._states_to_planes(states)
+        logits = np.asarray(self.forward(planes))
+        out = []
+        for i, state in enumerate(states):
+            size = state.size if isinstance(state, pygo.GameState) \
+                else self.board
+            legal = self._legal_for(state)
+            if moves_lists is not None and moves_lists[i] is not None:
+                allowed = np.zeros_like(legal)
+                for (x, y) in moves_lists[i]:
+                    allowed[x * size + y] = True
+                legal = legal & allowed
+            probs = np.asarray(masked_probs(
+                logits[i][None], jnp.asarray(legal[None])))[0]
+            out.append([((p // size, p % size), float(probs[p]))
+                        for p in np.flatnonzero(legal)])
+        return out
+
+    def _legal_for(self, state) -> np.ndarray:
+        if isinstance(state, pygo.GameState):
+            return legal_moves_mask_host(state)
+        mask = np.asarray(jaxgo.legal_mask(self.cfg, state))
+        return mask[:-1]

@@ -1,0 +1,57 @@
+"""Fast rollout policy.
+
+Parity: the reference's rollout slot (SURVEY.md §2 "Rollout policy",
+[C-LOW] — upstream lacks a trained rollout net; its ``MCTS`` accepts any
+``rollout_policy_fn``, and BASELINE's north star names "rollout-policy
+convnets", so the rebuild ships one). A deliberately tiny convnet —
+one 3×3 conv over the cheap feature subset + 1×1 head + per-position
+bias — whose batched forward is a few MXU tiles, so thousands of
+vectorized rollout steps per second per chip are feasible.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from rocalphago_tpu.models.nn_util import NeuralNetBase, neuralnet
+
+# Cheap planes only: no candidate-simulation or ladder features, so the
+# rollout encoder costs a fraction of the full 48-plane pass.
+ROLLOUT_FEATURES = ("board", "ones", "turns_since", "liberties")
+
+
+class RolloutNet(nn.Module):
+    """One 3×3 conv → 1×1 conv → per-position bias → logits ``[B, N]``."""
+
+    board: int = 19
+    input_planes: int = 20
+    filters: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(self.filters, (3, 3), padding="SAME",
+                            dtype=self.dtype, name="conv1")(x))
+        x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
+                    name="conv2")(x)
+        n = self.board * self.board
+        logits = x.reshape((x.shape[0], n)).astype(jnp.float32)
+        bias = self.param("position_bias", nn.initializers.zeros, (n,))
+        return logits + bias
+
+
+@neuralnet
+class CNNRollout(NeuralNetBase):
+    """Fast policy for MCTS rollouts (same eval API as CNNPolicy)."""
+
+    def __init__(self, feature_list=ROLLOUT_FEATURES, **kwargs):
+        super().__init__(feature_list, **kwargs)
+
+    @staticmethod
+    def create_network(board: int = 19, input_planes: int = 20,
+                       filters: int = 32) -> RolloutNet:
+        return RolloutNet(board=board, input_planes=input_planes,
+                          filters=filters)

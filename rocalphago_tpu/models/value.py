@@ -1,0 +1,72 @@
+"""Value network: position → expected outcome in [-1, 1].
+
+Parity: ``AlphaGo/models/value.py::CNNValue`` (same conv trunk as the
+policy + 1×1 conv + ``Dense(256, relu)`` + ``Dense(1, tanh)``;
+``eval_state``; SURVEY.md §2 "Value net"). NHWC bfloat16 trunk, float32
+head, scalar per position.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocalphago_tpu.models.nn_util import NeuralNetBase, neuralnet
+
+
+class ValueNet(nn.Module):
+    """Conv trunk → 1×1 conv → Dense(256) → tanh scalar ``[B]``."""
+
+    board: int = 19
+    input_planes: int = 49
+    layers: int = 12
+    filters_per_layer: int = 128
+    filter_width_1: int = 5
+    filter_width_K: int = 3
+    dense_units: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        for i in range(self.layers - 1):
+            w = self.filter_width_1 if i == 0 else self.filter_width_K
+            x = nn.Conv(self.filters_per_layer, (w, w), padding="SAME",
+                        dtype=self.dtype, name=f"conv{i + 1}")(x)
+            x = nn.relu(x)
+        x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
+                    name=f"conv{self.layers}")(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense_units, dtype=self.dtype,
+                             name="dense1")(x))
+        v = nn.Dense(1, dtype=self.dtype, name="dense2")(x)
+        return jnp.tanh(v[:, 0].astype(jnp.float32))
+
+
+@neuralnet
+class CNNValue(NeuralNetBase):
+    """Scalar position evaluator."""
+
+    @staticmethod
+    def create_network(board: int = 19, input_planes: int = 49,
+                       layers: int = 12, filters_per_layer: int = 128,
+                       filter_width_1: int = 5, filter_width_K: int = 3,
+                       dense_units: int = 256) -> ValueNet:
+        return ValueNet(board=board, input_planes=input_planes,
+                        layers=layers,
+                        filters_per_layer=filters_per_layer,
+                        filter_width_1=filter_width_1,
+                        filter_width_K=filter_width_K,
+                        dense_units=dense_units)
+
+    def eval_state(self, state) -> float:
+        """Expected outcome of one state from the player to move's
+        perspective, in [-1, 1]."""
+        planes = self._states_to_planes(state)
+        return float(np.asarray(self.forward(planes))[0])
+
+    def batch_eval_state(self, states) -> np.ndarray:
+        planes = self._states_to_planes(self._as_state_list(states))
+        return np.asarray(self.forward(planes))

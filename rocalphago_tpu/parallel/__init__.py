@@ -1,0 +1,14 @@
+"""Device topology + shardings — the communication backend
+(SURVEY.md §2c). XLA collectives over ICI/DCN; no hand-written comms."""
+
+from rocalphago_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    distributed_init,
+    global_batch_size,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)

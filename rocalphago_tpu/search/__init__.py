@@ -1,0 +1,14 @@
+"""Agents & search (reference layer L5): policy players, on-device
+batched self-play, and APV-MCTS (SURVEY.md §1 L5, §3.3)."""
+
+from rocalphago_tpu.search.players import (  # noqa: F401
+    GreedyPolicyPlayer,
+    ProbabilisticPolicyPlayer,
+    ValuePlayer,
+)
+from rocalphago_tpu.search.selfplay import (  # noqa: F401
+    SelfplayResult,
+    make_selfplay,
+    play_games,
+    sensible_mask,
+)

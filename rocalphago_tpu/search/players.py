@@ -1,0 +1,136 @@
+"""Host-facing agents over the device nets.
+
+Parity: ``AlphaGo/ai.py`` (``GreedyPolicyPlayer``,
+``ProbabilisticPolicyPlayer`` with its lockstep-batch ``get_moves``,
+``ValuePlayer``; SURVEY.md §2 "Agents"). These wrap host
+``pygo.GameState`` objects for GTP / tournaments / tests; bulk
+self-play does NOT go through them — that's the fully on-device loop
+in :mod:`rocalphago_tpu.search.selfplay`.
+
+``MCTSPlayer`` lives in :mod:`rocalphago_tpu.search.mcts`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rocalphago_tpu.models.policy import CNNPolicy
+from rocalphago_tpu.models.value import CNNValue
+
+
+def _sensible_moves(state, move_limit=None):
+    if move_limit is not None and state.turns_played >= move_limit:
+        return []
+    moves = state.get_legal_moves(include_eyes=False)
+    return moves if moves else []
+
+
+class GreedyPolicyPlayer:
+    """Plays the policy's argmax move over sensible legal moves."""
+
+    def __init__(self, policy: CNNPolicy, pass_when_offered: bool = False,
+                 move_limit: int | None = None):
+        self.policy = policy
+        self.pass_when_offered = pass_when_offered
+        self.move_limit = move_limit
+
+    def get_move(self, state):
+        return self.get_moves([state])[0]
+
+    def get_moves(self, states):
+        out = [None] * len(states)
+        idx, live, moves_lists = [], [], []
+        for i, st in enumerate(states):
+            if self.pass_when_offered and st.history and \
+                    st.history[-1] is None and st.turns_played > 100:
+                continue
+            sensible = _sensible_moves(st, self.move_limit)
+            if sensible:
+                idx.append(i)
+                live.append(st)
+                moves_lists.append(sensible)
+        if not live:
+            return out
+        dists = self.policy.batch_eval_state(live, moves_lists)
+        for i, dist in zip(idx, dists):
+            if dist:
+                out[i] = max(dist, key=lambda mp: mp[1])[0]
+        return out
+
+
+class ProbabilisticPolicyPlayer:
+    """Samples moves ∝ p^(1/temperature) over sensible legal moves —
+    the reference's lockstep-batch self-play agent."""
+
+    def __init__(self, policy: CNNPolicy, temperature: float = 1.0,
+                 seed: int | None = None, move_limit: int | None = 500,
+                 greedy_start: int | None = None):
+        self.policy = policy
+        self.temperature = float(temperature)
+        self.move_limit = move_limit
+        self.greedy_start = greedy_start
+        self.rng = np.random.default_rng(seed)
+
+    def get_move(self, state):
+        return self.get_moves([state])[0]
+
+    def get_moves(self, states):
+        out = [None] * len(states)
+        idx, live, moves_lists = [], [], []
+        for i, st in enumerate(states):
+            sensible = _sensible_moves(st, self.move_limit)
+            if sensible:
+                idx.append(i)
+                live.append(st)
+                moves_lists.append(sensible)
+        if not live:
+            return out
+        dists = self.policy.batch_eval_state(live, moves_lists)
+        for k, (i, dist) in enumerate(zip(idx, dists)):
+            if not dist:
+                continue
+            moves = [m for m, _ in dist]
+            probs = np.asarray([p for _, p in dist], np.float64)
+            greedy = (self.greedy_start is not None
+                      and live[k].turns_played >= self.greedy_start)
+            if self.temperature != 1.0 and not greedy:
+                probs = probs ** (1.0 / self.temperature)
+            probs = probs / probs.sum()
+            if greedy:
+                out[i] = moves[int(np.argmax(probs))]
+            else:
+                out[i] = moves[self.rng.choice(len(moves), p=probs)]
+        return out
+
+
+class ValuePlayer:
+    """One-ply lookahead on the value net: for each sensible move,
+    evaluate the successor and pick the worst position for the
+    opponent (SURVEY.md §2 agents [C-MED])."""
+
+    def __init__(self, value: CNNValue, policy: CNNPolicy | None = None,
+                 top_k: int | None = None, move_limit: int | None = None):
+        self.value = value
+        self.policy = policy      # optional pre-filter to top_k moves
+        self.top_k = top_k
+        self.move_limit = move_limit
+
+    def get_move(self, state):
+        moves = _sensible_moves(state, self.move_limit)
+        if not moves:
+            return None
+        if self.policy is not None and self.top_k:
+            dist = self.policy.eval_state(state, moves=moves)
+            dist.sort(key=lambda mp: -mp[1])
+            moves = [m for m, _ in dist[:self.top_k]]
+        succs = []
+        for mv in moves:
+            nxt = state.copy()
+            nxt.do_move(mv)
+            succs.append(nxt)
+        # value is from the player-to-move's (opponent's) perspective
+        vals = self.value.batch_eval_state(succs)
+        return moves[int(np.argmin(vals))]
+
+    def get_moves(self, states):
+        return [self.get_move(s) for s in states]

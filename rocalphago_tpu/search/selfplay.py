@@ -1,0 +1,132 @@
+"""On-device batched self-play: the whole game loop under one jit.
+
+This is the rebuild of the reference's only vectorized primitive —
+``ProbabilisticPolicyPlayer.get_moves`` stepping ~20 games in lockstep
+on host with per-state Python featurization (SURVEY.md §2b
+"environment parallelism", §3.2 HOT loops). Here the *entire* loop —
+encode planes, policy forward, temperature sampling, rules step —
+is a ``lax.scan`` over moves with every operand batched over games, so
+thousands of games run per chip with zero host round-trips. This is
+the component the ≥200 games/min north-star metric rests on.
+
+Color handling: games in the first half of the batch have net A as
+Black, the second half net B, so each scan step runs exactly one
+half-batch forward through each net (a `jnp.roll` by B/2 swaps the
+halves on odd plies) — no wasted double evaluation.
+
+Move policy matches the reference's self-play players: sample from
+softmax(logits/T) restricted to *sensible* moves (legal, not filling
+an own true eye — the engine's sensibleness analysis); pass only when
+no sensible move exists. Games end by two passes or ``max_moves``
+(reference ``move_limit`` ≈ 500); unfinished games are scored as they
+stand (area scoring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rocalphago_tpu.engine.jaxgo import (
+    GoConfig,
+    GoState,
+    group_data,
+    legal_mask,
+    new_states,
+    step,
+    winner,
+)
+from rocalphago_tpu.features.planes import encode, true_eyes
+
+
+def sensible_mask(cfg: GoConfig, state: GoState) -> jax.Array:
+    """bool [N]: legal board moves that do not fill an own true eye
+    (the reference's ``get_legal_moves(include_eyes=False)``)."""
+    gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko)
+    legal = legal_mask(cfg, state, gd)[:-1]
+    return legal & ~true_eyes(cfg, state, state.turn)
+
+
+class SelfplayResult(NamedTuple):
+    final: GoState       # batched end states
+    actions: jax.Array   # int32 [T, B] action per ply (N = pass)
+    live: jax.Array      # bool  [T, B] game was live when ply t played
+    winners: jax.Array   # int32 [B]    +1 black / -1 white / 0
+    num_moves: jax.Array  # int32 [B]   plies actually played
+
+
+def _half_swap(x: jax.Array, swap: jax.Array) -> jax.Array:
+    """Swap batch halves when ``swap`` (scalar bool) — static shapes."""
+    half = x.shape[0] // 2
+    return lax.cond(swap, lambda a: jnp.roll(a, half, axis=0), lambda a: a,
+                    x)
+
+
+def play_games(cfg: GoConfig, features: tuple,
+               apply_a: Callable, params_a,
+               apply_b: Callable, params_b,
+               rng: jax.Array, batch: int, max_moves: int = 500,
+               temperature: float = 1.0) -> SelfplayResult:
+    """Play ``batch`` lockstep games of net A vs net B.
+
+    First half of the batch: A is Black; second half: B is Black
+    (callers average both colors for unbiased win-rates, as the
+    reference's RL trainer does). ``apply_*`` map (params, planes
+    [B',s,s,F]) → logits [B', N]. Fully jit-compatible; wrap in
+    ``jax.jit`` with static ``cfg/features/batch/max_moves``.
+    """
+    if batch % 2:
+        raise ValueError(
+            f"batch must be even (half-and-half color split), got {batch}")
+    n = cfg.num_points
+    states = new_states(cfg, batch)
+    enc = jax.vmap(functools.partial(encode, cfg, features=features))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(step, cfg))
+
+    def ply(carry, t):
+        states, rng = carry
+        rng, sub = jax.random.split(rng)
+        planes = enc(states)
+        # which half faces net A this ply (see module docstring)
+        swap = (t % 2) == 1
+        rolled = _half_swap(planes, swap)
+        half = batch // 2
+        logits_a = apply_a(params_a, rolled[:half])
+        logits_b = apply_b(params_b, rolled[half:])
+        logits = _half_swap(
+            jnp.concatenate([logits_a, logits_b], axis=0), swap)
+
+        sens = vsens(states)                              # bool [B, N]
+        neg = jnp.finfo(logits.dtype).min
+        masked = jnp.where(sens, logits / temperature, neg)
+        board_action = jax.random.categorical(sub, masked, axis=-1)
+        must_pass = ~sens.any(axis=-1)
+        action = jnp.where(must_pass, n, board_action).astype(jnp.int32)
+
+        live = ~states.done
+        new = vstep(states, action)
+        return (new, rng), (action, live)
+
+    (final, _), (actions, live) = lax.scan(
+        ply, (states, rng), jnp.arange(max_moves))
+    winners = jax.vmap(functools.partial(winner, cfg))(final)
+    return SelfplayResult(final, actions, live, winners,
+                          live.sum(axis=0, dtype=jnp.int32))
+
+
+def make_selfplay(cfg: GoConfig, features: tuple, apply_a: Callable,
+                  apply_b: Callable, batch: int, max_moves: int = 500,
+                  temperature: float = 1.0):
+    """Jitted ``(params_a, params_b, rng) -> SelfplayResult`` closure."""
+
+    @jax.jit
+    def run(params_a, params_b, rng):
+        return play_games(cfg, features, apply_a, params_a, apply_b,
+                          params_b, rng, batch, max_moves, temperature)
+
+    return run

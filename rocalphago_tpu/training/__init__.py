@@ -1,0 +1,3 @@
+"""Trainers: supervised policy, REINFORCE self-play policy, value
+regression, and the self-play value-dataset generator the reference
+lacks (SURVEY.md §1 L4, §2 "Value trainer" gap)."""

@@ -1,0 +1,321 @@
+"""Supervised policy training, data-parallel over the device mesh.
+
+Parity: ``AlphaGo/training/supervised_policy_trainer.py::run_training``
+(SGD + categorical cross-entropy on (state → expert move), minibatch 16,
+lr ~0.003 with decay, .93/.05/.02 split, 8-symmetry augmentation,
+per-epoch checkpoints + ``metadata.json``, persisted shuffle for resume;
+SURVEY.md §2 "SL trainer", §3.1).
+
+TPU-native design:
+* one jitted ``train_step`` whose inputs carry `NamedSharding`s — batch
+  split over the mesh ``data`` axis, params replicated; XLA inserts the
+  gradient all-reduce over ICI (SURVEY.md §2b "Data parallel");
+* dihedral augmentation runs *inside* the step on device
+  (``symmetries.random_transform_batch``), not per-sample on host;
+* input pipeline: sharded npz + double-buffered ``device_put`` prefetch;
+* checkpoints are Orbax pytrees of (params, opt state, step, PRNG bits)
+  — exact resume, async save.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import os
+import sys
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rocalphago_tpu.data.pipeline import (
+    ShardedDataset,
+    batch_iterator,
+    device_prefetch,
+    split_indices,
+)
+from rocalphago_tpu.io.checkpoint import (
+    MetadataWriter,
+    TrainCheckpointer,
+    pack_rng,
+    unpack_rng,
+)
+from rocalphago_tpu.io.metrics import MetricsLogger
+from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.training.symmetries import random_transform_batch
+
+
+@dataclasses.dataclass
+class SLConfig:
+    """Flat, JSON-serializable stage config (SURVEY.md §5 "Config")."""
+
+    model_json: str = ""
+    train_data: str = ""          # shard prefix (npz pipeline)
+    out_dir: str = ""
+    minibatch: int = 16           # per *mesh*, like the reference's 16
+    epochs: int = 10
+    learning_rate: float = 0.003
+    decay: float = 0.0            # Keras-style lr/(1+decay*step)
+    momentum: float = 0.0
+    train_val_test: tuple = (0.93, 0.05, 0.02)
+    symmetries: bool = True
+    seed: int = 0
+    num_devices: int | None = None
+    max_validation_batches: int = 200
+    epoch_length: int | None = None   # steps per epoch; None = full pass
+
+
+class SLState(NamedTuple):
+    params: dict
+    opt_state: tuple
+    step: jax.Array     # int32 []
+    rng: jax.Array      # uint32 key data
+
+
+def make_optimizer(cfg: SLConfig) -> optax.GradientTransformation:
+    """SGD with the reference's Keras-style inverse-time lr decay."""
+    if cfg.decay:
+        sched = lambda step: cfg.learning_rate / (1.0 + cfg.decay * step)  # noqa: E731
+    else:
+        sched = cfg.learning_rate
+    return optax.sgd(sched, momentum=cfg.momentum or None)
+
+
+def policy_loss_fn(apply_fn, params, planes, actions):
+    logits = apply_fn(params, planes)
+    # pass actions (== N, present when a corpus was converted with
+    # include_passes) are outside the policy's board-point output space
+    # — mask them out rather than letting the xent gather clamp them
+    # onto the last board point
+    valid = (actions < logits.shape[-1]).astype(jnp.float32)
+    denom = jnp.maximum(valid.sum(), 1.0)
+    xent = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.minimum(actions, logits.shape[-1] - 1))
+    loss = (xent * valid).sum() / denom
+    acc = (((logits.argmax(axis=-1) == actions) * valid).sum() / denom)
+    return loss, acc
+
+
+def make_train_step(apply_fn, tx, size: int, symmetries: bool):
+    """Pure (state, planes, actions) → (state, metrics) step fn."""
+
+    def train_step(state: SLState, planes, actions):
+        key = unpack_rng(state.rng)
+        key, sub = jax.random.split(key)
+        planes = planes.astype(jnp.float32)
+        if symmetries:
+            planes, actions = random_transform_batch(
+                sub, planes, actions, size)
+        (loss, acc), grads = jax.value_and_grad(
+            functools.partial(policy_loss_fn, apply_fn), has_aux=True)(
+                state.params, planes, actions)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new = SLState(params, opt_state, state.step + 1, pack_rng(key))
+        return new, {"loss": loss, "accuracy": acc}
+
+    return train_step
+
+
+def make_eval_step(apply_fn):
+    def eval_step(params, planes, actions):
+        loss, acc = policy_loss_fn(
+            apply_fn, params, planes.astype(jnp.float32), actions)
+        return {"loss": loss, "accuracy": acc}
+    return eval_step
+
+
+class SLTrainer:
+    """Wires net + data + mesh + checkpointing into the train loop.
+
+    Usable programmatically (tests drive small configs through it) or
+    via the ``run_training`` CLI.
+    """
+
+    def __init__(self, cfg: SLConfig, net: NeuralNetBase | None = None):
+        self.cfg = cfg
+        self.net = net or NeuralNetBase.load_model(cfg.model_json)
+        self.mesh = meshlib.make_mesh(cfg.num_devices)
+        self.dataset = ShardedDataset(cfg.train_data)
+        if self.dataset.planes != self.net.preprocess.output_dim:
+            raise ValueError(
+                f"dataset has {self.dataset.planes} planes but the model's "
+                f"feature list needs {self.net.preprocess.output_dim}")
+        os.makedirs(cfg.out_dir, exist_ok=True)
+
+        dwidth = self.mesh.shape[meshlib.DATA_AXIS]
+        if cfg.minibatch % dwidth:
+            raise ValueError(
+                f"minibatch {cfg.minibatch} not divisible by data-parallel "
+                f"width {dwidth}")
+
+        tx = make_optimizer(cfg)
+        size = self.net.board
+        opt_state0 = tx.init(self.net.params)
+        batch_sh = meshlib.data_sharding(self.mesh, rank=4)
+        act_sh = meshlib.data_sharding(self.mesh, rank=1)
+        rep = meshlib.replicated(self.mesh)
+        state_sh = SLState(
+            params=jax.tree.map(lambda _: rep, self.net.params),
+            opt_state=jax.tree.map(lambda _: rep, opt_state0),
+            step=rep, rng=rep)
+        self._train_step = jax.jit(
+            make_train_step(self.net.module.apply, tx, size, cfg.symmetries),
+            in_shardings=(state_sh, batch_sh, act_sh),
+            out_shardings=(state_sh, rep),
+            donate_argnums=(0,))
+        self._eval_step = jax.jit(
+            make_eval_step(self.net.module.apply),
+            in_shardings=(state_sh.params, batch_sh, act_sh),
+            out_shardings=rep)
+
+        self.tx = tx
+        self.ckpt = TrainCheckpointer(
+            os.path.join(cfg.out_dir, "checkpoints"))
+        self.metrics = MetricsLogger(
+            os.path.join(cfg.out_dir, "metrics.jsonl"))
+
+        key = jax.random.key(cfg.seed)
+        self.state = meshlib.replicate(self.mesh, SLState(
+            params=self.net.params,
+            opt_state=opt_state0,
+            step=jnp.int32(0),
+            rng=pack_rng(key)))
+
+        self.train_idx, self.val_idx, self.test_idx = split_indices(
+            len(self.dataset), cfg.train_val_test, seed=cfg.seed,
+            path=os.path.join(cfg.out_dir, "shuffle.npz"))
+        self.start_epoch = 0
+        self._maybe_resume()
+
+    # ----------------------------------------------------------- resume
+
+    def _maybe_resume(self):
+        restored, step = self.ckpt.restore(jax.device_get(self.state))
+        if restored is None:
+            return
+        self.state = meshlib.replicate(self.mesh, SLState(*restored))
+        steps_per_epoch = self._steps_per_epoch()
+        self.start_epoch = int(restored.step) // max(steps_per_epoch, 1)
+        self.metrics.log("resume", step=int(restored.step),
+                         epoch=self.start_epoch)
+
+    def _steps_per_epoch(self) -> int:
+        if self.cfg.epoch_length:
+            return self.cfg.epoch_length
+        return max(len(self.train_idx) // self.cfg.minibatch, 1)
+
+    # ------------------------------------------------------------- train
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        meta = MetadataWriter(
+            os.path.join(cfg.out_dir, "metadata.json"),
+            header={"cmd": " ".join(sys.argv),
+                    "config": dataclasses.asdict(cfg),
+                    "dataset_positions": len(self.dataset)})
+        steps_per_epoch = self._steps_per_epoch()
+        # host RNG seeded per-epoch → identical batch order on re-run
+        # of the same epoch after resume (reference shuffle.npz trick)
+        final = {}
+        for epoch in range(self.start_epoch, cfg.epochs):
+            host_rng = np.random.default_rng(
+                np.random.SeedSequence([cfg.seed, epoch]))
+            it = batch_iterator(self.dataset, self.train_idx,
+                                cfg.minibatch, host_rng, epochs=1)
+            it = (meshlib.shard_batch(self.mesh, b)
+                  for b in it)
+            t0 = time.time()
+            losses, accs = [], []
+            for i, (planes, actions) in enumerate(
+                    device_prefetch(it, size=2)):
+                if i >= steps_per_epoch:
+                    break
+                self.state, m = self._train_step(
+                    self.state, planes, actions)
+                losses.append(m["loss"])
+                accs.append(m["accuracy"])
+            train_loss = float(jnp.mean(jnp.stack(losses)))
+            train_acc = float(jnp.mean(jnp.stack(accs)))
+            dt = time.time() - t0
+            val = self.evaluate(self.val_idx)
+            step = int(jax.device_get(self.state.step))
+            entry = {
+                "epoch": epoch, "step": step,
+                "train_loss": train_loss, "train_accuracy": train_acc,
+                "val_loss": val["loss"], "val_accuracy": val["accuracy"],
+                "positions_per_s": len(losses) * cfg.minibatch / max(dt, 1e-9),
+            }
+            self.metrics.log("epoch", **entry)
+            meta.record_epoch(entry)
+            self.ckpt.save(step, jax.device_get(self.state))
+            self._export_weights(epoch)
+            final = entry
+        self.ckpt.wait()
+        return final
+
+    def evaluate(self, indices, max_batches: int | None = None) -> dict:
+        cfg = self.cfg
+        max_batches = max_batches or cfg.max_validation_batches
+        params = self.state.params
+        rng = np.random.default_rng(0)
+        losses, accs = [], []
+        it = batch_iterator(self.dataset, indices, cfg.minibatch, rng,
+                            epochs=1)
+        for i, (planes, actions) in enumerate(it):
+            if i >= max_batches:
+                break
+            planes, actions = meshlib.shard_batch(
+                self.mesh, (planes, actions))
+            m = self._eval_step(params, planes, actions)
+            losses.append(m["loss"])
+            accs.append(m["accuracy"])
+        if not losses:
+            return {"loss": float("nan"), "accuracy": float("nan")}
+        return {"loss": float(jnp.mean(jnp.stack(losses))),
+                "accuracy": float(jnp.mean(jnp.stack(accs)))}
+
+    def _export_weights(self, epoch: int) -> None:
+        """Reference-parity per-epoch weight export
+        (``weights.NNNNN``-style) in the model-spec format GTP loads."""
+        self.net.params = jax.device_get(self.state.params)
+        self.net.save_weights(os.path.join(
+            self.cfg.out_dir, f"weights.{epoch:05d}.flax.msgpack"))
+
+
+def run_training(argv=None) -> dict:
+    """CLI parity with the reference trainer."""
+    ap = argparse.ArgumentParser(
+        description="Supervised policy training on expert games")
+    ap.add_argument("model_json")
+    ap.add_argument("train_data", help="npz shard prefix")
+    ap.add_argument("out_dir")
+    ap.add_argument("--minibatch", "-B", type=int, default=16)
+    ap.add_argument("--epochs", "-E", type=int, default=10)
+    ap.add_argument("--learning-rate", "-l", type=float, default=0.003)
+    ap.add_argument("--decay", "-d", type=float, default=0.0)
+    ap.add_argument("--momentum", type=float, default=0.0)
+    ap.add_argument("--train-val-test", nargs=3, type=float,
+                    default=[0.93, 0.05, 0.02])
+    ap.add_argument("--no-symmetries", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--num-devices", type=int, default=None)
+    ap.add_argument("--epoch-length", type=int, default=None)
+    a = ap.parse_args(argv)
+    cfg = SLConfig(
+        model_json=a.model_json, train_data=a.train_data, out_dir=a.out_dir,
+        minibatch=a.minibatch, epochs=a.epochs,
+        learning_rate=a.learning_rate, decay=a.decay, momentum=a.momentum,
+        train_val_test=tuple(a.train_val_test),
+        symmetries=not a.no_symmetries, seed=a.seed,
+        num_devices=a.num_devices, epoch_length=a.epoch_length)
+    return SLTrainer(cfg).run()
+
+
+if __name__ == "__main__":
+    run_training(sys.argv[1:])

@@ -1,0 +1,77 @@
+"""On-device dihedral (D4) augmentation.
+
+Parity: the reference SL trainer's ``BOARD_TRANSFORMATIONS`` — 8 board
+symmetries applied randomly per sample on the *host* with
+``np.rot90/fliplr`` (SURVEY.md §2 "SL trainer"). Here the transform is
+a jitted gather on device: one random int per sample picks the group
+element, applied to both the NHWC plane stack and the flat action
+index, so augmentation rides along inside the compiled train step at
+zero host cost.
+
+Group element ``t`` in 0..7 = ``rot90^(t % 4)`` then horizontal flip if
+``t >= 4``; ``inverse_transform`` provides the inverse permutation for
+symmetry-averaged evaluation (used by search).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def transform_planes(x: jax.Array, t: jax.Array) -> jax.Array:
+    """Apply group element ``t`` (int scalar) to one ``[s, s, F]`` (or
+    ``[s, s]``) array. Branchless: composed from flips/transposes picked
+    by ``lax.switch``."""
+    return jax.lax.switch(t, [
+        lambda a: a,
+        lambda a: jnp.rot90(a, 1),
+        lambda a: jnp.rot90(a, 2),
+        lambda a: jnp.rot90(a, 3),
+        lambda a: jnp.flip(a, axis=1),
+        lambda a: jnp.rot90(jnp.flip(a, axis=1), 1),
+        lambda a: jnp.rot90(jnp.flip(a, axis=1), 2),
+        lambda a: jnp.rot90(jnp.flip(a, axis=1), 3),
+    ], x)
+
+
+def transform_action(action: jax.Array, t: jax.Array, size: int
+                     ) -> jax.Array:
+    """Apply group element ``t`` to a flat board action (pass = ``size²``
+    maps to itself)."""
+    n = size * size
+    grid = jnp.arange(n, dtype=action.dtype).reshape(size, size)
+    # forward-transform the *index grid*: entry (r, c) of the transformed
+    # grid names the source point that lands at (r, c); we need the
+    # inverse map (where does `action` land), so scatter instead:
+    moved = transform_planes(grid, t).reshape(n)      # moved[dst] = src
+    dest = jnp.zeros((n,), action.dtype).at[moved].set(
+        jnp.arange(n, dtype=action.dtype))            # dest[src] = dst
+    return jnp.where(action >= n, action, dest[jnp.minimum(action, n - 1)])
+
+
+def inverse_transform_planes(x: jax.Array, t: jax.Array) -> jax.Array:
+    """Inverse group element (t<4 → rot90^(4-t); t>=4 is an involution
+    composed as flip∘rot, whose inverse is rot^{-1}∘flip = itself for
+    these generators)."""
+    return jax.lax.switch(t, [
+        lambda a: a,
+        lambda a: jnp.rot90(a, 3),
+        lambda a: jnp.rot90(a, 2),
+        lambda a: jnp.rot90(a, 1),
+        lambda a: jnp.flip(a, axis=1),
+        lambda a: jnp.flip(jnp.rot90(a, 3), axis=1),
+        lambda a: jnp.flip(jnp.rot90(a, 2), axis=1),
+        lambda a: jnp.flip(jnp.rot90(a, 1), axis=1),
+    ], x)
+
+
+def random_transform_batch(rng: jax.Array, planes: jax.Array,
+                           actions: jax.Array, size: int):
+    """Random per-sample symmetry for a training batch
+    (``planes [B,s,s,F]``, ``actions [B]``)."""
+    t = jax.random.randint(rng, (planes.shape[0],), 0, 8)
+    planes = jax.vmap(transform_planes)(planes, t)
+    actions = jax.vmap(
+        lambda a, ti: transform_action(a, ti, size))(actions, t)
+    return planes, actions

@@ -2,17 +2,24 @@
 
 Forces JAX onto a virtual 8-device CPU mesh (SURVEY.md §4 "multi-node
 testing") so data-parallel training, collectives, and shardings are
-exercised in CI without TPU hardware. Must run before ``import jax``,
-hence the env mutation at module import time (pytest imports conftest
-before test modules).
+exercised in CI without TPU hardware.
+
+Note: env vars alone are not enough here — the machine's sitecustomize
+registers a TPU PJRT plugin at interpreter start and pins
+``jax_platforms``, so we also override the config after import (safe:
+backends initialize lazily, at the first ``jax.devices()`` call, which
+has not happened yet at conftest-import time).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_CHECKS", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

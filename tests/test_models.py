@@ -1,0 +1,109 @@
+"""Model tests: shapes, masking/normalization, spec roundtrip.
+
+Mirrors the reference's ``tests/test_policy.py`` / value analog
+(SURVEY.md §4 "Model tests"): tiny networks via ``create_network``,
+softmax-over-legal-moves normalization, and the save→load→identical-
+output roundtrip of the JSON+weights format.
+"""
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.models import (
+    CNNPolicy,
+    CNNRollout,
+    CNNValue,
+    NeuralNetBase,
+)
+
+FEATURES = ("board", "ones")
+SIZE = 7
+
+
+@pytest.fixture(scope="module")
+def policy():
+    return CNNPolicy(FEATURES, board=SIZE, layers=3, filters_per_layer=8)
+
+
+@pytest.fixture(scope="module")
+def midgame():
+    st = pygo.GameState(size=SIZE)
+    for mv in [(3, 3), (2, 2), (3, 4), (2, 5), (4, 2)]:
+        st.do_move(mv)
+    return st
+
+
+def test_policy_eval_normalized_over_legal(policy, midgame):
+    moves = policy.eval_state(midgame)
+    legal = set(midgame.get_legal_moves(include_eyes=True))
+    assert {m for m, _ in moves} == legal
+    assert np.isclose(sum(p for _, p in moves), 1.0, atol=1e-5)
+    assert all(p >= 0 for _, p in moves)
+
+
+def test_policy_restricted_moves(policy, midgame):
+    subset = [(0, 0), (6, 6)]
+    moves = policy.eval_state(midgame, moves=subset)
+    assert {m for m, _ in moves} == set(subset)
+    assert np.isclose(sum(p for _, p in moves), 1.0, atol=1e-5)
+
+
+def test_policy_batch_eval_matches_single(policy, midgame):
+    fresh = pygo.GameState(size=SIZE)
+    batch = policy.batch_eval_state([midgame, fresh])
+    single = policy.eval_state(midgame)
+    assert dict(batch[0]).keys() == dict(single).keys()
+    # bf16 trunk → batch-size-dependent reduction order; loose tolerance
+    for m, p in single:
+        assert np.isclose(dict(batch[0])[m], p, atol=1e-3)
+    # fresh board: every point legal
+    assert len(batch[1]) == SIZE * SIZE
+
+
+def test_policy_spec_roundtrip(tmp_path, policy, midgame):
+    path = tmp_path / "policy.json"
+    policy.save_model(str(path))
+    loaded = NeuralNetBase.load_model(str(path))
+    assert isinstance(loaded, CNNPolicy)
+    assert loaded.feature_list == policy.feature_list
+    a = policy.eval_state(midgame)
+    b = loaded.eval_state(midgame)
+    np.testing.assert_allclose([p for _, p in a], [p for _, p in b],
+                               atol=1e-6)
+
+
+def test_value_range_and_roundtrip(tmp_path, midgame):
+    val = CNNValue(FEATURES, board=SIZE, layers=3, filters_per_layer=8,
+                   dense_units=16, seed=3)
+    v = val.eval_state(midgame)
+    assert -1.0 <= v <= 1.0
+    path = tmp_path / "value.json"
+    val.save_model(str(path))
+    loaded = NeuralNetBase.load_model(str(path))
+    assert np.isclose(loaded.eval_state(midgame), v, atol=1e-6)
+
+
+def test_value_batch(midgame):
+    val = CNNValue(FEATURES, board=SIZE, layers=2, filters_per_layer=4,
+                   dense_units=8)
+    out = val.batch_eval_state([midgame, pygo.GameState(size=SIZE)])
+    assert out.shape == (2,)
+
+
+def test_rollout_defaults_to_cheap_features():
+    ro = CNNRollout(board=SIZE, filters=4)
+    # board(3) + ones(1) + turns_since(8) + liberties(8)
+    assert ro.preprocess.output_dim == 20
+    planes = np.zeros((2, SIZE, SIZE, 20), np.float32)
+    logits = ro.forward(planes)
+    assert logits.shape == (2, SIZE * SIZE)
+
+
+def test_unknown_class_rejected(tmp_path):
+    import json
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(
+        {"class": "NoSuchNet", "feature_list": ["board"], "board": 7}))
+    with pytest.raises(ValueError, match="unknown network class"):
+        NeuralNetBase.load_model(str(path))
