@@ -25,6 +25,7 @@ import functools
 import json
 import os
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,6 +35,47 @@ from rocalphago_tpu.engine import jaxgo, pygo
 from rocalphago_tpu.features import DEFAULT_FEATURES, Preprocess
 
 NEURALNETS: dict[str, type] = {}
+
+
+class ConvTrunk(nn.Module):
+    """The AlphaGo conv trunk shared by policy and value nets: a
+    width-``filter_width_1`` first layer then ``layers-2`` more of
+    width ``filter_width_K``, ReLU, SAME padding (reference
+    ``create_network`` trunk)."""
+
+    layers: int = 12
+    filters_per_layer: int = 128
+    filter_width_1: int = 5
+    filter_width_K: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        for i in range(self.layers - 1):
+            w = self.filter_width_1 if i == 0 else self.filter_width_K
+            x = nn.Conv(self.filters_per_layer, (w, w), padding="SAME",
+                        dtype=self.dtype, name=f"conv{i + 1}")(x)
+            x = nn.relu(x)
+        return x
+
+
+class PointHead(nn.Module):
+    """1×1 conv → flatten → per-position learned bias → float32 logits
+    ``[B, N]`` over board points (the reference's custom Keras ``Bias``
+    layer, as a plain parameter)."""
+
+    board: int = 19
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
+                    name="conv")(x)
+        n = self.board * self.board
+        logits = x.reshape((x.shape[0], n)).astype(jnp.float32)
+        bias = self.param("position_bias", nn.initializers.zeros, (n,))
+        return logits + bias
 
 
 def neuralnet(cls):

@@ -21,7 +21,9 @@ import numpy as np
 
 from rocalphago_tpu.engine import jaxgo, pygo
 from rocalphago_tpu.models.nn_util import (
+    ConvTrunk,
     NeuralNetBase,
+    PointHead,
     legal_moves_mask_host,
     masked_probs,
     neuralnet,
@@ -41,18 +43,13 @@ class PolicyNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = x.astype(self.dtype)
-        for i in range(self.layers - 1):
-            w = self.filter_width_1 if i == 0 else self.filter_width_K
-            x = nn.Conv(self.filters_per_layer, (w, w), padding="SAME",
-                        dtype=self.dtype, name=f"conv{i + 1}")(x)
-            x = nn.relu(x)
-        x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
-                    name=f"conv{self.layers}")(x)
-        n = self.board * self.board
-        logits = x.reshape((x.shape[0], n)).astype(jnp.float32)
-        bias = self.param("position_bias", nn.initializers.zeros, (n,))
-        return logits + bias
+        x = ConvTrunk(layers=self.layers,
+                      filters_per_layer=self.filters_per_layer,
+                      filter_width_1=self.filter_width_1,
+                      filter_width_K=self.filter_width_K,
+                      dtype=self.dtype, name="trunk")(x)
+        return PointHead(board=self.board, dtype=self.dtype,
+                         name="head")(x)
 
 
 @neuralnet
@@ -76,15 +73,17 @@ class CNNPolicy(NeuralNetBase):
         """Distribution over legal moves of one state →
         ``[((x, y), prob), ...]`` (the reference's
         ``_select_moves_and_normalize`` semantics). ``moves`` optionally
-        restricts the support."""
-        return self.batch_eval_state([state], [moves] if moves else None)[0]
+        restricts the support (an empty list means "no moves")."""
+        return self.batch_eval_state(
+            [state], [moves] if moves is not None else None)[0]
 
     def batch_eval_state(self, states, moves_lists=None):
-        """Lockstep evaluation of many states (one device call)."""
+        """Lockstep evaluation of many states: one forward and one
+        masked-softmax device call for the whole batch."""
         states = self._as_state_list(states)
         planes = self._states_to_planes(states)
-        logits = np.asarray(self.forward(planes))
-        out = []
+        logits = self.forward(planes)
+        sizes, legal_rows = [], []
         for i, state in enumerate(states):
             size = state.size if isinstance(state, pygo.GameState) \
                 else self.board
@@ -94,10 +93,15 @@ class CNNPolicy(NeuralNetBase):
                 for (x, y) in moves_lists[i]:
                     allowed[x * size + y] = True
                 legal = legal & allowed
-            probs = np.asarray(masked_probs(
-                logits[i][None], jnp.asarray(legal[None])))[0]
-            out.append([((p // size, p % size), float(probs[p]))
-                        for p in np.flatnonzero(legal)])
+            sizes.append(size)
+            legal_rows.append(legal)
+        legal_b = np.stack(legal_rows)
+        probs = np.asarray(masked_probs(logits, jnp.asarray(legal_b)))
+        out = []
+        for i, size in enumerate(sizes):
+            out.append([((int(p) // size, int(p) % size),
+                         float(probs[i, p]))
+                        for p in np.flatnonzero(legal_b[i])])
         return out
 
     def _legal_for(self, state) -> np.ndarray:

@@ -15,7 +15,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from rocalphago_tpu.models.nn_util import NeuralNetBase, neuralnet
+from rocalphago_tpu.models.nn_util import (
+    NeuralNetBase,
+    PointHead,
+    neuralnet,
+)
 
 # Cheap planes only: no candidate-simulation or ladder features, so the
 # rollout encoder costs a fraction of the full 48-plane pass.
@@ -35,12 +39,8 @@ class RolloutNet(nn.Module):
         x = x.astype(self.dtype)
         x = nn.relu(nn.Conv(self.filters, (3, 3), padding="SAME",
                             dtype=self.dtype, name="conv1")(x))
-        x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
-                    name="conv2")(x)
-        n = self.board * self.board
-        logits = x.reshape((x.shape[0], n)).astype(jnp.float32)
-        bias = self.param("position_bias", nn.initializers.zeros, (n,))
-        return logits + bias
+        return PointHead(board=self.board, dtype=self.dtype,
+                         name="head")(x)
 
 
 @neuralnet

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rocalphago_tpu.models.nn_util import NeuralNetBase, neuralnet
+from rocalphago_tpu.models.nn_util import ConvTrunk, NeuralNetBase, neuralnet
 
 
 class ValueNet(nn.Module):
@@ -30,14 +30,13 @@ class ValueNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = x.astype(self.dtype)
-        for i in range(self.layers - 1):
-            w = self.filter_width_1 if i == 0 else self.filter_width_K
-            x = nn.Conv(self.filters_per_layer, (w, w), padding="SAME",
-                        dtype=self.dtype, name=f"conv{i + 1}")(x)
-            x = nn.relu(x)
+        x = ConvTrunk(layers=self.layers,
+                      filters_per_layer=self.filters_per_layer,
+                      filter_width_1=self.filter_width_1,
+                      filter_width_K=self.filter_width_K,
+                      dtype=self.dtype, name="trunk")(x)
         x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
-                    name=f"conv{self.layers}")(x)
+                    name="head_conv")(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(self.dense_units, dtype=self.dtype,
                              name="dense1")(x))

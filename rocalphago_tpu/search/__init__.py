@@ -1,6 +1,13 @@
 """Agents & search (reference layer L5): policy players, on-device
 batched self-play, and APV-MCTS (SURVEY.md §1 L5, §3.3)."""
 
+from rocalphago_tpu.search.mcts import (  # noqa: F401
+    MCTS,
+    MCTSPlayer,
+    ParallelMCTS,
+    TreeNode,
+    net_backends,
+)
 from rocalphago_tpu.search.players import (  # noqa: F401
     GreedyPolicyPlayer,
     ProbabilisticPolicyPlayer,

@@ -1,0 +1,375 @@
+"""APV-MCTS: PUCT tree search with batched device leaf evaluation.
+
+Parity: ``AlphaGo/mcts.py`` (``TreeNode`` with ``_P/_Q/_u/_n_visits``,
+``select`` = argmax(Q+u), ``expand``, ``update_recursive``; ``MCTS``
+with ``value_fn/policy_fn/rollout_policy_fn``, ``lmbda``, ``c_puct``,
+``rollout_limit``, ``playout_depth``, ``n_playout``, ``get_move``,
+``update_with_move`` subtree reuse; the empty ``ParallelMCTS`` stub;
+SURVEY.md §2 "MCTS", §3.3). Every NN touchpoint is an injected callable
+— the reference's test seam — so tree mechanics are testable with plain
+lambdas.
+
+TPU-native design (SURVEY.md §7 step 6): the tree lives on host (tiny,
+pointer-chasing, branchy — a bad fit for XLA), but *leaf evaluation is
+batched*: ``ParallelMCTS`` runs ``leaf_batch`` playouts per wave under
+virtual loss, collects the distinct leaves, and evaluates policy priors
+and values for all of them in ONE jitted forward per net — replacing
+the reference's batch-size-1 evals per playout (its known bottleneck)
+and filling in its unimplemented ``ParallelMCTS``. Rollouts for the
+λ-mix run lockstep across the wave through the injected batch rollout
+callable (host rules, batched NN forward — or fully on device via
+:func:`device_rollout_fn`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rocalphago_tpu.engine import pygo
+
+PASS_MOVE = pygo.PASS_MOVE
+
+
+class TreeNode:
+    """A node in the MCTS tree, holding the edge statistics of the move
+    that led to it: prior ``_P``, mean value ``_Q`` (from the moving
+    player's perspective), visit count ``_n_visits``, and the PUCT
+    exploration bonus ``_u``."""
+
+    __slots__ = ("_parent", "_children", "_n_visits", "_Q", "_u", "_P",
+                 "_vloss")
+
+    def __init__(self, parent: "TreeNode | None", prior_p: float):
+        self._parent = parent
+        self._children: dict = {}     # move -> TreeNode
+        self._n_visits = 0
+        self._Q = 0.0
+        self._u = prior_p
+        self._P = prior_p
+        self._vloss = 0               # outstanding virtual losses
+
+    def expand(self, action_priors) -> None:
+        """Create children for ``[(move, prior), ...]``."""
+        for action, prob in action_priors:
+            if action not in self._children:
+                self._children[action] = TreeNode(self, prob)
+
+    def select(self, c_puct: float) -> tuple:
+        """(move, child) maximizing Q + u."""
+        return max(self._children.items(),
+                   key=lambda ac: ac[1].get_value(c_puct))
+
+    def get_value(self, c_puct: float) -> float:
+        n_parent = self._parent._n_visits if self._parent else 1
+        self._u = (c_puct * self._P * np.sqrt(max(n_parent, 1))
+                   / (1 + self._n_visits))
+        return self._Q + self._u
+
+    def update(self, leaf_value: float) -> None:
+        """Fold one evaluation (from this node's edge perspective) into
+        the running mean."""
+        self._n_visits += 1
+        self._Q += (leaf_value - self._Q) / self._n_visits
+
+    def update_recursive(self, leaf_value: float) -> None:
+        """Update ancestors bottom-up, flipping the sign per level
+        (alternating players)."""
+        if self._parent:
+            self._parent.update_recursive(-leaf_value)
+        self.update(leaf_value)
+
+    # ------------------------------------------------------ virtual loss
+
+    def add_virtual_loss(self, loss: float = 1.0) -> None:
+        """Pessimistic in-flight marker that steers later selections in
+        the same wave away from this path (AlphaGo's n_vl trick)."""
+        self._vloss += 1
+        self._n_visits += 1
+        self._Q += (-loss - self._Q) / self._n_visits
+
+    def revert_virtual_loss(self, loss: float = 1.0) -> None:
+        if self._vloss <= 0:
+            return
+        self._vloss -= 1
+        self._Q = (self._Q * self._n_visits + loss) / max(
+            self._n_visits - 1, 1)
+        self._n_visits -= 1
+
+    def is_leaf(self) -> bool:
+        return not self._children
+
+    def is_root(self) -> bool:
+        return self._parent is None
+
+
+class MCTS:
+    """Asynchronous-policy-and-value MCTS (sequential reference form).
+
+    ``policy_fn(state) -> [(move, prob), ...]`` over sensible moves;
+    ``value_fn(state) -> float`` in [-1, 1] from the player to move's
+    perspective; ``rollout_policy_fn(state) -> [(move, prob), ...]``
+    used for playouts. Leaf value = (1−λ)·value + λ·rollout_outcome.
+    """
+
+    def __init__(self, value_fn, policy_fn, rollout_policy_fn,
+                 lmbda: float = 0.5, c_puct: float = 5.0,
+                 rollout_limit: int = 500, playout_depth: int = 20,
+                 n_playout: int = 10000, rng=None):
+        self._root = TreeNode(None, 1.0)
+        self._value = value_fn
+        self._policy = policy_fn
+        self._rollout = rollout_policy_fn
+        self._lmbda = lmbda
+        self._c_puct = c_puct
+        self._rollout_limit = rollout_limit
+        self._L = playout_depth
+        self._n_playout = n_playout
+        self._rng = rng or np.random.default_rng(0)
+
+    # ---------------------------------------------------------- playouts
+
+    def _descend(self, state):
+        """Walk from the root to a leaf (≤ playout_depth plies),
+        mutating ``state`` along the way. Returns the leaf node."""
+        node = self._root
+        for _ in range(self._L):
+            if node.is_leaf():
+                break
+            move, node = node.select(self._c_puct)
+            state.do_move(move)
+        return node
+
+    def _playout(self, state) -> None:
+        node = self._descend(state)
+        if not state.is_end_of_game:
+            priors = self._policy(state)
+            if priors:
+                node.expand(priors)
+        node.update_recursive(self._leaf_value(state))
+
+    def _leaf_value(self, state) -> float:
+        """λ-mixed evaluation from the leaf's player-to-move
+        perspective, returned from the *edge* (previous mover's)
+        perspective — i.e. negated — ready for ``update_recursive``."""
+        if state.is_end_of_game:
+            w = state.get_winner()
+            v = 0.0 if w == 0 else (1.0 if w == state.current_player
+                                    else -1.0)
+        else:
+            v = 0.0
+            if self._lmbda < 1.0:
+                v += (1.0 - self._lmbda) * float(self._value(state))
+            if self._lmbda > 0.0:
+                v += self._lmbda * self._evaluate_rollout(
+                    state.copy(), self._rollout_limit)
+        return -v
+
+    def _evaluate_rollout(self, state, limit: int) -> float:
+        """Play to the end (≤ limit plies) with the rollout policy;
+        outcome from the perspective of the player to move at entry."""
+        player = state.current_player
+        for _ in range(limit):
+            if state.is_end_of_game:
+                break
+            dist = self._rollout(state)
+            if not dist:
+                state.do_move(PASS_MOVE)
+                continue
+            probs = np.asarray([p for _, p in dist], np.float64)
+            probs /= probs.sum()
+            move = dist[self._rng.choice(len(dist), p=probs)][0]
+            state.do_move(move)
+        w = state.get_winner()
+        return 0.0 if w == 0 else (1.0 if w == player else -1.0)
+
+    # ------------------------------------------------------------ driving
+
+    def get_move(self, state):
+        """Run playouts from ``state`` and return the most-visited
+        move (``None`` = pass when the tree has no children)."""
+        for _ in range(self._n_playout):
+            self._playout(state.copy())
+        if self._root.is_leaf():
+            return PASS_MOVE
+        return max(self._root._children.items(),
+                   key=lambda ac: ac[1]._n_visits)[0]
+
+    def update_with_move(self, last_move) -> None:
+        """Re-root at the played move, keeping the subtree (reference
+        subtree reuse); unknown move → fresh tree."""
+        child = self._root._children.get(last_move)
+        if child is not None:
+            child._parent = None
+            self._root = child
+        else:
+            self.reset()
+
+    def reset(self) -> None:
+        """Discard the tree (e.g. the game position jumped)."""
+        self._root = TreeNode(None, 1.0)
+
+
+class ParallelMCTS(MCTS):
+    """Batched-leaf APV-MCTS — the reference's empty stub, implemented.
+
+    Per wave: select ``leaf_batch`` leaves under virtual loss, then one
+    batched call each to ``batch_policy_fn(states) -> [priors, ...]``,
+    ``batch_value_fn(states) -> [v, ...]`` and (if λ>0)
+    ``batch_rollout_fn(states) -> [outcome, ...]`` — so NN cost per
+    playout drops by ~leaf_batch× versus the sequential form. All
+    callables remain injected (lambda-testable, SURVEY.md §4).
+    """
+
+    def __init__(self, batch_value_fn, batch_policy_fn, batch_rollout_fn,
+                 lmbda: float = 0.5, c_puct: float = 5.0,
+                 rollout_limit: int = 500, playout_depth: int = 20,
+                 n_playout: int = 10000, leaf_batch: int = 8, rng=None):
+        super().__init__(batch_value_fn, batch_policy_fn, batch_rollout_fn,
+                         lmbda=lmbda, c_puct=c_puct,
+                         rollout_limit=rollout_limit,
+                         playout_depth=playout_depth, n_playout=n_playout,
+                         rng=rng)
+        self._leaf_batch = leaf_batch
+
+    def get_move(self, state):
+        waves, rem = divmod(self._n_playout, self._leaf_batch)
+        for _ in range(waves):
+            self._wave(state, self._leaf_batch)
+        if rem:
+            self._wave(state, rem)
+        if self._root.is_leaf():
+            return PASS_MOVE
+        return max(self._root._children.items(),
+                   key=lambda ac: ac[1]._n_visits)[0]
+
+    def _wave(self, state, width: int) -> None:
+        paths, leaf_states = [], []
+        for _ in range(width):
+            st = state.copy()
+            node = self._descend(st)
+            node.add_virtual_loss()
+            paths.append(node)
+            leaf_states.append(st)
+
+        live = [i for i, st in enumerate(leaf_states)
+                if not st.is_end_of_game]
+        priors = [None] * width
+        values = np.zeros(width)
+        if live:
+            live_states = [leaf_states[i] for i in live]
+            for i, pri in zip(live, self._policy(live_states)):
+                priors[i] = pri
+            if self._lmbda < 1.0:
+                vals = np.asarray(self._value(live_states), np.float64)
+                values[live] += (1.0 - self._lmbda) * vals
+            if self._lmbda > 0.0:
+                outs = np.asarray(
+                    self._rollout([s.copy() for s in live_states]),
+                    np.float64)
+                values[live] += self._lmbda * outs
+        for i, st in enumerate(leaf_states):
+            if st.is_end_of_game:
+                w = st.get_winner()
+                values[i] = 0.0 if w == 0 else (
+                    1.0 if w == st.current_player else -1.0)
+
+        for i, node in enumerate(paths):
+            node.revert_virtual_loss()
+            if priors[i]:
+                node.expand(priors[i])
+            node.update_recursive(-values[i])
+
+
+# --------------------------------------------------------------- wiring
+
+
+def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
+                 rng=None):
+    """Batch callables for :class:`ParallelMCTS` from the framework
+    nets: one jitted forward per net per wave.
+
+    ``rollout`` (a fast policy net — or the SL policy itself, as the
+    reference does when no rollout net is trained) drives lockstep
+    batched playouts-to-terminal on host rules.
+    """
+    rng = rng or np.random.default_rng(0)
+
+    def batch_policy(states):
+        sensible = [s.get_legal_moves(include_eyes=False) for s in states]
+        return policy.batch_eval_state(states, sensible)
+
+    def batch_value(states):
+        return value.batch_eval_state(states)
+
+    rollout_net = rollout or policy
+
+    def batch_rollout(states):
+        entry_players = [s.current_player for s in states]
+        for _ in range(rollout_limit):
+            live = [s for s in states if not s.is_end_of_game]
+            if not live:
+                break
+            dists = rollout_net.batch_eval_state(
+                live, [s.get_legal_moves(include_eyes=False) for s in live])
+            for st, dist in zip(live, dists):
+                if not dist:
+                    st.do_move(PASS_MOVE)
+                    continue
+                probs = np.asarray([p for _, p in dist], np.float64)
+                probs /= probs.sum()
+                st.do_move(dist[rng.choice(len(dist), p=probs)][0])
+        outs = []
+        for st, player in zip(states, entry_players):
+            w = st.get_winner()
+            outs.append(0.0 if w == 0 else (1.0 if w == player else -1.0))
+        return outs
+
+    return batch_value, batch_policy, batch_rollout
+
+
+class MCTSPlayer:
+    """Full-strength agent: APV-MCTS over the policy/value/rollout nets
+    (reference ``ai.MCTSPlayer``), batched-leaf by default.
+
+    Subtree reuse is history-aware: the player records the move history
+    its root corresponds to, re-roots along the opponent's intervening
+    move when the incoming state extends it by exactly one ply, and
+    otherwise resets the tree — so a stale tree can never desync from
+    the position being searched.
+    """
+
+    def __init__(self, value, policy, rollout=None, lmbda: float = 0.5,
+                 c_puct: float = 5.0, rollout_limit: int = 500,
+                 playout_depth: int = 20, n_playout: int = 100,
+                 leaf_batch: int = 8, seed: int | None = None):
+        rng = np.random.default_rng(seed)
+        bv, bp, br = net_backends(policy, value, rollout,
+                                  rollout_limit=rollout_limit, rng=rng)
+        self.mcts = ParallelMCTS(bv, bp, br, lmbda=lmbda, c_puct=c_puct,
+                                 rollout_limit=rollout_limit,
+                                 playout_depth=playout_depth,
+                                 n_playout=n_playout,
+                                 leaf_batch=leaf_batch, rng=rng)
+        self._tree_history: list | None = None
+
+    def _sync_tree(self, history: list) -> None:
+        if self._tree_history is None or history == self._tree_history:
+            return
+        n = len(self._tree_history)
+        if len(history) == n + 1 and history[:n] == self._tree_history:
+            self.mcts.update_with_move(history[-1])
+        else:
+            self.mcts.reset()
+
+    def get_move(self, state):
+        history = list(state.history)
+        self._sync_tree(history)
+        sensible = state.get_legal_moves(include_eyes=False)
+        if state.is_end_of_game or not sensible:
+            self._tree_history = None
+            self.mcts.reset()
+            return PASS_MOVE
+        move = self.mcts.get_move(state)
+        self.mcts.update_with_move(move)
+        self._tree_history = history + [move]
+        return move

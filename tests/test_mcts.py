@@ -1,0 +1,232 @@
+"""MCTS tree mechanics with injected fake backends.
+
+The reference tests ``mcts.py`` entirely with plain Python lambdas as
+the policy/value/rollout functions (SURVEY.md §4 "MCTS tests") — no NN
+involved. Same here, for both the sequential ``MCTS`` and the batched
+``ParallelMCTS``, plus an end-to-end ``MCTSPlayer`` smoke test over
+tiny real nets.
+"""
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.models import CNNPolicy, CNNValue
+from rocalphago_tpu.search.mcts import (
+    MCTS,
+    MCTSPlayer,
+    ParallelMCTS,
+    TreeNode,
+    net_backends,
+)
+
+SIZE = 5
+
+
+def uniform_priors(state):
+    moves = state.get_legal_moves(include_eyes=False)
+    return [(m, 1.0 / len(moves)) for m in moves] if moves else []
+
+
+def constant_value(_state):
+    return 0.2
+
+
+def batch(fn):
+    return lambda states: [fn(s) for s in states]
+
+
+# ------------------------------------------------------------- TreeNode
+
+
+class TestTreeNode:
+    def test_expand_and_select(self):
+        root = TreeNode(None, 1.0)
+        root.expand([((0, 0), 0.7), ((1, 1), 0.3)])
+        assert set(root._children) == {(0, 0), (1, 1)}
+        move, child = root.select(c_puct=5.0)
+        assert move == (0, 0)  # higher prior wins before any visits
+        assert child._P == pytest.approx(0.7)
+
+    def test_update_running_mean(self):
+        node = TreeNode(None, 1.0)
+        node.update(1.0)
+        node.update(0.0)
+        assert node._n_visits == 2
+        assert node._Q == pytest.approx(0.5)
+
+    def test_update_recursive_alternates_sign(self):
+        root = TreeNode(None, 1.0)
+        root.expand([((0, 0), 1.0)])
+        child = root._children[(0, 0)]
+        child.expand([((1, 1), 1.0)])
+        leaf = child._children[(1, 1)]
+        leaf.update_recursive(1.0)
+        assert leaf._Q == pytest.approx(1.0)
+        assert child._Q == pytest.approx(-1.0)
+        assert root._Q == pytest.approx(1.0)
+
+    def test_visits_shift_selection(self):
+        root = TreeNode(None, 1.0)
+        root.expand([((0, 0), 0.6), ((1, 1), 0.4)])
+        a = root._children[(0, 0)]
+        # punish the favourite; exploration term must eventually pick b
+        for _ in range(50):
+            root._n_visits += 1
+            a.update(-1.0)
+        move, _ = root.select(c_puct=5.0)
+        assert move == (1, 1)
+
+    def test_virtual_loss_revert_restores_stats(self):
+        node = TreeNode(None, 0.5)
+        node.update(0.8)
+        q, n = node._Q, node._n_visits
+        node.add_virtual_loss()
+        assert node._n_visits == n + 1 and node._Q < q
+        node.revert_virtual_loss()
+        assert node._n_visits == n
+        assert node._Q == pytest.approx(q)
+
+
+# ----------------------------------------------------------------- MCTS
+
+
+class TestMCTS:
+    def make(self, lmbda=0.0, n_playout=40, cls=MCTS, **kw):
+        if cls is ParallelMCTS:
+            return ParallelMCTS(batch(constant_value),
+                                batch(uniform_priors),
+                                lambda states: [0.0] * len(states),
+                                lmbda=lmbda, n_playout=n_playout,
+                                playout_depth=4, **kw)
+        return MCTS(constant_value, uniform_priors, uniform_priors,
+                    lmbda=lmbda, n_playout=n_playout, playout_depth=4,
+                    **kw)
+
+    def test_returns_legal_move_and_counts_visits(self):
+        mcts = self.make()
+        state = pygo.GameState(size=SIZE)
+        move = mcts.get_move(state)
+        assert state.is_legal(move)
+        # first playout expands the root itself; the other 39 descend
+        assert sum(c._n_visits for c in mcts._root._children.values()) \
+            == 39
+        assert mcts._root._n_visits == 40
+
+    def test_update_with_move_reuses_subtree(self):
+        mcts = self.make()
+        state = pygo.GameState(size=SIZE)
+        move = mcts.get_move(state)
+        subtree = mcts._root._children[move]
+        mcts.update_with_move(move)
+        assert mcts._root is subtree
+        assert mcts._root._parent is None
+        mcts.update_with_move((4, 4))  # unseen move → fresh root
+        assert mcts._root.is_leaf()
+
+    def test_rollout_mix_prefers_winning_line(self):
+        # deterministic rollout that always ends the game by passing:
+        # leaf values then come purely from area scoring
+        def pass_rollout(state):
+            return []
+        mcts = MCTS(constant_value, uniform_priors, pass_rollout,
+                    lmbda=1.0, n_playout=30, playout_depth=2,
+                    rollout_limit=4)
+        state = pygo.GameState(size=SIZE, komi=0.5)
+        move = mcts.get_move(state)
+        assert state.is_legal(move)
+
+    def test_terminal_leaf_uses_game_winner(self):
+        state = pygo.GameState(size=SIZE, komi=0.5)
+        state.do_move((2, 2))
+        state.do_move(pygo.PASS_MOVE, pygo.WHITE)
+        state.do_move(pygo.PASS_MOVE, pygo.BLACK)
+        assert state.is_end_of_game
+        mcts = self.make(n_playout=5)
+        mcts._playout(state.copy())
+        # Black won the finished game; root edge belongs to the mover
+        # into this position, so Q reflects a decided game, not 0.2
+        assert abs(mcts._root._Q) == pytest.approx(1.0)
+
+
+# --------------------------------------------------------- ParallelMCTS
+
+
+class TestParallelMCTS:
+    def test_matches_sequential_contract(self):
+        mcts = TestMCTS().make(cls=ParallelMCTS, leaf_batch=8)
+        state = pygo.GameState(size=SIZE)
+        move = mcts.get_move(state)
+        assert state.is_legal(move)
+        assert mcts._root._n_visits == 40
+        # all virtual losses reverted
+        def no_vloss(node):
+            assert node._vloss == 0
+            for c in node._children.values():
+                no_vloss(c)
+        no_vloss(mcts._root)
+
+    def test_batches_leaf_evaluations(self):
+        calls = []
+
+        def batch_policy(states):
+            calls.append(len(states))
+            return [uniform_priors(s) for s in states]
+
+        mcts = ParallelMCTS(batch(constant_value), batch_policy,
+                            lambda states: [0.0] * len(states),
+                            lmbda=0.0, n_playout=24, leaf_batch=8,
+                            playout_depth=4)
+        mcts.get_move(pygo.GameState(size=SIZE))
+        assert len(calls) == 3          # 24 playouts / 8 per wave
+        assert max(calls) > 1           # genuinely batched
+
+    def test_remainder_wave(self):
+        mcts = TestMCTS().make(cls=ParallelMCTS, n_playout=13,
+                               leaf_batch=5)
+        mcts.get_move(pygo.GameState(size=SIZE))
+        assert mcts._root._n_visits == 13
+
+
+# ------------------------------------------------------------ MCTSPlayer
+
+
+def test_mcts_player_end_to_end():
+    policy = CNNPolicy(("board", "ones"), board=SIZE, layers=2,
+                       filters_per_layer=4)
+    value = CNNValue(("board", "ones"), board=SIZE, layers=2,
+                     filters_per_layer=4, dense_units=8)
+    player = MCTSPlayer(value, policy, lmbda=0.5, n_playout=8,
+                        leaf_batch=4, rollout_limit=6, playout_depth=3,
+                        seed=0)
+    state = pygo.GameState(size=SIZE)
+    move = player.get_move(state)
+    assert state.is_legal(move)
+    state.do_move(move)
+    move2 = player.get_move(state)
+    assert state.is_legal(move2)
+
+
+def test_mcts_player_alternating_game_stays_synced():
+    """Regression: opponent moves between get_move calls must re-root
+    or reset the reused subtree, never desync it (a desynced tree
+    replays occupied points → IllegalMove)."""
+    policy = CNNPolicy(("board", "ones"), board=SIZE, layers=2,
+                       filters_per_layer=4)
+    value = CNNValue(("board", "ones"), board=SIZE, layers=2,
+                     filters_per_layer=4, dense_units=8)
+    player = MCTSPlayer(value, policy, lmbda=0.0, n_playout=12,
+                        leaf_batch=4, playout_depth=4, seed=0)
+    opponent = np.random.default_rng(1)
+    state = pygo.GameState(size=SIZE)
+    for _ in range(5):
+        move = player.get_move(state)
+        assert move is None or state.is_legal(move)
+        state.do_move(move)
+        if state.is_end_of_game:
+            break
+        moves = state.get_legal_moves(include_eyes=False)
+        state.do_move(moves[opponent.integers(len(moves))]
+                      if moves else pygo.PASS_MOVE)
+        if state.is_end_of_game:
+            break
