@@ -87,12 +87,15 @@ class CNNPolicy(NeuralNetBase):
         for i, state in enumerate(states):
             size = state.size if isinstance(state, pygo.GameState) \
                 else self.board
-            legal = self._legal_for(state)
             if moves_lists is not None and moves_lists[i] is not None:
-                allowed = np.zeros_like(legal)
+                # callers pass a subset of legal moves; building the
+                # mask from it directly skips the per-point legality
+                # scan (the expensive host computation)
+                legal = np.zeros((size * size,), bool)
                 for (x, y) in moves_lists[i]:
-                    allowed[x * size + y] = True
-                legal = legal & allowed
+                    legal[x * size + y] = True
+            else:
+                legal = self._legal_for(state)
             sizes.append(size)
             legal_rows.append(legal)
         legal_b = np.stack(legal_rows)

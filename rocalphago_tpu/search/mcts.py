@@ -141,7 +141,9 @@ class MCTS:
 
     def _playout(self, state) -> None:
         node = self._descend(state)
-        if not state.is_end_of_game:
+        # an internal node hit at the depth cap is already expanded —
+        # don't spend a policy forward on it
+        if not state.is_end_of_game and node.is_leaf():
             priors = self._policy(state)
             if priors:
                 node.expand(priors)
@@ -243,22 +245,32 @@ class ParallelMCTS(MCTS):
                    key=lambda ac: ac[1]._n_visits)[0]
 
     def _wave(self, state, width: int) -> None:
-        paths, leaf_states = [], []
+        # descend under virtual loss; duplicate arrivals at the same
+        # node (forced when the tree is tiny) share one evaluation
+        paths = []
+        uniq_idx: dict = {}          # id(node) -> index below
+        nodes, leaf_states = [], []
         for _ in range(width):
             st = state.copy()
             node = self._descend(st)
             node.add_virtual_loss()
             paths.append(node)
-            leaf_states.append(st)
+            if id(node) not in uniq_idx:
+                uniq_idx[id(node)] = len(nodes)
+                nodes.append(node)
+                leaf_states.append(st)
 
         live = [i for i, st in enumerate(leaf_states)
                 if not st.is_end_of_game]
-        priors = [None] * width
-        values = np.zeros(width)
+        need_priors = [i for i in live if nodes[i].is_leaf()]
+        priors = [None] * len(nodes)
+        values = np.zeros(len(nodes))
+        if need_priors:
+            dists = self._policy([leaf_states[i] for i in need_priors])
+            for i, pri in zip(need_priors, dists):
+                priors[i] = pri
         if live:
             live_states = [leaf_states[i] for i in live]
-            for i, pri in zip(live, self._policy(live_states)):
-                priors[i] = pri
             if self._lmbda < 1.0:
                 vals = np.asarray(self._value(live_states), np.float64)
                 values[live] += (1.0 - self._lmbda) * vals
@@ -273,8 +285,10 @@ class ParallelMCTS(MCTS):
                 values[i] = 0.0 if w == 0 else (
                     1.0 if w == st.current_player else -1.0)
 
-        for i, node in enumerate(paths):
+        for node in paths:
             node.revert_virtual_loss()
+        for node in paths:
+            i = uniq_idx[id(node)]
             if priors[i]:
                 node.expand(priors[i])
             node.update_recursive(-values[i])
@@ -306,12 +320,18 @@ def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
     def batch_rollout(states):
         entry_players = [s.current_player for s in states]
         for _ in range(rollout_limit):
-            live = [s for s in states if not s.is_end_of_game]
-            if not live:
+            if all(s.is_end_of_game for s in states):
                 break
-            dists = rollout_net.batch_eval_state(
-                live, [s.get_legal_moves(include_eyes=False) for s in live])
-            for st, dist in zip(live, dists):
+            # evaluate the whole fixed-size batch every ply (finished
+            # games get an empty support and are skipped): one
+            # compiled shape, not one per distinct live count
+            sens = [[] if s.is_end_of_game
+                    else s.get_legal_moves(include_eyes=False)
+                    for s in states]
+            dists = rollout_net.batch_eval_state(states, sens)
+            for st, dist in zip(states, dists):
+                if st.is_end_of_game:
+                    continue
                 if not dist:
                     st.do_move(PASS_MOVE)
                     continue

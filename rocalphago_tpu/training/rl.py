@@ -1,0 +1,293 @@
+"""REINFORCE policy training over on-device self-play.
+
+Parity: ``AlphaGo/training/reinforcement_policy_trainer.py::run_training``
+(lockstep game batches learner-vs-sampled-past-self, per-game gradient
+of the log-likelihood of played moves scaled by the ±1 outcome, an
+opponent pool of past checkpoints sampled uniformly, ``--game-batch 20
+--policy-temp --move-limit 500 --save-every``, ``metadata.json`` resume;
+SURVEY.md §2 "RL policy trainer", §3.2).
+
+TPU-native design — the reference's two host hot loops (Python
+``do_move`` and per-state featurization, SURVEY.md §3.2) are gone:
+
+* games are played by :func:`rocalphago_tpu.search.selfplay.play_games`
+  — the whole encode → forward → sample → rules-step loop is one
+  ``lax.scan`` on device;
+* the REINFORCE gradient needs the states the learner saw, which the
+  game scan does not materialize (storing ``[T, B, 19, 19, 48]`` planes
+  would blow HBM). Instead the iteration *replays* the recorded actions
+  through the engine in a second scan, accumulating a per-ply policy
+  gradient into a params-shaped carry — constant memory in game length,
+  and only the learner's half-batch is re-forwarded per ply;
+* no custom sign-flipped SGD (the reference's Keras hack): the ±z
+  weight is just a per-sample coefficient on the log-likelihood loss,
+  and plain ``optax.sgd`` applies the one accumulated update;
+* the games batch axis carries a ``data``-mesh sharding constraint, so
+  on a multi-chip mesh XLA shards the whole game scan and all-reduces
+  the gradient over ICI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import glob
+import os
+import sys
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from rocalphago_tpu.engine import jaxgo
+from rocalphago_tpu.io.checkpoint import (
+    MetadataWriter,
+    TrainCheckpointer,
+    pack_rng,
+    unpack_rng,
+)
+from rocalphago_tpu.io.metrics import MetricsLogger
+from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.search.selfplay import play_games, sensible_mask
+from rocalphago_tpu.features.planes import encode
+
+
+@dataclasses.dataclass
+class RLConfig:
+    """Flat, JSON-serializable stage config (SURVEY.md §5 "Config")."""
+
+    model_json: str = ""
+    out_dir: str = ""
+    learning_rate: float = 0.001
+    game_batch: int = 20          # reference default; TPU runs use 128+
+    iterations: int = 100
+    save_every: int = 10
+    policy_temp: float = 0.67
+    move_limit: int = 500
+    seed: int = 0
+    num_devices: int | None = None
+
+
+class RLState(NamedTuple):
+    params: dict
+    opt_state: tuple
+    iteration: jax.Array  # int32 []
+    rng: jax.Array        # uint32 key data
+
+
+def make_rl_iteration(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
+                      tx, batch: int, move_limit: int,
+                      temperature: float, mesh=None):
+    """Pure ``(RLState, opp_params) -> (RLState, metrics)`` — one full
+    REINFORCE iteration: play a game batch, accumulate the z-weighted
+    policy gradient by replay, apply one SGD update."""
+    if batch % 2:
+        raise ValueError(f"game_batch must be even, got {batch}")
+    n = cfg.num_points
+    half = batch // 2
+    enc = jax.vmap(functools.partial(encode, cfg, features=features))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
+
+    def iteration(state: RLState, opp_params):
+        key = unpack_rng(state.rng)
+        key, game_key = jax.random.split(key)
+        params = state.params
+
+        result = play_games(cfg, features, apply_fn, params, apply_fn,
+                            opp_params, game_key, batch, move_limit,
+                            temperature)
+        winners = result.winners.astype(jnp.float32)
+        # learner (net A) is Black in games [0:half], White in the rest
+        z = jnp.concatenate([winners[:half], -winners[half:]])
+
+        def ply(carry, xs):
+            states, grads = carry
+            t, actions_t, live_t = xs
+            # the learner moves games [0:half] on even plies and games
+            # [half:batch] on odd plies (selfplay color split)
+            start = jnp.where((t % 2) == 0, 0, half)
+            take = lambda a: lax.dynamic_slice_in_dim(a, start, half)  # noqa: E731
+            planes = enc(jax.tree.map(take, states))
+            sens = take(vsens(states))
+            acts = take(actions_t)
+            w = (take(z) * take(live_t)
+                 * (acts < n).astype(jnp.float32))
+
+            def loss_fn(p):
+                logits = apply_fn(p, planes)
+                neg = jnp.finfo(logits.dtype).min
+                masked = jnp.where(sens, logits / temperature, neg)
+                logp = jax.nn.log_softmax(masked, axis=-1)
+                lp = jnp.take_along_axis(
+                    logp, jnp.minimum(acts, n - 1)[:, None], axis=1)[:, 0]
+                return -(w * lp).sum() / batch
+
+            grads = jax.tree.map(jnp.add, grads, jax.grad(loss_fn)(params))
+            return (vstep(states, actions_t), grads), None
+
+        states0 = jaxgo.new_states(cfg, batch)
+        if mesh is not None:
+            states0 = lax.with_sharding_constraint(
+                states0, meshlib.data_sharding(mesh))
+        zero = jax.tree.map(jnp.zeros_like, params)
+        (_, grads), _ = lax.scan(
+            ply, (states0, zero),
+            (jnp.arange(result.actions.shape[0]), result.actions,
+             result.live.astype(jnp.float32)))
+
+        updates, opt_state = tx.update(grads, state.opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "win_rate": (z > 0).mean(),
+            "mean_moves": result.num_moves.astype(jnp.float32).mean(),
+        }
+        new = RLState(params, opt_state, state.iteration + 1,
+                      pack_rng(key))
+        return new, metrics
+
+    return iteration
+
+
+class OpponentPool:
+    """Directory of past learner snapshots, sampled uniformly each
+    iteration (reference opponent-pool semantics)."""
+
+    def __init__(self, directory: str, net: NeuralNetBase):
+        self.directory = directory
+        self.net = net
+        os.makedirs(directory, exist_ok=True)
+        if not self.snapshots():
+            self.add(net.params, 0)
+
+    def snapshots(self) -> list:
+        return sorted(glob.glob(
+            os.path.join(self.directory, "opponent.*.flax.msgpack")))
+
+    def add(self, params, iteration: int) -> None:
+        self.net.params = jax.device_get(params)
+        self.net.save_weights(os.path.join(
+            self.directory, f"opponent.{iteration:05d}.flax.msgpack"))
+
+    def sample(self, seed, iteration: int):
+        """Uniform draw over the current pool, seeded by (seed,
+        iteration) — stateless, so an interrupted-and-resumed run makes
+        the same choices as an uninterrupted one with no RNG replay."""
+        paths = self.snapshots()
+        rng = np.random.default_rng(
+            np.random.SeedSequence([seed, iteration]))
+        path = paths[rng.integers(len(paths))]
+        template = self.net.params
+        self.net.load_weights(path)
+        params, self.net.params = self.net.params, template
+        return params, os.path.basename(path)
+
+
+class RLTrainer:
+    """Wires learner + opponent pool + mesh into the iteration loop."""
+
+    def __init__(self, cfg: RLConfig, net: NeuralNetBase | None = None):
+        self.cfg = cfg
+        self.net = net or NeuralNetBase.load_model(cfg.model_json)
+        self.mesh = meshlib.make_mesh(cfg.num_devices)
+        os.makedirs(cfg.out_dir, exist_ok=True)
+
+        tx = optax.sgd(cfg.learning_rate)
+        rep = meshlib.replicated(self.mesh)
+        iteration = make_rl_iteration(
+            self.net.cfg, self.net.feature_list, self.net.module.apply,
+            tx, cfg.game_batch, cfg.move_limit, cfg.policy_temp,
+            mesh=self.mesh)
+        self._iteration = jax.jit(iteration, donate_argnums=(0,),
+                                  out_shardings=(rep, rep))
+
+        self.state = meshlib.replicate(self.mesh, RLState(
+            params=self.net.params,
+            opt_state=tx.init(self.net.params),
+            iteration=jnp.int32(0),
+            rng=pack_rng(jax.random.key(cfg.seed))))
+        self.pool = OpponentPool(
+            os.path.join(cfg.out_dir, "opponents"), self.net)
+        self.ckpt = TrainCheckpointer(
+            os.path.join(cfg.out_dir, "checkpoints"))
+        self.metrics = MetricsLogger(
+            os.path.join(cfg.out_dir, "metrics.jsonl"))
+        self.start_iteration = 0
+        self._maybe_resume()
+
+    def _maybe_resume(self):
+        restored, _ = self.ckpt.restore(jax.device_get(self.state))
+        if restored is None:
+            return
+        self.state = meshlib.replicate(self.mesh, RLState(*restored))
+        self.start_iteration = int(restored.iteration)
+        self.metrics.log("resume", iteration=self.start_iteration)
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        meta = MetadataWriter(
+            os.path.join(cfg.out_dir, "metadata.json"),
+            header={"cmd": " ".join(sys.argv),
+                    "config": dataclasses.asdict(cfg)})
+        final = {}
+        for it in range(self.start_iteration, cfg.iterations):
+            opp_params, opp_name = self.pool.sample(cfg.seed, it)
+            opp_params = meshlib.replicate(self.mesh, opp_params)
+            t0 = time.time()
+            self.state, m = self._iteration(self.state, opp_params)
+            win = float(m["win_rate"])
+            entry = {
+                "iteration": it, "opponent": opp_name,
+                "win_rate": win,
+                "mean_moves": float(m["mean_moves"]),
+                "games_per_min": cfg.game_batch * 60.0
+                / max(time.time() - t0, 1e-9),
+            }
+            self.metrics.log("iteration", **entry)
+            meta.record_epoch(entry)
+            final = entry
+            if (it + 1) % cfg.save_every == 0 or it + 1 == cfg.iterations:
+                self.pool.add(self.state.params, it + 1)
+                self.ckpt.save(it + 1, jax.device_get(self.state))
+                self._export_weights(it + 1)
+        self.ckpt.wait()
+        return final
+
+    def _export_weights(self, iteration: int) -> None:
+        self.net.params = jax.device_get(self.state.params)
+        self.net.save_weights(os.path.join(
+            self.cfg.out_dir, f"weights.{iteration:05d}.flax.msgpack"))
+
+
+def run_training(argv=None) -> dict:
+    """CLI parity with the reference RL trainer."""
+    ap = argparse.ArgumentParser(
+        description="REINFORCE policy training via self-play")
+    ap.add_argument("model_json")
+    ap.add_argument("out_dir")
+    ap.add_argument("--learning-rate", type=float, default=0.001)
+    ap.add_argument("--game-batch", type=int, default=20)
+    ap.add_argument("--iterations", type=int, default=100)
+    ap.add_argument("--save-every", type=int, default=10)
+    ap.add_argument("--policy-temp", type=float, default=0.67)
+    ap.add_argument("--move-limit", type=int, default=500)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--num-devices", type=int, default=None)
+    a = ap.parse_args(argv)
+    cfg = RLConfig(
+        model_json=a.model_json, out_dir=a.out_dir,
+        learning_rate=a.learning_rate, game_batch=a.game_batch,
+        iterations=a.iterations, save_every=a.save_every,
+        policy_temp=a.policy_temp, move_limit=a.move_limit,
+        seed=a.seed, num_devices=a.num_devices)
+    return RLTrainer(cfg).run()
+
+
+if __name__ == "__main__":
+    run_training(sys.argv[1:])

@@ -1,0 +1,165 @@
+"""REINFORCE trainer: gradient correctness, loop behavior, resume.
+
+The reference's RL trainer test runs a handful of lockstep games on a
+tiny model and asserts completion + written weights (SURVEY.md §4
+"Trainer smoke tests"). Here additionally the replay-accumulated
+policy gradient is checked against a direct ``jax.grad`` of the whole
+replayed log-likelihood — the rebuild's scan-with-per-ply-grads must
+be exactly the REINFORCE gradient, not an approximation of it.
+"""
+
+import dataclasses
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rocalphago_tpu.engine import jaxgo
+from rocalphago_tpu.features.planes import encode
+from rocalphago_tpu.models import CNNPolicy
+from rocalphago_tpu.search.selfplay import play_games, sensible_mask
+from rocalphago_tpu.training.rl import (
+    RLConfig,
+    RLState,
+    RLTrainer,
+    make_rl_iteration,
+)
+from rocalphago_tpu.io.checkpoint import pack_rng
+
+SIZE = 5
+FEATURES = ("board", "ones")
+BATCH = 4
+MOVES = 10
+TEMP = 0.67
+
+
+@pytest.fixture(scope="module")
+def net():
+    return CNNPolicy(FEATURES, board=SIZE, layers=2, filters_per_layer=4)
+
+
+def test_replay_gradient_matches_direct_grad(net):
+    """(params_old - params_new)/lr from the iteration must equal
+    jax.grad of the directly-written REINFORCE objective. Run in
+    float32 (bf16 kernels fuse differently between the scan and the
+    unrolled reference, adding ~1% noise that would mask real bugs)."""
+    from rocalphago_tpu.models.policy import PolicyNet
+
+    cfg = jaxgo.GoConfig(size=SIZE)
+    module = PolicyNet(board=SIZE,
+                       input_planes=net.preprocess.output_dim,
+                       layers=2, filters_per_layer=4,
+                       dtype=jnp.float32)
+    params = module.init(
+        jax.random.key(0),
+        jnp.zeros((1, SIZE, SIZE, net.preprocess.output_dim)))
+    lr = 0.1
+    tx = optax.sgd(lr)
+    iteration = make_rl_iteration(cfg, FEATURES, module.apply, tx,
+                                  BATCH, MOVES, TEMP)
+    key = jax.random.key(3)
+    state0 = RLState(params, tx.init(params), jnp.int32(0),
+                     pack_rng(key))
+    new_state, metrics = jax.jit(iteration)(state0, params)
+
+    # reproduce the games the iteration played (same key split)
+    game_key = jax.random.split(key)[1]
+    result = play_games(cfg, FEATURES, module.apply, params,
+                        module.apply, params, game_key, BATCH,
+                        MOVES, TEMP)
+    actions = np.asarray(result.actions)
+    live = np.asarray(result.live)
+    winners = np.asarray(result.winners).astype(np.float32)
+    half = BATCH // 2
+    z = np.concatenate([winners[:half], -winners[half:]])
+    n = cfg.num_points
+
+    enc = jax.vmap(functools.partial(encode, cfg, features=FEATURES))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
+
+    # precompute the replayed states/masks outside the loss (they do
+    # not depend on params)
+    states = jaxgo.new_states(cfg, BATCH)
+    planes_seq, sens_seq = [], []
+    for t in range(MOVES):
+        planes_seq.append(enc(states))
+        sens_seq.append(np.asarray(vsens(states)))
+        states = vstep(states, jnp.asarray(actions[t]))
+
+    def direct_loss(p):
+        total = 0.0
+        for t in range(MOVES):
+            start = 0 if t % 2 == 0 else half
+            sel = slice(start, start + half)
+            w = (z[sel] * live[t, sel]
+                 * (actions[t, sel] < n).astype(np.float32))
+            logits = module.apply(p, planes_seq[t][sel])
+            neg = jnp.finfo(logits.dtype).min
+            masked = jnp.where(jnp.asarray(sens_seq[t][sel]),
+                               logits / TEMP, neg)
+            logp = jax.nn.log_softmax(masked, axis=-1)
+            a = jnp.minimum(jnp.asarray(actions[t, sel]), n - 1)
+            lp = jnp.take_along_axis(logp, a[:, None], axis=1)[:, 0]
+            total = total - (jnp.asarray(w) * lp).sum() / BATCH
+        return total
+
+    grads_ref = jax.grad(direct_loss)(params)
+    grads_got = jax.tree.map(lambda a, b: (a - b) / lr,
+                             params, new_state.params)
+    flat_ref, _ = jax.flatten_util.ravel_pytree(grads_ref)
+    flat_got, _ = jax.flatten_util.ravel_pytree(grads_got)
+    np.testing.assert_allclose(np.asarray(flat_got),
+                               np.asarray(flat_ref),
+                               rtol=1e-3, atol=1e-5)
+    assert 0.0 <= float(metrics["win_rate"]) <= 1.0
+
+
+def make_trainer(tmp_path, net, iterations=2, save_every=1):
+    cfg = RLConfig(out_dir=str(tmp_path / "rl"), learning_rate=0.01,
+                   game_batch=BATCH, iterations=iterations,
+                   save_every=save_every, policy_temp=TEMP,
+                   move_limit=MOVES, seed=0, num_devices=2)
+    fresh = CNNPolicy(FEATURES, board=SIZE, layers=2,
+                      filters_per_layer=4)
+    fresh.params = jax.device_get(net.params)
+    return RLTrainer(cfg, net=fresh)
+
+
+def test_rl_trainer_runs_and_saves(tmp_path, net):
+    trainer = make_trainer(tmp_path, net)
+    before = jax.device_get(trainer.state.params)
+    final = trainer.run()
+    after = jax.device_get(trainer.state.params)
+    assert final["iteration"] == 1
+    assert 0.0 <= final["win_rate"] <= 1.0
+    diff = jax.tree.map(lambda a, b: float(np.abs(a - b).max()),
+                        before, after)
+    assert max(jax.tree.leaves(diff)) > 0  # params actually moved
+
+    out = trainer.cfg.out_dir
+    with open(os.path.join(out, "metadata.json")) as f:
+        meta = json.load(f)
+    assert len(meta["epochs"]) == 2
+    # initial snapshot + one per save_every=1 iteration
+    assert len(trainer.pool.snapshots()) == 3
+    assert os.path.exists(os.path.join(out, "weights.00002.flax.msgpack"))
+
+
+def test_rl_trainer_resumes(tmp_path, net):
+    trainer = make_trainer(tmp_path, net, iterations=2)
+    trainer.run()
+    trainer.ckpt.close()
+    # a fresh trainer over the same out_dir must resume, not restart
+    resumed = make_trainer(tmp_path, net, iterations=3)
+    assert resumed.start_iteration == 2
+    final = resumed.run()
+    assert final["iteration"] == 2
+    with open(os.path.join(resumed.cfg.out_dir, "metadata.json")) as f:
+        meta = json.load(f)
+    assert [e["iteration"] for e in meta["epochs"]] == [0, 1, 2]
