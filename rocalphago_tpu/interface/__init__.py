@@ -1,0 +1,9 @@
+"""Serving interface (reference layer L6): the GTP engine
+(SURVEY.md §1 L6, §3.5)."""
+
+from rocalphago_tpu.interface.gtp import (  # noqa: F401
+    GTPEngine,
+    move_to_vertex,
+    run_gtp,
+    vertex_to_move,
+)

@@ -1,0 +1,319 @@
+"""GTP (Go Text Protocol) engine over stdin/stdout.
+
+Parity: ``interface/gtp_wrapper.py::run_gtp`` (engine wrapping any
+player with a ``get_move(state)`` method, spoken to by GoGui/KGS-style
+controllers; SURVEY.md §1 L6, §3.5). The reference leaned on the
+``gtp`` pip package; the protocol is ~100 lines, so the rebuild ships
+its own host-side implementation (SURVEY.md §2a — not
+performance-relevant) rather than depending on it.
+
+Supported commands: the GTP 2 administrative/core set
+(``protocol_version name version known_command list_commands quit``),
+setup (``boardsize clear_board komi fixed_handicap place_free_handicap
+set_free_handicap``), play (``play genmove undo``), and tournament
+niceties (``showboard final_score time_left time_settings``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from rocalphago_tpu.engine import pygo
+
+COLS = "ABCDEFGHJKLMNOPQRSTUVWXYZ"  # GTP skips I
+
+def fixed_handicap_points(size: int, n: int) -> list:
+    """GTP 2 fixed_handicap layouts on the star points: corners for
+    2–4; center joins only at odd counts (5, 7, 9); 6 adds the left
+    and right mid-sides, 8 all four mid-sides."""
+    if size < 7 or size % 2 == 0:
+        raise ValueError("board has no fixed handicap layout")
+    edge = 2 if size < 13 else 3
+    lo, hi, mid = edge, size - 1 - edge, size // 2
+    corners = [(hi, hi), (lo, lo), (lo, hi), (hi, lo)]
+    sides_lr = [(lo, mid), (hi, mid)]
+    sides_tb = [(mid, lo), (mid, hi)]
+    center = (mid, mid)
+    layouts = {
+        2: corners[:2], 3: corners[:3], 4: corners,
+        5: corners + [center],
+        6: corners + sides_lr,
+        7: corners + sides_lr + [center],
+        8: corners + sides_lr + sides_tb,
+        9: corners + sides_lr + sides_tb + [center],
+    }
+    if n not in layouts:
+        raise ValueError("invalid number of stones")
+    return layouts[n]
+
+
+def move_to_vertex(move, size: int) -> str:
+    """(x, y) board move (or None) → GTP vertex string. ``x`` is the
+    column (A..T skipping I), ``y`` the row (1-based)."""
+    if move is None:
+        return "pass"
+    x, y = move
+    return f"{COLS[int(x)]}{int(y) + 1}"
+
+
+def vertex_to_move(vertex: str, size: int):
+    """GTP vertex → (x, y) or None for pass. Raises ValueError."""
+    v = vertex.strip().upper()
+    if v in ("PASS",):
+        return None
+    if v in ("RESIGN",):
+        raise ValueError("resign is not a board vertex")
+    col, row = v[0], v[1:]
+    x = COLS.index(col)
+    y = int(row) - 1
+    if not (0 <= x < size and 0 <= y < size):
+        raise ValueError(f"vertex {vertex!r} off the {size}x{size} board")
+    return (x, y)
+
+
+def parse_color(s: str) -> int:
+    c = s.strip().lower()
+    if c in ("b", "black"):
+        return pygo.BLACK
+    if c in ("w", "white"):
+        return pygo.WHITE
+    raise ValueError(f"invalid color {s!r}")
+
+
+class GTPEngine:
+    """Stateful GTP command dispatcher around a player object.
+
+    ``player`` needs ``get_move(state)``; if it exposes a ``reset`` or
+    its MCTS exposes ``reset``, a ``clear_board`` clears search state
+    too.
+    """
+
+    def __init__(self, player, name: str = "rocalphago-tpu",
+                 version: str = "0.1"):
+        self.player = player
+        self.name = name
+        self.version = version
+        self.size = 19
+        self.komi = 7.5
+        self.state = pygo.GameState(size=self.size, komi=self.komi)
+        self._undo_stack: list = []
+        self._commands = sorted(
+            m[4:] for m in dir(self) if m.startswith("cmd_"))
+
+    # ------------------------------------------------------------ admin
+
+    def cmd_protocol_version(self, args):
+        return "2"
+
+    def cmd_name(self, args):
+        return self.name
+
+    def cmd_version(self, args):
+        return self.version
+
+    def cmd_known_command(self, args):
+        return "true" if args and args[0] in self._commands else "false"
+
+    def cmd_list_commands(self, args):
+        return "\n".join(self._commands)
+
+    def cmd_quit(self, args):
+        return ""
+
+    # ------------------------------------------------------------ setup
+
+    def _new_game(self):
+        self.state = pygo.GameState(size=self.size, komi=self.komi)
+        self._undo_stack.clear()
+        mcts = getattr(self.player, "mcts", None)
+        if mcts is not None and hasattr(mcts, "reset"):
+            mcts.reset()
+        if hasattr(self.player, "_tree_history"):
+            self.player._tree_history = None
+
+    def cmd_boardsize(self, args):
+        size = int(args[0])
+        if not 2 <= size <= 25:
+            raise ValueError("unacceptable size")
+        self.size = size
+        self._new_game()
+        return ""
+
+    def cmd_clear_board(self, args):
+        self._new_game()
+        return ""
+
+    def cmd_komi(self, args):
+        self.komi = float(args[0])
+        self.state.komi = self.komi
+        return ""
+
+    def cmd_fixed_handicap(self, args):
+        pts = fixed_handicap_points(self.size, int(args[0]))
+        self.state.place_handicaps(pts)
+        return " ".join(move_to_vertex(p, self.size) for p in pts)
+
+    def cmd_place_free_handicap(self, args):
+        return self.cmd_fixed_handicap(args)
+
+    def cmd_set_free_handicap(self, args):
+        pts = [vertex_to_move(v, self.size) for v in args]
+        if None in pts:
+            raise ValueError("pass is not a handicap vertex")
+        self.state.place_handicaps(pts)
+        return ""
+
+    # ------------------------------------------------------------- play
+
+    def _apply_move(self, move, color) -> None:
+        """Snapshot + play; a rejected move leaves the undo stack
+        untouched (do_move raises before mutating on illegal input,
+        including moves after the game has ended)."""
+        snapshot = self.state.copy()
+        self.state.do_move(move, color)
+        self._undo_stack.append(snapshot)
+
+    def cmd_play(self, args):
+        color = parse_color(args[0])
+        move = vertex_to_move(args[1], self.size)
+        self.state.current_player = color
+        if move is not None and not self.state.is_legal(move):
+            raise ValueError("illegal move")
+        self._apply_move(move, color)
+        return ""
+
+    def cmd_genmove(self, args):
+        color = parse_color(args[0])
+        self.state.current_player = color
+        move = self.player.get_move(self.state)
+        if move is not None and not self.state.is_legal(move):
+            move = None
+        self._apply_move(move, color)
+        return move_to_vertex(move, self.size)
+
+    def cmd_undo(self, args):
+        if not self._undo_stack:
+            raise ValueError("cannot undo")
+        self.state = self._undo_stack.pop()
+        return ""
+
+    # ------------------------------------------------------ observation
+
+    def cmd_showboard(self, args):
+        s = self.state
+        rows = []
+        for y in reversed(range(s.size)):
+            cells = []
+            for x in range(s.size):
+                v = s.board[x, y]
+                cells.append("X" if v == pygo.BLACK
+                             else "O" if v == pygo.WHITE else ".")
+            rows.append(f"{y + 1:2d} " + " ".join(cells))
+        rows.append("   " + " ".join(COLS[:s.size]))
+        return "\n" + "\n".join(rows)
+
+    def cmd_final_score(self, args):
+        black, white = self.state.get_scores()
+        if black > white:
+            return f"B+{black - white:g}"
+        if white > black:
+            return f"W+{white - black:g}"
+        return "0"
+
+    # ------------------------------------------------------------- time
+
+    def cmd_time_settings(self, args):
+        return ""
+
+    def cmd_time_left(self, args):
+        return ""
+
+    # --------------------------------------------------------- dispatch
+
+    def handle(self, line: str):
+        """One GTP line → (reply string or None to terminate)."""
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            return None, False
+        parts = line.split()
+        cmd_id = ""
+        if parts[0].isdigit():
+            cmd_id = parts[0]
+            parts = parts[1:]
+        if not parts:
+            return None, False
+        cmd, args = parts[0], parts[1:]
+        fn = getattr(self, f"cmd_{cmd}", None)
+        if fn is None:
+            return f"?{cmd_id} unknown command\n\n", False
+        try:
+            result = fn(args)
+        except Exception as e:  # noqa: BLE001 — GTP reports all errors
+            return f"?{cmd_id} {e}\n\n", False
+        sep = " " if result else ""
+        return f"={cmd_id}{sep}{result}\n\n", cmd == "quit"
+
+
+def run_gtp(player, instream=None, outstream=None, **engine_kwargs):
+    """Blocking GTP loop (reference ``run_gtp`` entry point)."""
+    instream = instream or sys.stdin
+    outstream = outstream or sys.stdout
+    engine = GTPEngine(player, **engine_kwargs)
+    for line in instream:
+        reply, done = engine.handle(line)
+        if reply is not None:
+            outstream.write(reply)
+            outstream.flush()
+        if done:
+            break
+    return engine
+
+
+def make_player(args):
+    """Build the requested agent from saved model specs."""
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+    from rocalphago_tpu.search.mcts import MCTSPlayer
+    from rocalphago_tpu.search.players import (
+        GreedyPolicyPlayer,
+        ProbabilisticPolicyPlayer,
+    )
+
+    policy = NeuralNetBase.load_model(args.policy)
+    if args.player == "greedy":
+        return GreedyPolicyPlayer(policy)
+    if args.player == "probabilistic":
+        return ProbabilisticPolicyPlayer(policy,
+                                         temperature=args.temperature)
+    if args.player == "mcts":
+        if not args.value:
+            raise SystemExit("--value model is required for --player mcts")
+        value = NeuralNetBase.load_model(args.value)
+        rollout = (NeuralNetBase.load_model(args.rollout)
+                   if args.rollout else None)
+        return MCTSPlayer(value, policy, rollout=rollout,
+                          lmbda=args.lmbda, n_playout=args.playouts,
+                          leaf_batch=args.leaf_batch)
+    raise SystemExit(f"unknown player type {args.player!r}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="GTP engine (GoGui/KGS-compatible) over the "
+                    "framework's players")
+    ap.add_argument("--policy", required=True,
+                    help="policy model JSON spec")
+    ap.add_argument("--value", help="value model JSON spec (for mcts)")
+    ap.add_argument("--rollout", help="rollout model JSON spec")
+    ap.add_argument("--player", default="greedy",
+                    choices=("greedy", "probabilistic", "mcts"))
+    ap.add_argument("--temperature", type=float, default=0.1)
+    ap.add_argument("--lmbda", type=float, default=0.5)
+    ap.add_argument("--playouts", type=int, default=100)
+    ap.add_argument("--leaf-batch", type=int, default=8)
+    a = ap.parse_args(argv)
+    run_gtp(make_player(a))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

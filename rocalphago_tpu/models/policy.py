@@ -73,13 +73,20 @@ class CNNPolicy(NeuralNetBase):
         """Distribution over legal moves of one state →
         ``[((x, y), prob), ...]`` (the reference's
         ``_select_moves_and_normalize`` semantics). ``moves`` optionally
-        restricts the support (an empty list means "no moves")."""
+        restricts the support (an empty list means "no moves");
+        it must contain only legal moves — entries are NOT re-checked
+        against the rules."""
         return self.batch_eval_state(
             [state], [moves] if moves is not None else None)[0]
 
     def batch_eval_state(self, states, moves_lists=None):
         """Lockstep evaluation of many states: one forward and one
-        masked-softmax device call for the whole batch."""
+        masked-softmax device call for the whole batch.
+
+        ``moves_lists[i]``, when given, becomes the support for state
+        ``i`` verbatim (callers pass pre-computed legal/sensible
+        subsets; re-deriving legality here would double the host cost
+        of the search hot path)."""
         states = self._as_state_list(states)
         planes = self._states_to_planes(states)
         logits = self.forward(planes)

@@ -1,3 +1,14 @@
 """Trainers: supervised policy, REINFORCE self-play policy, value
 regression, and the self-play value-dataset generator the reference
 lacks (SURVEY.md §1 L4, §2 "Value trainer" gap)."""
+
+from rocalphago_tpu.training.rl import RLConfig, RLTrainer  # noqa: F401
+from rocalphago_tpu.training.selfplay_data import (  # noqa: F401
+    ValueDataGenerator,
+    play_value_games,
+)
+from rocalphago_tpu.training.sl import SLConfig, SLTrainer  # noqa: F401
+from rocalphago_tpu.training.value import (  # noqa: F401
+    ValueConfig,
+    ValueTrainer,
+)

@@ -114,8 +114,9 @@ def make_rl_iteration(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
             # [half:batch] on odd plies (selfplay color split)
             start = jnp.where((t % 2) == 0, 0, half)
             take = lambda a: lax.dynamic_slice_in_dim(a, start, half)  # noqa: E731
-            planes = enc(jax.tree.map(take, states))
-            sens = take(vsens(states))
+            half_states = jax.tree.map(take, states)
+            planes = enc(half_states)
+            sens = vsens(half_states)
             acts = take(actions_t)
             w = (take(z) * take(live_t)
                  * (acts < n).astype(jnp.float32))

@@ -86,13 +86,15 @@ def make_optimizer(cfg: SLConfig) -> optax.GradientTransformation:
     return optax.sgd(sched, momentum=cfg.momentum or None)
 
 
-def policy_loss_fn(apply_fn, params, planes, actions):
+def policy_loss_fn(apply_fn, params, planes, actions, weights=None):
     logits = apply_fn(params, planes)
     # pass actions (== N, present when a corpus was converted with
     # include_passes) are outside the policy's board-point output space
     # — mask them out rather than letting the xent gather clamp them
     # onto the last board point
     valid = (actions < logits.shape[-1]).astype(jnp.float32)
+    if weights is not None:
+        valid = valid * weights
     denom = jnp.maximum(valid.sum(), 1.0)
     xent = optax.softmax_cross_entropy_with_integer_labels(
         logits, jnp.minimum(actions, logits.shape[-1] - 1))
@@ -122,12 +124,33 @@ def make_train_step(apply_fn, tx, size: int, symmetries: bool):
     return train_step
 
 
-def make_eval_step(apply_fn):
-    def eval_step(params, planes, actions):
+def make_eval_step(apply_fn, num_points: int):
+    def eval_step(params, planes, actions, weights):
         loss, acc = policy_loss_fn(
-            apply_fn, params, planes.astype(jnp.float32), actions)
-        return {"loss": loss, "accuracy": acc}
+            apply_fn, params, planes.astype(jnp.float32), actions,
+            weights)
+        # effective sample count = the loss denominator (real rows
+        # whose action is a board point)
+        count = ((actions < num_points) * weights).sum()
+        return {"loss": loss, "accuracy": acc, "count": count}
     return eval_step
+
+
+def pad_batch(planes, targets, batch_size: int):
+    """Pad a short final batch up to ``batch_size`` (repeating row 0)
+    with a 0/1 weight vector marking the real rows — so evaluation
+    keeps one compiled shape and small validation splits still
+    contribute instead of being dropped."""
+    k = len(targets)
+    weights = np.ones(batch_size, np.float32)
+    if k < batch_size:
+        pad = batch_size - k
+        planes = np.concatenate(
+            [planes, np.repeat(planes[:1], pad, axis=0)])
+        targets = np.concatenate(
+            [targets, np.repeat(targets[:1], pad, axis=0)])
+        weights[k:] = 0.0
+    return planes, targets, weights
 
 
 class SLTrainer:
@@ -170,8 +193,8 @@ class SLTrainer:
             out_shardings=(state_sh, rep),
             donate_argnums=(0,))
         self._eval_step = jax.jit(
-            make_eval_step(self.net.module.apply),
-            in_shardings=(state_sh.params, batch_sh, act_sh),
+            make_eval_step(self.net.module.apply, size * size),
+            in_shardings=(state_sh.params, batch_sh, act_sh, act_sh),
             out_shardings=rep)
 
         self.tx = tx
@@ -240,6 +263,11 @@ class SLTrainer:
                     self.state, planes, actions)
                 losses.append(m["loss"])
                 accs.append(m["accuracy"])
+            if not losses:
+                raise ValueError(
+                    f"train split ({len(self.train_idx)} positions) "
+                    f"yields no full minibatch of {cfg.minibatch}; "
+                    "convert more games or shrink the minibatch")
             train_loss = float(jnp.mean(jnp.stack(losses)))
             train_acc = float(jnp.mean(jnp.stack(accs)))
             dt = time.time() - t0
@@ -264,21 +292,24 @@ class SLTrainer:
         max_batches = max_batches or cfg.max_validation_batches
         params = self.state.params
         rng = np.random.default_rng(0)
-        losses, accs = [], []
+        loss_sum = acc_sum = count = 0.0
         it = batch_iterator(self.dataset, indices, cfg.minibatch, rng,
-                            epochs=1)
+                            epochs=1, drop_remainder=False)
         for i, (planes, actions) in enumerate(it):
             if i >= max_batches:
                 break
-            planes, actions = meshlib.shard_batch(
-                self.mesh, (planes, actions))
-            m = self._eval_step(params, planes, actions)
-            losses.append(m["loss"])
-            accs.append(m["accuracy"])
-        if not losses:
+            planes, actions, weights = pad_batch(
+                planes, actions, cfg.minibatch)
+            planes, actions, weights = meshlib.shard_batch(
+                self.mesh, (planes, actions, weights))
+            m = self._eval_step(params, planes, actions, weights)
+            c = float(m["count"])
+            loss_sum += float(m["loss"]) * c
+            acc_sum += float(m["accuracy"]) * c
+            count += c
+        if not count:
             return {"loss": float("nan"), "accuracy": float("nan")}
-        return {"loss": float(jnp.mean(jnp.stack(losses))),
-                "accuracy": float(jnp.mean(jnp.stack(accs)))}
+        return {"loss": loss_sum / count, "accuracy": acc_sum / count}
 
     def _export_weights(self, epoch: int) -> None:
         """Reference-parity per-epoch weight export

@@ -1,0 +1,197 @@
+"""GTP engine protocol tests.
+
+The reference drives its GTP wrapper with a scripted player
+(SURVEY.md §4 [C-LOW]); here the engine is exercised command-by-command
+with a deterministic fake player (no NN), plus one end-to-end loop over
+a real policy net — the "serve" call stack of SURVEY.md §3.5.
+"""
+
+import io
+
+import pytest
+
+from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.interface.gtp import (
+    GTPEngine,
+    move_to_vertex,
+    run_gtp,
+    vertex_to_move,
+)
+
+
+class ScriptedPlayer:
+    """Plays the first sensible legal move; records calls."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def get_move(self, state):
+        self.calls += 1
+        moves = state.get_legal_moves(include_eyes=False)
+        return moves[0] if moves else None
+
+
+@pytest.fixture
+def engine():
+    return GTPEngine(ScriptedPlayer())
+
+
+def ok(engine, line):
+    reply, _ = engine.handle(line)
+    assert reply.startswith("="), reply
+    return reply[1:].strip()
+
+
+def fail(engine, line):
+    reply, _ = engine.handle(line)
+    assert reply.startswith("?"), reply
+    return reply
+
+
+# ----------------------------------------------------------- vertices
+
+
+def test_vertex_roundtrip():
+    for size in (9, 19):
+        for move in [(0, 0), (size - 1, size - 1), (3, 2), None]:
+            v = move_to_vertex(move, size)
+            assert vertex_to_move(v, size) == move
+    # GTP columns skip I: the 9th column letter is J
+    assert move_to_vertex((8, 0), 19) == "J1"
+    with pytest.raises(ValueError):
+        vertex_to_move("Z9", 9)
+
+
+# ------------------------------------------------------------ protocol
+
+
+def test_admin_commands(engine):
+    assert ok(engine, "protocol_version") == "2"
+    assert ok(engine, "name") == "rocalphago-tpu"
+    assert ok(engine, "known_command genmove") == "true"
+    assert ok(engine, "known_command frobnicate") == "false"
+    assert "genmove" in ok(engine, "list_commands")
+    assert fail(engine, "frobnicate").startswith("?")
+
+
+def test_id_echo(engine):
+    reply, _ = engine.handle("42 name")
+    assert reply == "=42 rocalphago-tpu\n\n"
+    reply, _ = engine.handle("7 bogus_command")
+    assert reply.startswith("?7 ")
+
+
+def test_board_setup_and_play(engine):
+    ok(engine, "boardsize 9")
+    ok(engine, "komi 5.5")
+    assert engine.state.size == 9
+    assert engine.state.komi == 5.5
+    ok(engine, "play black E5")
+    assert engine.state.board[4, 4] == pygo.BLACK
+    ok(engine, "play white C3")
+    assert engine.state.board[2, 2] == pygo.WHITE
+    fail(engine, "play black E5")        # occupied
+    fail(engine, "play purple A1")       # bad color
+    board = ok(engine, "showboard")
+    assert "X" in board and "O" in board
+
+
+def test_genmove_updates_state(engine):
+    ok(engine, "boardsize 5")
+    vertex = ok(engine, "genmove b")
+    assert vertex != "pass"
+    move = vertex_to_move(vertex, 5)
+    assert engine.state.board[move] == pygo.BLACK
+    assert engine.player.calls == 1
+    vertex2 = ok(engine, "genmove w")
+    move2 = vertex_to_move(vertex2, 5)
+    assert engine.state.board[move2] == pygo.WHITE
+
+
+def test_undo_restores_position(engine):
+    ok(engine, "boardsize 5")
+    ok(engine, "play b C3")
+    ok(engine, "genmove w")
+    ok(engine, "undo")
+    ok(engine, "undo")
+    assert (engine.state.board == pygo.EMPTY).all()
+    fail(engine, "undo")
+
+
+def test_clear_board_resets(engine):
+    ok(engine, "boardsize 5")
+    ok(engine, "play b C3")
+    ok(engine, "clear_board")
+    assert (engine.state.board == pygo.EMPTY).all()
+    assert engine.state.history == []
+
+
+def test_handicap(engine):
+    ok(engine, "boardsize 9")
+    vertices = ok(engine, "fixed_handicap 4").split()
+    assert len(vertices) == 4
+    for v in vertices:
+        assert engine.state.board[vertex_to_move(v, 9)] == pygo.BLACK
+    assert engine.state.current_player == pygo.WHITE
+    fail(engine, "fixed_handicap 99")
+
+
+def test_fixed_handicap_layouts_follow_spec():
+    from rocalphago_tpu.interface.gtp import fixed_handicap_points
+
+    center = (9, 9)
+    for n in (2, 3, 4, 6, 8):
+        assert center not in fixed_handicap_points(19, n)
+    for n in (5, 7, 9):
+        assert center in fixed_handicap_points(19, n)
+    assert len(fixed_handicap_points(19, 8)) == 8
+    with pytest.raises(ValueError):
+        fixed_handicap_points(8, 2)  # even boards: no layout
+
+
+def test_play_after_game_over_keeps_undo_stack(engine):
+    ok(engine, "boardsize 5")
+    ok(engine, "play b C3")
+    ok(engine, "play w pass")
+    ok(engine, "play b pass")
+    assert engine.state.is_end_of_game
+    depth = len(engine._undo_stack)
+    fail(engine, "play w A1")            # game over → error reply...
+    assert len(engine._undo_stack) == depth  # ...and no stale snapshot
+    ok(engine, "undo")                   # undo still unwinds correctly
+    assert not engine.state.is_end_of_game
+
+
+def test_final_score(engine):
+    ok(engine, "boardsize 5")
+    ok(engine, "komi 0.5")
+    ok(engine, "play b C3")
+    # all empty space borders only black
+    assert ok(engine, "final_score").startswith("B+")
+
+
+def test_run_gtp_loop_and_quit():
+    instream = io.StringIO(
+        "boardsize 5\nclear_board\ngenmove b\n# comment line\n"
+        "final_score\nquit\nname\n")
+    out = io.StringIO()
+    engine = run_gtp(ScriptedPlayer(), instream, out)
+    replies = out.getvalue().split("\n\n")
+    # 5 replies (comment skipped, name never reached after quit)
+    assert len([r for r in replies if r]) == 5
+    assert engine.player.calls == 1
+
+
+def test_gtp_with_real_policy_player():
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.search.players import GreedyPolicyPlayer
+
+    policy = CNNPolicy(("board", "ones"), board=5, layers=2,
+                       filters_per_layer=4)
+    instream = io.StringIO(
+        "boardsize 5\ngenmove b\ngenmove w\nshowboard\nquit\n")
+    out = io.StringIO()
+    run_gtp(GreedyPolicyPlayer(policy), instream, out)
+    text = out.getvalue()
+    assert text.count("=") >= 5
+    assert "?" not in text.split("showboard")[0]

@@ -1,0 +1,138 @@
+"""Value pipeline: dataset generation → trainer → eval.
+
+Covers the reference's value-trainer contract (MSE regression, trainer
+smoke + resume; SURVEY.md §4) plus the generator the reference lacks:
+the de-correlated one-position-per-game sampler, whose recorded-state
+invariants (sample ply, player to move, outcome sign) are asserted
+against the returned game metadata.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocalphago_tpu.data.pipeline import ShardedDataset
+from rocalphago_tpu.models import CNNPolicy, CNNValue
+from rocalphago_tpu.training.selfplay_data import (
+    ValueDataGenerator,
+    play_value_games,
+)
+from rocalphago_tpu.training.value import ValueConfig, ValueTrainer
+
+SIZE = 5
+FEATURES = ("board", "ones")
+BATCH = 8
+MOVES = 20
+
+
+@pytest.fixture(scope="module")
+def policy():
+    return CNNPolicy(FEATURES, board=SIZE, layers=2, filters_per_layer=4)
+
+
+@pytest.fixture(scope="module")
+def samples(policy):
+    return jax.jit(
+        lambda rng: play_value_games(
+            policy.cfg, FEATURES, policy.module.apply, policy.params,
+            policy.module.apply, policy.params, rng, BATCH, MOVES))(
+        jax.random.key(0))
+
+
+def test_one_sample_per_game_invariants(samples):
+    valid = np.asarray(samples.valid)
+    assert valid.any()
+    u = np.asarray(samples.u)
+    step = np.asarray(samples.recorded.step_count)
+    turn = np.asarray(samples.recorded.turn)
+    z = np.asarray(samples.z)
+    for g in np.flatnonzero(valid):
+        # recorded position is right after the random move U
+        assert step[g] == u[g] + 1
+        # Black moves on even plies, so after U+1 plies the player to
+        # move alternates accordingly
+        assert turn[g] == (1 if (u[g] + 1) % 2 == 0 else -1)
+        assert z[g] in (-1, 0, 1)
+    assert not np.asarray(samples.recorded.done)[valid].any()
+
+
+def test_generator_writes_trainable_corpus(tmp_path, policy):
+    gen = ValueDataGenerator(policy, policy, FEATURES, batch=BATCH,
+                             max_moves=MOVES)
+    prefix = str(tmp_path / "value" / "corpus")
+    manifest = gen.generate(24, prefix, seed=0, shard_size=16)
+    assert manifest["targets"] == "outcome"
+    assert manifest["num_positions"] >= 24
+    ds = ShardedDataset(prefix)
+    assert len(ds) == manifest["num_positions"]
+    states, z = ds.gather(np.arange(len(ds)))
+    assert states.shape[1:] == (SIZE, SIZE, gen.pre.output_dim)
+    assert states.dtype == np.uint8
+    assert set(np.unique(z)) <= {-1, 1}
+    # roughly outcome-balanced corpus (both colors sampled)
+    assert (z == 1).any() and (z == -1).any()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory, policy):
+    gen = ValueDataGenerator(policy, policy, FEATURES, batch=BATCH,
+                             max_moves=MOVES)
+    prefix = str(tmp_path_factory.mktemp("vdata") / "corpus")
+    gen.generate(48, prefix, seed=1, shard_size=32)
+    return prefix
+
+
+def make_trainer(out_dir, corpus, epochs=2):
+    cfg = ValueConfig(
+        train_data=corpus, out_dir=str(out_dir), minibatch=4,
+        epochs=epochs, learning_rate=0.01,
+        train_val_test=(0.8, 0.1, 0.1), seed=0, num_devices=2)
+    net = CNNValue(FEATURES, board=SIZE, layers=2, filters_per_layer=4,
+                   dense_units=8)
+    return ValueTrainer(cfg, net=net)
+
+
+def test_value_trainer_runs_and_saves(tmp_path, corpus):
+    trainer = make_trainer(tmp_path / "out", corpus)
+    final = trainer.run()
+    assert np.isfinite(final["train_mse"])
+    assert np.isfinite(final["val_mse"])
+    assert final["epoch"] == 1
+    out = trainer.cfg.out_dir
+    with open(os.path.join(out, "metadata.json")) as f:
+        meta = json.load(f)
+    assert len(meta["epochs"]) == 2
+    assert os.path.exists(os.path.join(out, "weights.00001.flax.msgpack"))
+    # predictions stay in the tanh range
+    states, _ = trainer.dataset.gather(np.arange(8))
+    trainer.net.params = jax.device_get(trainer.state.params)
+    preds = trainer.net.forward(jnp.asarray(states, jnp.float32))
+    assert np.all(np.abs(np.asarray(preds)) <= 1.0)
+
+
+def test_value_trainer_resumes(tmp_path, corpus):
+    trainer = make_trainer(tmp_path / "out2", corpus, epochs=1)
+    trainer.run()
+    trainer.ckpt.close()
+    resumed = make_trainer(tmp_path / "out2", corpus, epochs=2)
+    assert resumed.start_epoch == 1
+    final = resumed.run()
+    assert final["epoch"] == 1
+    with open(os.path.join(resumed.cfg.out_dir, "metadata.json")) as f:
+        meta = json.load(f)
+    assert [e["epoch"] for e in meta["epochs"]] == [0, 1]
+
+
+def test_trainer_rejects_wrong_corpus(tmp_path, corpus, policy):
+    """An SL (action-labelled) corpus must be refused."""
+    from rocalphago_tpu.data.convert import GameConverter  # noqa: F401
+    cfg = ValueConfig(train_data=corpus, out_dir=str(tmp_path / "o3"),
+                      minibatch=8, epochs=1, num_devices=2)
+    net = CNNValue(("board",), board=SIZE, layers=2,
+                   filters_per_layer=4, dense_units=8)
+    with pytest.raises(ValueError, match="planes"):
+        ValueTrainer(cfg, net=net)
