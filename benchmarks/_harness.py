@@ -1,0 +1,52 @@
+"""Shared micro-benchmark harness.
+
+Parity: the reference's ``benchmarks/`` cProfile scripts (SURVEY.md §2
+"Benchmarks", §5 "Tracing / profiling"). Here each script times a
+jitted program with compile excluded and prints one JSON line, the
+same shape as the repo-root ``bench.py``; pass ``--profile DIR`` to
+any script to additionally capture a ``jax.profiler`` trace viewable
+in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+
+def std_parser(description: str) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--board", type=int, default=19)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="write a jax.profiler trace to DIR")
+    return ap
+
+
+def timed(fn, reps: int = 3, profile_dir: str | None = None) -> float:
+    """Seconds per call of ``fn`` (first call = warmup/compile,
+    excluded). ``fn`` must force completion itself (return
+    ``jax.device_get`` of something)."""
+    fn()
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    t0 = time.time()
+    for _ in range(reps):
+        fn()
+    dt = (time.time() - t0) / reps
+    if profile_dir:
+        jax.profiler.stop_trace()
+    return dt
+
+
+def report(metric: str, value: float, unit: str,
+           baseline: float | None = None, **extra) -> None:
+    line = {"metric": metric, "value": round(value, 2), "unit": unit}
+    if baseline:
+        line["vs_baseline"] = round(value / baseline, 3)
+    line.update(extra)
+    print(json.dumps(line))

@@ -1,0 +1,69 @@
+"""Vectorized Go engine throughput (board steps/s).
+
+The rebuild's analogue of the reference's Cython-engine motivation
+(SURVEY.md §2a): random-legal-move games stepped in lockstep under one
+jit — the raw rules-kernel speed with no NN in the loop. Compare with
+Pgx's O(10⁴–10⁶) steps/s/device (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks._harness import report, std_parser, timed  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine.jaxgo import (
+        GoConfig,
+        legal_mask,
+        new_states,
+        step,
+    )
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--moves", type=int, default=128)
+    args = ap.parse_args()
+    batch = args.batch or (1024 if jax.devices()[0].platform == "tpu"
+                           else 64)
+    cfg = GoConfig(size=args.board)
+    vstep = jax.vmap(functools.partial(step, cfg))
+    vlegal = jax.vmap(functools.partial(legal_mask, cfg))
+
+    @jax.jit
+    def run(rng):
+        states = new_states(cfg, batch)
+
+        def ply(carry, _):
+            states, rng = carry
+            rng, sub = jax.random.split(rng)
+            legal = vlegal(states)[:, :-1]
+            logits = jnp.where(legal, 0.0, -1e30)
+            action = jnp.where(
+                legal.any(-1),
+                jax.random.categorical(sub, logits, axis=-1),
+                cfg.num_points).astype(jnp.int32)
+            return (vstep(states, action), rng), None
+
+        (states, _), _ = jax.lax.scan(ply, (states, rng),
+                                      length=args.moves)
+        return states.step_count
+
+    key = [jax.random.key(0)]
+
+    def once():
+        key[0], sub = jax.random.split(key[0])
+        return jax.device_get(run(sub))
+
+    dt = timed(once, reps=args.reps, profile_dir=args.profile)
+    report("engine_steps", batch * args.moves / dt, "steps/s",
+           batch=batch, board=args.board)
+
+
+if __name__ == "__main__":
+    main()

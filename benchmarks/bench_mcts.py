@@ -1,0 +1,50 @@
+"""MCTS playout throughput (sims/s) with real nets.
+
+The reference's per-playout batch-1 NN eval was its search bottleneck
+(SURVEY.md §3.3); this measures the batched-leaf rebuild end to end:
+host tree + one jitted policy/value forward per wave.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+from benchmarks._harness import report, std_parser  # noqa: E402
+
+
+def main() -> None:
+    from rocalphago_tpu.engine import pygo
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.mcts import MCTSPlayer
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--playouts", type=int, default=64)
+    ap.add_argument("--leaf-batch", type=int, default=16)
+    ap.add_argument("--lmbda", type=float, default=0.0,
+                    help="0 = value-net only (no rollouts)")
+    args = ap.parse_args()
+
+    policy = CNNPolicy(board=args.board, layers=12,
+                       filters_per_layer=128)
+    value = CNNValue(board=args.board, layers=12, filters_per_layer=128)
+    player = MCTSPlayer(value, policy, lmbda=args.lmbda,
+                        n_playout=args.playouts,
+                        leaf_batch=args.leaf_batch, seed=0)
+    state = pygo.GameState(size=args.board)
+    player.get_move(state.copy())      # warmup/compile
+
+    t0 = time.time()
+    for _ in range(args.reps):
+        player.mcts.reset()
+        player._tree_history = None
+        player.get_move(state.copy())
+    dt = (time.time() - t0) / args.reps
+    report("mcts_playouts", args.playouts / dt, "sims/s",
+           playouts=args.playouts, leaf_batch=args.leaf_batch,
+           board=args.board, lmbda=args.lmbda)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""48-plane feature-encoder throughput (positions/s).
+
+The reference's ``preprocess_benchmark.py`` profiled its hottest
+function — per-state Python featurization (SURVEY.md §2 "Benchmarks",
+§3.2). The rebuild's encoder is a vmapped jitted program over batched
+device states; this measures end-to-end positions/s on mid-game boards.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks._harness import report, std_parser, timed  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig, new_states, step
+    from rocalphago_tpu.features import DEFAULT_FEATURES
+    from rocalphago_tpu.features.planes import encode
+
+    args = std_parser(__doc__).parse_args()
+    batch = args.batch or (256 if jax.devices()[0].platform == "tpu"
+                           else 32)
+    cfg = GoConfig(size=args.board)
+
+    # build mid-game positions: 120 random-legal plies
+    vstep = jax.vmap(functools.partial(step, cfg))
+
+    @jax.jit
+    def fill(rng):
+        states = new_states(cfg, batch)
+
+        def ply(carry, _):
+            states, rng = carry
+            rng, sub = jax.random.split(rng)
+            from rocalphago_tpu.engine.jaxgo import legal_mask
+            legal = jax.vmap(
+                functools.partial(legal_mask, cfg))(states)[:, :-1]
+            logits = jnp.where(legal, 0.0, -1e30)
+            action = jax.random.categorical(sub, logits, axis=-1)
+            action = jnp.where(legal.any(-1), action,
+                               cfg.num_points).astype(jnp.int32)
+            return (vstep(states, action), rng), None
+
+        (states, _), _ = jax.lax.scan(ply, (states, rng),
+                                      length=120)
+        return states
+
+    states = jax.block_until_ready(fill(jax.random.key(0)))
+    enc = jax.jit(jax.vmap(
+        functools.partial(encode, cfg, features=DEFAULT_FEATURES)))
+
+    dt = timed(lambda: jax.device_get(enc(states)), reps=args.reps,
+               profile_dir=args.profile)
+    report("preprocess_48planes", batch / dt, "positions/s",
+           batch=batch, board=args.board)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""SL training-step throughput (positions/s) on synthetic data.
+
+The device-side half of the reference's training hot path (SURVEY.md
+§3.1): full 12×128 policy on 48 planes, jitted data-parallel train
+step with on-device dihedral augmentation, synthetic batches (no input
+pipeline — measure the step itself).
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks._harness import report, std_parser, timed  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from rocalphago_tpu.io.checkpoint import pack_rng
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.parallel import mesh as meshlib
+    from rocalphago_tpu.training.sl import SLState, make_train_step
+
+    args = std_parser(__doc__).parse_args()
+    batch = args.batch or (256 if jax.devices()[0].platform == "tpu"
+                           else 16)
+    net = CNNPolicy(board=args.board, layers=12, filters_per_layer=128)
+    mesh = meshlib.make_mesh()
+    tx = optax.sgd(0.003)
+
+    rep = meshlib.replicated(mesh)
+    state = meshlib.replicate(mesh, SLState(
+        params=net.params, opt_state=tx.init(net.params),
+        step=jnp.int32(0), rng=pack_rng(jax.random.key(0))))
+    state_sh = jax.tree.map(lambda _: rep, state)
+    train_step = jax.jit(
+        make_train_step(net.module.apply, tx, args.board,
+                        symmetries=True),
+        in_shardings=(state_sh, meshlib.data_sharding(mesh, 4),
+                      meshlib.data_sharding(mesh, 1)),
+        out_shardings=(state_sh, rep))
+
+    rng = np.random.default_rng(0)
+    planes = rng.random((batch, args.board, args.board,
+                         net.preprocess.output_dim), np.float32)
+    actions = rng.integers(0, args.board ** 2, batch, dtype=np.int32)
+    planes, actions = meshlib.shard_batch(mesh, (planes, actions))
+
+    holder = [state]
+
+    def once():
+        holder[0], m = train_step(holder[0], planes, actions)
+        return jax.device_get(m["loss"])
+
+    dt = timed(once, reps=args.reps, profile_dir=args.profile)
+    report("sl_train_step", batch / dt, "positions/s",
+           batch=batch, board=args.board,
+           devices=mesh.shape[meshlib.DATA_AXIS])
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,29 @@ def fixed_handicap_points(size: int, n: int) -> list:
     return layouts[n]
 
 
+def free_handicap_points(size: int, n: int) -> list:
+    """Up to ``n`` engine-chosen handicap vertices (GTP
+    ``place_free_handicap`` may place fewer): star points first, then a
+    deterministic spread over remaining third-line points."""
+    try:
+        pts = list(fixed_handicap_points(size, min(n, 9)))
+    except ValueError:
+        pts = []
+    if len(pts) >= n:
+        return pts[:n]
+    edge = 2 if size < 13 else 3
+    lo, hi = edge, size - 1 - edge
+    seen = set(pts)
+    for x in range(lo, hi + 1, 2):
+        for y in range(lo, hi + 1, 2):
+            if len(pts) >= n:
+                return pts
+            if (x, y) not in seen:
+                pts.append((x, y))
+                seen.add((x, y))
+    return pts
+
+
 def move_to_vertex(move, size: int) -> str:
     """(x, y) board move (or None) → GTP vertex string. ``x`` is the
     column (A..T skipping I), ``y`` the row (1-based)."""
@@ -124,13 +147,11 @@ class GTPEngine:
     # ------------------------------------------------------------ setup
 
     def _new_game(self):
+        from rocalphago_tpu.search.players import reset_player
+
         self.state = pygo.GameState(size=self.size, komi=self.komi)
         self._undo_stack.clear()
-        mcts = getattr(self.player, "mcts", None)
-        if mcts is not None and hasattr(mcts, "reset"):
-            mcts.reset()
-        if hasattr(self.player, "_tree_history"):
-            self.player._tree_history = None
+        reset_player(self.player)
 
     def cmd_boardsize(self, args):
         size = int(args[0])
@@ -155,7 +176,15 @@ class GTPEngine:
         return " ".join(move_to_vertex(p, self.size) for p in pts)
 
     def cmd_place_free_handicap(self, args):
-        return self.cmd_fixed_handicap(args)
+        # free placement: the engine chooses; GTP 2 allows returning
+        # fewer stones than requested, but must place some. Use the
+        # star-point layouts as far as they go.
+        n = int(args[0])
+        if n < 2:
+            raise ValueError("invalid number of stones")
+        pts = free_handicap_points(self.size, n)
+        self.state.place_handicaps(pts)
+        return " ".join(move_to_vertex(p, self.size) for p in pts)
 
     def cmd_set_free_handicap(self, args):
         pts = [vertex_to_move(v, self.size) for v in args]
@@ -196,6 +225,8 @@ class GTPEngine:
         if not self._undo_stack:
             raise ValueError("cannot undo")
         self.state = self._undo_stack.pop()
+        # a komi set after the snapshot must survive the undo
+        self.state.komi = self.komi
         return ""
 
     # ------------------------------------------------------ observation
@@ -272,29 +303,16 @@ def run_gtp(player, instream=None, outstream=None, **engine_kwargs):
 
 def make_player(args):
     """Build the requested agent from saved model specs."""
-    from rocalphago_tpu.models.nn_util import NeuralNetBase
-    from rocalphago_tpu.search.mcts import MCTSPlayer
-    from rocalphago_tpu.search.players import (
-        GreedyPolicyPlayer,
-        ProbabilisticPolicyPlayer,
-    )
+    from rocalphago_tpu.search.players import build_player
 
-    policy = NeuralNetBase.load_model(args.policy)
-    if args.player == "greedy":
-        return GreedyPolicyPlayer(policy)
-    if args.player == "probabilistic":
-        return ProbabilisticPolicyPlayer(policy,
-                                         temperature=args.temperature)
-    if args.player == "mcts":
-        if not args.value:
-            raise SystemExit("--value model is required for --player mcts")
-        value = NeuralNetBase.load_model(args.value)
-        rollout = (NeuralNetBase.load_model(args.rollout)
-                   if args.rollout else None)
-        return MCTSPlayer(value, policy, rollout=rollout,
-                          lmbda=args.lmbda, n_playout=args.playouts,
-                          leaf_batch=args.leaf_batch)
-    raise SystemExit(f"unknown player type {args.player!r}")
+    try:
+        return build_player(args.player, args.policy, args.value,
+                            args.rollout, temperature=args.temperature,
+                            playouts=args.playouts,
+                            leaf_batch=args.leaf_batch,
+                            lmbda=args.lmbda)
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def main(argv=None):

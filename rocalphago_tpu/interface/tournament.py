@@ -1,0 +1,104 @@
+"""Head-to-head evaluation: play two configured agents against each
+other and report win rates.
+
+Parity: the reference's SL-vs-RL-vs-MCTS evaluation configurations
+(BASELINE.json configs; SURVEY.md §7 step 6 "tournament CLI"). Colors
+alternate per game; results stream to stdout and a JSONL file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from rocalphago_tpu.engine import pygo
+
+
+def play_match(black, white, size: int = 19, komi: float = 7.5,
+               move_limit: int = 722):
+    """One game; returns +1 (black win), -1 (white win), 0 (draw)."""
+    from rocalphago_tpu.search.players import reset_player
+
+    state = pygo.GameState(size=size, komi=komi)
+    players = {pygo.BLACK: black, pygo.WHITE: white}
+    for player in players.values():
+        reset_player(player)
+    while not state.is_end_of_game and state.turns_played < move_limit:
+        move = players[state.current_player].get_move(state)
+        state.do_move(move)
+    return state.get_winner()
+
+
+def run_tournament(player_a, player_b, games: int, size: int = 19,
+                   komi: float = 7.5, move_limit: int = 722,
+                   log=None, names=("A", "B")) -> dict:
+    """``games`` games, colors alternating; returns the tally."""
+    wins = {names[0]: 0, names[1]: 0, "draw": 0}
+    for g in range(games):
+        black, white = (player_a, player_b) if g % 2 == 0 \
+            else (player_b, player_a)
+        black_name = names[0] if g % 2 == 0 else names[1]
+        white_name = names[1] if g % 2 == 0 else names[0]
+        w = play_match(black, white, size=size, komi=komi,
+                       move_limit=move_limit)
+        winner = black_name if w == pygo.BLACK else \
+            white_name if w == pygo.WHITE else "draw"
+        wins[winner] += 1
+        entry = {"game": g, "black": black_name, "white": white_name,
+                 "winner": winner}
+        if log:
+            log.write(json.dumps(entry) + "\n")
+            log.flush()
+        print(f"game {g}: {black_name}(B) vs {white_name}(W) -> "
+              f"{winner}", file=sys.stderr)
+    total = max(games, 1)
+    return {"games": games,
+            "wins": wins,
+            "win_rate_a": wins[names[0]] / total,
+            "win_rate_b": wins[names[1]] / total}
+
+
+def _build_player(spec: str, temperature: float, playouts: int):
+    """``kind:policy.json[:value.json[:rollout.json]]`` → agent."""
+    from rocalphago_tpu.search.players import build_player
+
+    parts = spec.split(":")
+    try:
+        return build_player(parts[0], parts[1],
+                            parts[2] if len(parts) > 2 else None,
+                            parts[3] if len(parts) > 3 else None,
+                            temperature=temperature, playouts=playouts)
+    except (ValueError, IndexError) as e:
+        raise SystemExit(f"bad player spec {spec!r}: {e}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Agent-vs-agent evaluation tournament")
+    ap.add_argument("player_a", help="kind:policy.json[:value.json]")
+    ap.add_argument("player_b", help="kind:policy.json[:value.json]")
+    ap.add_argument("--games", type=int, default=20)
+    ap.add_argument("--board", type=int, default=19)
+    ap.add_argument("--komi", type=float, default=7.5)
+    ap.add_argument("--move-limit", type=int, default=722)
+    ap.add_argument("--temperature", type=float, default=0.67)
+    ap.add_argument("--playouts", type=int, default=100)
+    ap.add_argument("--log", default=None, help="JSONL game log path")
+    a = ap.parse_args(argv)
+    pa = _build_player(a.player_a, a.temperature, a.playouts)
+    pb = _build_player(a.player_b, a.temperature, a.playouts)
+    log = open(a.log, "w") if a.log else None
+    try:
+        tally = run_tournament(pa, pb, a.games, size=a.board,
+                               komi=a.komi, move_limit=a.move_limit,
+                               log=log)
+    finally:
+        if log:
+            log.close()
+    print(json.dumps(tally))
+    return tally
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

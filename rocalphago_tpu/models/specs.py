@@ -1,0 +1,61 @@
+"""CLI to create model JSON specs (+ fresh weights).
+
+The reference keeps model architecture in a JSON spec created ad hoc in
+user code before training (SURVEY.md §2 "NN base / registry"); this
+small CLI makes that a one-liner:
+
+    python -m rocalphago_tpu.models.specs policy --out models/policy.json
+    python -m rocalphago_tpu.models.specs value --out models/value.json
+    python -m rocalphago_tpu.models.specs rollout --out models/rollout.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from rocalphago_tpu.features import DEFAULT_FEATURES
+from rocalphago_tpu.models.policy import CNNPolicy
+from rocalphago_tpu.models.rollout import ROLLOUT_FEATURES, CNNRollout
+from rocalphago_tpu.models.value import CNNValue
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Write a model JSON spec with fresh weights")
+    ap.add_argument("kind", choices=("policy", "value", "rollout"))
+    ap.add_argument("--out", required=True, help="spec path (.json)")
+    ap.add_argument("--board", type=int, default=19)
+    ap.add_argument("--layers", type=int, default=12,
+                    help="conv trunk depth (policy/value only; the "
+                         "rollout net is fixed at one conv layer)")
+    ap.add_argument("--filters", type=int, default=None,
+                    help="filters per conv layer (default 128; "
+                         "rollout default 32)")
+    ap.add_argument("--features", nargs="*", default=None,
+                    help=f"feature names (policy/value default: the "
+                         f"AlphaGo 48-plane set {', '.join(DEFAULT_FEATURES)}"
+                         f"; rollout default: {', '.join(ROLLOUT_FEATURES)})")
+    ap.add_argument("--seed", type=int, default=0)
+    a = ap.parse_args(argv)
+
+    if a.kind == "policy":
+        features = tuple(a.features) if a.features else DEFAULT_FEATURES
+        net = CNNPolicy(features, board=a.board, layers=a.layers,
+                        filters_per_layer=a.filters or 128, seed=a.seed)
+    elif a.kind == "value":
+        features = tuple(a.features) if a.features else DEFAULT_FEATURES
+        net = CNNValue(features, board=a.board, layers=a.layers,
+                       filters_per_layer=a.filters or 128, seed=a.seed)
+    else:
+        features = tuple(a.features) if a.features else ROLLOUT_FEATURES
+        net = CNNRollout(features, board=a.board,
+                         filters=a.filters or 32, seed=a.seed)
+    net.save_model(a.out)
+    print(f"wrote {a.out} ({type(net).__name__}, board={a.board}, "
+          f"{net.preprocess.output_dim} planes)")
+    return net
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -103,6 +103,42 @@ class ProbabilisticPolicyPlayer:
         return out
 
 
+def build_player(kind: str, policy_path: str, value_path: str | None = None,
+                 rollout_path: str | None = None, temperature: float = 0.67,
+                 playouts: int = 100, leaf_batch: int = 8,
+                 lmbda: float = 0.5):
+    """One agent factory for every CLI (GTP, tournament): build a
+    ``greedy`` / ``probabilistic`` / ``mcts`` player from saved model
+    specs."""
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+
+    policy = NeuralNetBase.load_model(policy_path)
+    if kind == "greedy":
+        return GreedyPolicyPlayer(policy)
+    if kind == "probabilistic":
+        return ProbabilisticPolicyPlayer(policy, temperature=temperature)
+    if kind == "mcts":
+        from rocalphago_tpu.search.mcts import MCTSPlayer
+
+        if not value_path:
+            raise ValueError("mcts player needs a value model")
+        value = NeuralNetBase.load_model(value_path)
+        rollout = NeuralNetBase.load_model(rollout_path) \
+            if rollout_path else None
+        return MCTSPlayer(value, policy, rollout=rollout, lmbda=lmbda,
+                          n_playout=playouts, leaf_batch=leaf_batch)
+    raise ValueError(f"unknown player kind {kind!r}")
+
+
+def reset_player(player) -> None:
+    """Clear any per-game search state (new game starting)."""
+    mcts = getattr(player, "mcts", None)
+    if mcts is not None and hasattr(mcts, "reset"):
+        mcts.reset()
+    if hasattr(player, "_tree_history"):
+        player._tree_history = None
+
+
 class ValuePlayer:
     """One-ply lookahead on the value net: for each sensible move,
     evaluate the successor and pick the worst position for the

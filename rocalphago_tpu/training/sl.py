@@ -313,10 +313,14 @@ class SLTrainer:
 
     def _export_weights(self, epoch: int) -> None:
         """Reference-parity per-epoch weight export
-        (``weights.NNNNN``-style) in the model-spec format GTP loads."""
+        (``weights.NNNNN``-style) plus ``model.json`` — a loadable
+        spec always pointing at the latest weights, so downstream
+        stages (RL, GTP) can consume ``out_dir/model.json`` directly."""
         self.net.params = jax.device_get(self.state.params)
-        self.net.save_weights(os.path.join(
-            self.cfg.out_dir, f"weights.{epoch:05d}.flax.msgpack"))
+        weights = os.path.join(
+            self.cfg.out_dir, f"weights.{epoch:05d}.flax.msgpack")
+        self.net.save_model(
+            os.path.join(self.cfg.out_dir, "model.json"), weights)
 
 
 def run_training(argv=None) -> dict:

@@ -257,8 +257,11 @@ class ValueTrainer:
 
     def _export_weights(self, epoch: int) -> None:
         self.net.params = jax.device_get(self.state.params)
-        self.net.save_weights(os.path.join(
-            self.cfg.out_dir, f"weights.{epoch:05d}.flax.msgpack"))
+        weights = os.path.join(
+            self.cfg.out_dir, f"weights.{epoch:05d}.flax.msgpack")
+        # model.json always points at the latest weights (GTP-loadable)
+        self.net.save_model(
+            os.path.join(self.cfg.out_dir, "model.json"), weights)
 
 
 def run_training(argv=None) -> dict:

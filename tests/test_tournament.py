@@ -1,0 +1,44 @@
+"""Tournament runner: full games between host agents.
+
+Mirrors the reference's evaluation configurations (SL vs RL vs MCTS;
+SURVEY.md §7 step 6) at test scale: tiny nets, small board, few games.
+"""
+
+import io
+import json
+
+from rocalphago_tpu.models import CNNPolicy
+from rocalphago_tpu.interface.tournament import play_match, run_tournament
+from rocalphago_tpu.search.players import (
+    GreedyPolicyPlayer,
+    ProbabilisticPolicyPlayer,
+)
+
+SIZE = 5
+
+
+def make_players():
+    policy = CNNPolicy(("board", "ones"), board=SIZE, layers=2,
+                       filters_per_layer=4)
+    return (GreedyPolicyPlayer(policy, move_limit=30),
+            ProbabilisticPolicyPlayer(policy, temperature=1.0, seed=0,
+                                      move_limit=30))
+
+
+def test_play_match_completes():
+    a, b = make_players()
+    w = play_match(a, b, size=SIZE, komi=5.5, move_limit=40)
+    assert w in (-1, 0, 1)
+
+
+def test_run_tournament_alternates_colors_and_tallies():
+    a, b = make_players()
+    log = io.StringIO()
+    tally = run_tournament(a, b, games=4, size=SIZE, komi=5.5,
+                           move_limit=40, log=log)
+    assert tally["games"] == 4
+    assert sum(tally["wins"].values()) == 4
+    entries = [json.loads(line) for line in
+               log.getvalue().strip().splitlines()]
+    assert [e["black"] for e in entries] == ["A", "B", "A", "B"]
+    assert 0.0 <= tally["win_rate_a"] + tally["win_rate_b"] <= 1.0 + 1e-9
