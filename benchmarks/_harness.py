@@ -46,7 +46,41 @@ def timed(fn, reps: int = 3, profile_dir: str | None = None) -> float:
 def report(metric: str, value: float, unit: str,
            baseline: float | None = None, **extra) -> None:
     line = {"metric": metric, "value": round(value, 2), "unit": unit}
-    if baseline:
-        line["vs_baseline"] = round(value / baseline, 3)
+    if baseline is not None:
+        line["vs_baseline"] = round(value / max(baseline, 1e-12), 3)
     line.update(extra)
     print(json.dumps(line))
+
+
+def random_game_states(cfg, batch: int, moves: int, rng_key):
+    """Batched mid-game positions: ``moves`` uniform random legal
+    plies under one jit (shared by the engine/encoder benchmarks)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine.jaxgo import legal_mask, new_states, step
+
+    vstep = jax.vmap(functools.partial(step, cfg))
+    vlegal = jax.vmap(functools.partial(legal_mask, cfg))
+
+    @jax.jit
+    def run(rng):
+        states = new_states(cfg, batch)
+
+        def ply(carry, _):
+            states, rng = carry
+            rng, sub = jax.random.split(rng)
+            legal = vlegal(states)[:, :-1]
+            logits = jnp.where(legal, 0.0, -1e30)
+            action = jnp.where(
+                legal.any(-1),
+                jax.random.categorical(sub, logits, axis=-1),
+                cfg.num_points).astype(jnp.int32)
+            return (vstep(states, action), rng), None
+
+        (states, _), _ = jax.lax.scan(ply, (states, rng), length=moves)
+        return states
+
+    return run(rng_key)

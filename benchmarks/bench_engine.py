@@ -8,23 +8,21 @@ Pgx's O(10⁴–10⁶) steps/s/device (SURVEY.md §6).
 
 from __future__ import annotations
 
-import functools
 import sys
 
 sys.path.insert(0, ".")
-from benchmarks._harness import report, std_parser, timed  # noqa: E402
+from benchmarks._harness import (  # noqa: E402
+    random_game_states,
+    report,
+    std_parser,
+    timed,
+)
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
-    from rocalphago_tpu.engine.jaxgo import (
-        GoConfig,
-        legal_mask,
-        new_states,
-        step,
-    )
+    from rocalphago_tpu.engine.jaxgo import GoConfig
 
     ap = std_parser(__doc__)
     ap.add_argument("--moves", type=int, default=128)
@@ -32,33 +30,12 @@ def main() -> None:
     batch = args.batch or (1024 if jax.devices()[0].platform == "tpu"
                            else 64)
     cfg = GoConfig(size=args.board)
-    vstep = jax.vmap(functools.partial(step, cfg))
-    vlegal = jax.vmap(functools.partial(legal_mask, cfg))
-
-    @jax.jit
-    def run(rng):
-        states = new_states(cfg, batch)
-
-        def ply(carry, _):
-            states, rng = carry
-            rng, sub = jax.random.split(rng)
-            legal = vlegal(states)[:, :-1]
-            logits = jnp.where(legal, 0.0, -1e30)
-            action = jnp.where(
-                legal.any(-1),
-                jax.random.categorical(sub, logits, axis=-1),
-                cfg.num_points).astype(jnp.int32)
-            return (vstep(states, action), rng), None
-
-        (states, _), _ = jax.lax.scan(ply, (states, rng),
-                                      length=args.moves)
-        return states.step_count
-
     key = [jax.random.key(0)]
 
     def once():
         key[0], sub = jax.random.split(key[0])
-        return jax.device_get(run(sub))
+        states = random_game_states(cfg, batch, args.moves, sub)
+        return jax.device_get(states.step_count)
 
     dt = timed(once, reps=args.reps, profile_dir=args.profile)
     report("engine_steps", batch * args.moves / dt, "steps/s",

@@ -18,6 +18,7 @@ def main() -> None:
     from rocalphago_tpu.engine import pygo
     from rocalphago_tpu.models import CNNPolicy, CNNValue
     from rocalphago_tpu.search.mcts import MCTSPlayer
+    from rocalphago_tpu.search.players import reset_player
 
     ap = std_parser(__doc__)
     ap.add_argument("--playouts", type=int, default=64)
@@ -37,8 +38,7 @@ def main() -> None:
 
     t0 = time.time()
     for _ in range(args.reps):
-        player.mcts.reset()
-        player._tree_history = None
+        reset_player(player)
         player.get_move(state.copy())
     dt = (time.time() - t0) / args.reps
     report("mcts_playouts", args.playouts / dt, "sims/s",

@@ -12,14 +12,18 @@ import functools
 import sys
 
 sys.path.insert(0, ".")
-from benchmarks._harness import report, std_parser, timed  # noqa: E402
+from benchmarks._harness import (  # noqa: E402
+    random_game_states,
+    report,
+    std_parser,
+    timed,
+)
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
-    from rocalphago_tpu.engine.jaxgo import GoConfig, new_states, step
+    from rocalphago_tpu.engine.jaxgo import GoConfig
     from rocalphago_tpu.features import DEFAULT_FEATURES
     from rocalphago_tpu.features.planes import encode
 
@@ -28,30 +32,9 @@ def main() -> None:
                            else 32)
     cfg = GoConfig(size=args.board)
 
-    # build mid-game positions: 120 random-legal plies
-    vstep = jax.vmap(functools.partial(step, cfg))
-
-    @jax.jit
-    def fill(rng):
-        states = new_states(cfg, batch)
-
-        def ply(carry, _):
-            states, rng = carry
-            rng, sub = jax.random.split(rng)
-            from rocalphago_tpu.engine.jaxgo import legal_mask
-            legal = jax.vmap(
-                functools.partial(legal_mask, cfg))(states)[:, :-1]
-            logits = jnp.where(legal, 0.0, -1e30)
-            action = jax.random.categorical(sub, logits, axis=-1)
-            action = jnp.where(legal.any(-1), action,
-                               cfg.num_points).astype(jnp.int32)
-            return (vstep(states, action), rng), None
-
-        (states, _), _ = jax.lax.scan(ply, (states, rng),
-                                      length=120)
-        return states
-
-    states = jax.block_until_ready(fill(jax.random.key(0)))
+    # mid-game positions: 120 random-legal plies
+    states = jax.block_until_ready(
+        random_game_states(cfg, batch, 120, jax.random.key(0)))
     enc = jax.jit(jax.vmap(
         functools.partial(encode, cfg, features=DEFAULT_FEATURES)))
 

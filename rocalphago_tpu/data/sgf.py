@@ -99,7 +99,8 @@ def parse(text: str) -> SGFGame:
         if tok == ";" or skip_depth is not None:
             continue
         ident = m.group(1).upper()
-        values = [v.group(1).replace("\\]", "]")
+        # SGF escaping: backslash makes the next char literal
+        values = [re.sub(r"\\(.)", r"\1", v.group(1), flags=re.DOTALL)
                   for v in _VALUE.finditer(m.group(2))]
         seen_props.append((ident, values))
     if not seen_props:
@@ -173,9 +174,20 @@ def render(game: SGFGame, app: str = "rocalphago_tpu") -> str:
         x, y = p
         return f"{_LETTERS[y]}{_LETTERS[x]}"
 
+    def esc(val) -> str:
+        return str(val).replace("\\", "\\\\").replace("]", "\\]")
+
+    # only game-info properties belong in the root node; parse()
+    # collects unhandled props from every node, so unknown keys (e.g.
+    # per-move C comments) must not be relocated here
+    root_props = ("PB", "PW", "PL", "GN", "DT", "EV", "RO", "SO", "US",
+                  "AN", "CP", "GC", "RU", "TM", "OT", "CA", "ST", "HA")
     parts = [f"(;GM[1]FF[4]AP[{app}]SZ[{game.size}]KM[{game.komi}]"]
     if game.result:
-        parts.append(f"RE[{game.result}]")
+        parts.append(f"RE[{esc(game.result)}]")
+    for key in root_props:
+        if key in game.properties:
+            parts.append(f"{key}[{esc(game.properties[key])}]")
     if game.setup_black:
         parts.append("AB" + "".join(f"[{pt(p)}]" for p in game.setup_black))
     if game.setup_white:

@@ -237,35 +237,50 @@ def from_pygo(cfg: GoConfig, st) -> GoState:
 def compute_labels(cfg: GoConfig, board: jax.Array) -> jax.Array:
     """Connected-component root (min flat index) per point; N for empty.
 
-    Iterative min-label propagation over same-color neighbors under
-    ``lax.while_loop``; converges in O(longest group diameter) cheap
-    [N,4] steps — XLA-friendly, no dynamic shapes (SURVEY.md §7 hard
-    part #1).
+    Min-label propagation over same-color neighbors as **2-D grid
+    shifts** (pad + static slice — vector ops the TPU executes at full
+    lane width, vs the index gathers of the naive formulation, which
+    serialize): each ``while_loop`` trip runs several unrolled hook
+    steps, then checks the fixed point, so convergence stays exact for
+    any group shape while the per-trip launch/cond overhead is
+    amortized ~8×. SURVEY.md §7 hard part #1.
     """
     n = cfg.num_points
-    nbrs = neighbors_for(cfg.size)
-    has_stone = board != 0
-    init = jnp.where(has_stone, jnp.arange(n, dtype=jnp.int32), n)
+    size = cfg.size
+    b2 = board.reshape(size, size)
+    stone = b2 != 0
+    sentinel = jnp.int32(n)
+    init = jnp.where(
+        stone, jnp.arange(n, dtype=jnp.int32).reshape(size, size),
+        sentinel)
 
-    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
-    same = (board_pad[nbrs] == board[:, None]) & has_stone[:, None] & (
-        nbrs < n)
+    def shifted(x, dx, dy, fill):
+        p = jnp.pad(x, 1, constant_values=fill)
+        return p[1 + dx:1 + dx + size, 1 + dy:1 + dy + size]
 
-    def body(labels):
-        lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
-        nbr_labels = jnp.where(same, lab_pad[nbrs], n)
-        return jnp.minimum(labels, nbr_labels.min(axis=1))
+    links = [(shifted(b2, dx, dy, 0) == b2) & stone
+             for dx, dy in ((1, 0), (-1, 0), (0, 1), (0, -1))]
+
+    def hook(lab):
+        for link, (dx, dy) in zip(links, ((1, 0), (-1, 0), (0, 1),
+                                          (0, -1))):
+            nb = shifted(lab, dx, dy, sentinel)
+            lab = jnp.minimum(lab, jnp.where(link, nb, sentinel))
+        return lab
+
+    def body(carry):
+        lab, _ = carry
+        new = lab
+        for _ in range(8):
+            new = hook(new)
+        return new, lab
 
     def cond(carry):
-        labels, prev = carry
-        return jnp.any(labels != prev)
+        lab, prev = carry
+        return jnp.any(lab != prev)
 
-    def step_fn(carry):
-        labels, _ = carry
-        return body(labels), labels
-
-    labels, _ = lax.while_loop(cond, step_fn, (body(init), init))
-    return labels
+    lab, _ = lax.while_loop(cond, body, (hook(init), init))
+    return lab.reshape(-1)
 
 
 def neighbor_analysis(cfg: GoConfig, board: jax.Array, labels: jax.Array):

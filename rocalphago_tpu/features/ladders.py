@@ -79,14 +79,18 @@ def _prey_libs(cfg: GoConfig, board, prey_pt):
     return jnp.where(board[prey_pt] == 0, 0, libs), gd
 
 
-def _escaper_response(cfg: GoConfig, board, prey_pt, prey_color):
+def _escaper_response(cfg: GoConfig, board, prey_pt, prey_color,
+                      libs0=None, gd=None):
     """Best forced response of a prey in atari: extend at the last
     liberty or counter-capture an adjacent chasing group in atari.
     Returns (libs_after_best, board_after_best); libs -1 if no legal
-    response exists."""
+    response exists. Pass ``(libs0, gd)`` when the caller already
+    analyzed ``board`` — each dropped ``group_data`` call removes a
+    full flood fill from the sequential ladder read."""
     n = cfg.num_points
     nbrs = neighbors_for(cfg.size)
-    libs0, gd = _prey_libs(cfg, board, prey_pt)
+    if gd is None:
+        libs0, gd = _prey_libs(cfg, board, prey_pt)
     lab_pad = jnp.concatenate([gd.labels, jnp.full((1,), n, jnp.int32)])
     root = gd.labels[prey_pt]
     empty = board == 0
@@ -130,8 +134,9 @@ def _chase(cfg: GoConfig, board0, prey_pt, depth: int) -> jax.Array:
         """Chaser fills ``lib_pt``; returns (outcome, board after the
         escaper's forced response)."""
         b1, ok = _place(cfg, board, gd, lib_pt, -prey_color)
-        preyL, _ = _prey_libs(cfg, b1, prey_pt)
-        respL, b2 = _escaper_response(cfg, b1, prey_pt, prey_color)
+        preyL, gd1 = _prey_libs(cfg, b1, prey_pt)
+        respL, b2 = _escaper_response(cfg, b1, prey_pt, prey_color,
+                                      libs0=preyL, gd=gd1)
         resp_logic = jnp.where(
             respL <= 1, _CAPTURED,
             jnp.where(respL >= 3, _ESCAPED, _CONTINUE))
@@ -213,7 +218,9 @@ def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
     def lane(mv, pr, ok):
         board1, placed = _place(cfg, state.board, gd, mv, me)
         # prey is now in atari; its forced response decides the opening
-        respL, board2 = _escaper_response(cfg, board1, pr, -me)
+        libs1, gd1 = _prey_libs(cfg, board1, pr)
+        respL, board2 = _escaper_response(cfg, board1, pr, -me,
+                                          libs0=libs1, gd=gd1)
         captured = jnp.where(
             respL <= 1, True,
             jnp.where(respL >= 3, False, _chase(cfg, board2, pr, depth)))

@@ -110,14 +110,26 @@ def _one_hot8(value: jax.Array, lo: int, active: jax.Array) -> jax.Array:
             * active[:, None].astype(jnp.float32))
 
 
+def needs_member(features: tuple) -> bool:
+    """Whether these features require ``group_data(with_member=True)``
+    (the candidate-simulation planes) — callers precomputing a shared
+    ``gd`` for :func:`encode` must match this."""
+    return any(f in ("capture_size", "self_atari_size",
+                     "liberties_after") for f in features)
+
+
 def encode(cfg: GoConfig, state: GoState,
            features: tuple = None,
            ladder_depth: int = 40,
-           ladder_lanes: int = 16) -> jax.Array:
+           ladder_lanes: int = 16,
+           gd: "GroupData | None" = None) -> jax.Array:
     """Encode one game state → float32 ``[size, size, F]`` (NHWC).
 
     ``features`` is a tuple of plane-group names (static under jit);
-    default is the full 48-plane AlphaGo set.
+    default is the full 48-plane AlphaGo set. Pass a precomputed ``gd``
+    (built with ``with_member`` if the candidate-simulation planes are
+    requested) to share one flood fill with the caller's own analysis
+    — the self-play ply does this (encode + sensibleness per ply).
     """
     from rocalphago_tpu.features import ladders as _ladders
     from rocalphago_tpu.features.pyfeatures import (
@@ -132,10 +144,10 @@ def encode(cfg: GoConfig, state: GoState,
     empty = board == 0
     has_stone = ~empty
 
-    need_member = any(f in ("capture_size", "self_atari_size",
-                            "liberties_after") for f in features)
-    gd = group_data(cfg, board, with_member=need_member,
-                    with_zxor=cfg.enforce_superko)
+    need_member = needs_member(features)
+    if gd is None:
+        gd = group_data(cfg, board, with_member=need_member,
+                        with_zxor=cfg.enforce_superko)
     ci = None
     if need_member:
         ci = candidate_info(cfg, state, gd)

@@ -1,0 +1,123 @@
+"""Batched on-device self-play → SGF records CLI.
+
+The reference's self-play lives inside its RL trainer; the rebuild
+additionally exposes it standalone (SURVEY.md §7 package layout,
+"selfplay CLI"): play N lockstep games entirely on device with any
+saved policy (optionally vs a second policy), then write one SGF per
+game plus a JSONL summary — inspectable in any SGF viewer, replayable
+by the converter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+from rocalphago_tpu.data import sgf
+from rocalphago_tpu.engine import jaxgo
+from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.search.selfplay import make_selfplay
+
+
+def result_strings(cfg, final_states) -> list:
+    """SGF RE values ("B+7.5" area-margin form) per game."""
+    b, w = jax.vmap(functools.partial(jaxgo.area_scores, cfg))(
+        final_states)
+    b, w = np.asarray(b, np.float64), np.asarray(w, np.float64)
+    out = []
+    for bi, wi in zip(b, w):
+        if bi > wi:
+            out.append(f"B+{bi - wi:g}")
+        elif wi > bi:
+            out.append(f"W+{wi - bi:g}")
+        else:
+            out.append("0")
+    return out
+
+
+def games_to_sgf(cfg, result, out_dir: str, prefix: str = "selfplay",
+                 black_name: str = "policy-a",
+                 white_name: str = "policy-b") -> list:
+    """Write one SGF per game from a ``SelfplayResult``."""
+    os.makedirs(out_dir, exist_ok=True)
+    actions = np.asarray(result.actions)     # [T, B]
+    live = np.asarray(result.live)           # [T, B]
+    n = cfg.num_points
+    res = result_strings(cfg, result.final)
+    paths = []
+    from rocalphago_tpu.engine import pygo
+
+    for g in range(actions.shape[1]):
+        moves = []
+        for t in range(actions.shape[0]):
+            if not live[t, g]:
+                break
+            a = int(actions[t, g])
+            color = pygo.BLACK if t % 2 == 0 else pygo.WHITE
+            moves.append(
+                (color, None if a >= n else divmod(a, cfg.size)))
+        game = sgf.from_moves(cfg.size, cfg.komi, moves, result=res[g])
+        game.properties["PB"] = black_name
+        game.properties["PW"] = white_name
+        path = os.path.join(out_dir, f"{prefix}-{g:05d}.sgf")
+        with open(path, "w") as f:
+            f.write(sgf.render(game))
+        paths.append(path)
+    return paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Play batched on-device self-play games, save SGFs")
+    ap.add_argument("--policy", required=True, help="policy model JSON")
+    ap.add_argument("--opponent", default=None,
+                    help="optional second policy JSON (default: self)")
+    ap.add_argument("--games", type=int, default=16)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--max-moves", type=int, default=500)
+    ap.add_argument("--temperature", type=float, default=0.67)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-sgf", action="store_true",
+                    help="summary only (skip SGF files)")
+    a = ap.parse_args(argv)
+    if a.games % 2:
+        raise SystemExit("--games must be even (color split)")
+
+    net = NeuralNetBase.load_model(a.policy)
+    opp = NeuralNetBase.load_model(a.opponent) if a.opponent else net
+    cfg = net.cfg
+    run = make_selfplay(cfg, net.feature_list, net.module.apply,
+                        opp.module.apply, batch=a.games,
+                        max_moves=a.max_moves, temperature=a.temperature)
+    result = run(net.params, opp.params, jax.random.key(a.seed))
+    jax.device_get(result.winners)
+
+    winners = np.asarray(result.winners)
+    summary = {
+        "games": a.games,
+        "black_wins": int((winners > 0).sum()),
+        "white_wins": int((winners < 0).sum()),
+        "draws": int((winners == 0).sum()),
+        "mean_moves": float(np.asarray(result.num_moves).mean()),
+    }
+    os.makedirs(a.out, exist_ok=True)
+    if not a.no_sgf:
+        paths = games_to_sgf(
+            cfg, result, a.out,
+            black_name=os.path.basename(a.policy),
+            white_name=os.path.basename(a.opponent or a.policy))
+        summary["sgf_files"] = len(paths)
+    with open(os.path.join(a.out, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

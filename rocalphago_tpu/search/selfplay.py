@@ -40,13 +40,16 @@ from rocalphago_tpu.engine.jaxgo import (
     step,
     winner,
 )
-from rocalphago_tpu.features.planes import encode, true_eyes
+from rocalphago_tpu.features.planes import encode, needs_member, true_eyes
 
 
-def sensible_mask(cfg: GoConfig, state: GoState) -> jax.Array:
+def sensible_mask(cfg: GoConfig, state: GoState,
+                  gd=None) -> jax.Array:
     """bool [N]: legal board moves that do not fill an own true eye
-    (the reference's ``get_legal_moves(include_eyes=False)``)."""
-    gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko)
+    (the reference's ``get_legal_moves(include_eyes=False)``).
+    Pass a precomputed ``gd`` to share the flood fill."""
+    if gd is None:
+        gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko)
     legal = legal_mask(cfg, state, gd)[:-1]
     return legal & ~true_eyes(cfg, state, state.turn)
 
@@ -84,14 +87,21 @@ def play_games(cfg: GoConfig, features: tuple,
             f"batch must be even (half-and-half color split), got {batch}")
     n = cfg.num_points
     states = new_states(cfg, batch)
-    enc = jax.vmap(functools.partial(encode, cfg, features=features))
+    vgd = jax.vmap(lambda board: group_data(
+        cfg, board, with_member=needs_member(features),
+        with_zxor=cfg.enforce_superko))
+    enc = jax.vmap(
+        lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
 
     def ply(carry, t):
         states, rng = carry
         rng, sub = jax.random.split(rng)
-        planes = enc(states)
+        # one flood fill per ply, shared by the encoder and the
+        # sensibleness mask
+        gd = vgd(states.board)
+        planes = enc(states, gd)
         # which half faces net A this ply (see module docstring)
         swap = (t % 2) == 1
         rolled = _half_swap(planes, swap)
@@ -101,7 +111,7 @@ def play_games(cfg: GoConfig, features: tuple,
         logits = _half_swap(
             jnp.concatenate([logits_a, logits_b], axis=0), swap)
 
-        sens = vsens(states)                              # bool [B, N]
+        sens = vsens(states, gd)                          # bool [B, N]
         neg = jnp.finfo(logits.dtype).min
         masked = jnp.where(sens, logits / temperature, neg)
         board_action = jax.random.categorical(sub, masked, axis=-1)

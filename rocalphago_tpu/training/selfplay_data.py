@@ -68,12 +68,16 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
     preprocess. ``u_max`` caps the random ply U (default
     ``max_moves - 2`` so the recorded position can exist).
     """
-    from rocalphago_tpu.features.planes import encode
+    from rocalphago_tpu.features.planes import encode, needs_member
 
     n = cfg.num_points
     u_cap = min(u_max if u_max is not None else max_moves - 2,
                 max_moves - 2)
-    enc = jax.vmap(functools.partial(encode, cfg, features=features))
+    vgd = jax.vmap(lambda board: jaxgo.group_data(
+        cfg, board, with_member=needs_member(features),
+        with_zxor=cfg.enforce_superko))
+    enc = jax.vmap(
+        lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
 
@@ -94,8 +98,9 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
         rec = _snapshot(hit, states, rec)
         recorded = recorded | hit
 
-        planes = enc(states)
-        sens = vsens(states)
+        gd = vgd(states.board)
+        planes = enc(states, gd)
+        sens = vsens(states, gd)
         neg = jnp.finfo(jnp.float32).min
         logits_sl = apply_sl(params_sl, planes)
         logits_rl = apply_rl(params_rl, planes)

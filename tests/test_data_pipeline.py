@@ -28,6 +28,21 @@ class TestSGF:
         assert g2.moves == g.moves
         assert g2.size == g.size
 
+    def test_render_escapes_property_values(self):
+        g = sgflib.from_moves(5, 5.5, [(pygo.BLACK, (2, 2))], "B+R")
+        g.properties["PB"] = "net]weird\\name"
+        g2 = sgflib.parse(sgflib.render(g))
+        assert g2.properties["PB"] == "net]weird\\name"
+        assert g2.moves == g.moves
+
+    def test_render_keeps_move_comments_out_of_root(self):
+        text = ("(;GM[1]FF[4]SZ[5]KM[5.5]RE[B+R]"
+                ";B[cc]C[a move comment];W[dd])")
+        g = sgflib.parse(text)
+        rendered = sgflib.render(g)
+        assert "a move comment" not in rendered  # not relocated to root
+        assert sgflib.parse(rendered).moves == g.moves
+
     def test_replay_yields_states_before_moves(self):
         g = sgflib.parse(open(os.path.join(DATA, "game0.sgf")).read())
         steps = 0
