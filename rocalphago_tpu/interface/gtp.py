@@ -310,7 +310,7 @@ def make_player(args):
                             args.rollout, temperature=args.temperature,
                             playouts=args.playouts,
                             leaf_batch=args.leaf_batch,
-                            lmbda=args.lmbda)
+                            lmbda=args.lmbda, symmetric=args.symmetric)
     except ValueError as e:
         raise SystemExit(str(e))
 
@@ -329,6 +329,8 @@ def main(argv=None):
     ap.add_argument("--lmbda", type=float, default=0.5)
     ap.add_argument("--playouts", type=int, default=100)
     ap.add_argument("--leaf-batch", type=int, default=8)
+    ap.add_argument("--symmetric", action="store_true",
+                    help="ensemble evals over the 8 board symmetries")
     a = ap.parse_args(argv)
     run_gtp(make_player(a))
 

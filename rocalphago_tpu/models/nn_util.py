@@ -116,6 +116,24 @@ class NeuralNetBase:
         """Jitted apply on encoded planes ``[B, s, s, F]``."""
         return self._apply(self.params, planes)
 
+    def forward_symmetric(self, planes: jax.Array) -> jax.Array:
+        """Dihedral-ensembled forward (the AlphaGo paper's
+        evaluation-time symmetry averaging): run all 8 transforms,
+        map each output back, average. Subclasses define the mapping
+        via ``_symmetric_spec``."""
+        if getattr(self, "_apply_sym", None) is None:
+            per_transform, finalize = self._symmetric_spec()
+            self._apply_sym = jax.jit(make_symmetric_forward(
+                self.module.apply, per_transform, finalize))
+        return self._apply_sym(self.params, planes)
+
+    def _symmetric_spec(self):
+        """(per_transform(out, t), finalize(mean)) for
+        :func:`make_symmetric_forward`; override per output type."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support symmetry "
+            "ensembling")
+
     def _states_to_planes(self, states) -> jax.Array:
         """Host ``pygo.GameState`` list / single device ``GoState`` /
         batched ``GoState`` / list of either → ``[B, s, s, F]``."""
@@ -196,6 +214,25 @@ class NeuralNetBase:
     @staticmethod
     def create_network(**kwargs):
         raise NotImplementedError
+
+
+def make_symmetric_forward(apply_fn, per_transform=None, finalize=None):
+    """``(params, planes [B,s,s,F]) -> ensembled output``: transform
+    the batch by each of the 8 dihedral group elements, apply the net,
+    map each output back with ``per_transform(out, t)``, average, then
+    ``finalize(mean)``."""
+    from rocalphago_tpu.training.symmetries import transform_planes
+
+    def sym(params, planes):
+        def one(t):
+            tp = jax.vmap(lambda x: transform_planes(x, t))(planes)
+            out = apply_fn(params, tp)
+            return per_transform(out, t) if per_transform else out
+
+        mean = jax.vmap(one)(jnp.arange(8)).mean(axis=0)
+        return finalize(mean) if finalize else mean
+
+    return sym
 
 
 @functools.partial(jax.jit, static_argnames=("temperature_is_one",))

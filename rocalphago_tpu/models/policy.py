@@ -67,6 +67,28 @@ class CNNPolicy(NeuralNetBase):
                          filter_width_1=filter_width_1,
                          filter_width_K=filter_width_K)
 
+    # ------------------------------------------------ symmetry ensemble
+
+    def _symmetric_spec(self):
+        """Inverse-map the point probabilities of each transform, then
+        return ``log p̄`` — which behaves as logits under the masked
+        softmax (renormalizing over the legal support recovers the
+        averaged distribution)."""
+        from rocalphago_tpu.training.symmetries import (
+            inverse_transform_planes,
+        )
+
+        s = self.board
+
+        def per_transform(logits, t):
+            probs = jax.nn.softmax(logits, axis=-1)
+            grids = probs.reshape(-1, s, s, 1)
+            inv = jax.vmap(
+                lambda g: inverse_transform_planes(g, t))(grids)
+            return inv.reshape(-1, s * s)
+
+        return per_transform, lambda mean: jnp.log(mean + 1e-30)
+
     # -------------------------------------------------- host-facing eval
 
     def eval_state(self, state, moves=None):
@@ -79,17 +101,20 @@ class CNNPolicy(NeuralNetBase):
         return self.batch_eval_state(
             [state], [moves] if moves is not None else None)[0]
 
-    def batch_eval_state(self, states, moves_lists=None):
+    def batch_eval_state(self, states, moves_lists=None,
+                         symmetric: bool = False):
         """Lockstep evaluation of many states: one forward and one
         masked-softmax device call for the whole batch.
 
         ``moves_lists[i]``, when given, becomes the support for state
         ``i`` verbatim (callers pass pre-computed legal/sensible
         subsets; re-deriving legality here would double the host cost
-        of the search hot path)."""
+        of the search hot path). ``symmetric`` ensembles the forward
+        over the 8 board symmetries (8× device work)."""
         states = self._as_state_list(states)
         planes = self._states_to_planes(states)
-        logits = self.forward(planes)
+        logits = self.forward_symmetric(planes) if symmetric \
+            else self.forward(planes)
         sizes, legal_rows = [], []
         for i, state in enumerate(states):
             size = state.size if isinstance(state, pygo.GameState) \

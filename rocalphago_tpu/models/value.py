@@ -60,12 +60,17 @@ class CNNValue(NeuralNetBase):
                         filter_width_K=filter_width_K,
                         dense_units=dense_units)
 
-    def eval_state(self, state) -> float:
+    def _symmetric_spec(self):
+        """The scalar value needs no inverse mapping — plain mean."""
+        return None, None
+
+    def eval_state(self, state, symmetric: bool = False) -> float:
         """Expected outcome of one state from the player to move's
         perspective, in [-1, 1]."""
-        planes = self._states_to_planes(state)
-        return float(np.asarray(self.forward(planes))[0])
+        return float(self.batch_eval_state([state], symmetric)[0])
 
-    def batch_eval_state(self, states) -> np.ndarray:
+    def batch_eval_state(self, states,
+                         symmetric: bool = False) -> np.ndarray:
         planes = self._states_to_planes(self._as_state_list(states))
-        return np.asarray(self.forward(planes))
+        fwd = self.forward_symmetric if symmetric else self.forward
+        return np.asarray(fwd(planes))

@@ -298,22 +298,25 @@ class ParallelMCTS(MCTS):
 
 
 def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
-                 rng=None):
+                 rng=None, symmetric: bool = False):
     """Batch callables for :class:`ParallelMCTS` from the framework
     nets: one jitted forward per net per wave.
 
     ``rollout`` (a fast policy net — or the SL policy itself, as the
     reference does when no rollout net is trained) drives lockstep
-    batched playouts-to-terminal on host rules.
+    batched playouts-to-terminal on host rules. ``symmetric``
+    ensembles priors/values over the 8 board symmetries (AlphaGo's
+    evaluation-time averaging; 8× eval cost, rollouts excluded).
     """
     rng = rng or np.random.default_rng(0)
 
     def batch_policy(states):
         sensible = [s.get_legal_moves(include_eyes=False) for s in states]
-        return policy.batch_eval_state(states, sensible)
+        return policy.batch_eval_state(states, sensible,
+                                       symmetric=symmetric)
 
     def batch_value(states):
-        return value.batch_eval_state(states)
+        return value.batch_eval_state(states, symmetric=symmetric)
 
     rollout_net = rollout or policy
 
@@ -361,10 +364,12 @@ class MCTSPlayer:
     def __init__(self, value, policy, rollout=None, lmbda: float = 0.5,
                  c_puct: float = 5.0, rollout_limit: int = 500,
                  playout_depth: int = 20, n_playout: int = 100,
-                 leaf_batch: int = 8, seed: int | None = None):
+                 leaf_batch: int = 8, seed: int | None = None,
+                 symmetric: bool = False):
         rng = np.random.default_rng(seed)
         bv, bp, br = net_backends(policy, value, rollout,
-                                  rollout_limit=rollout_limit, rng=rng)
+                                  rollout_limit=rollout_limit, rng=rng,
+                                  symmetric=symmetric)
         self.mcts = ParallelMCTS(bv, bp, br, lmbda=lmbda, c_puct=c_puct,
                                  rollout_limit=rollout_limit,
                                  playout_depth=playout_depth,

@@ -29,10 +29,11 @@ class GreedyPolicyPlayer:
     """Plays the policy's argmax move over sensible legal moves."""
 
     def __init__(self, policy: CNNPolicy, pass_when_offered: bool = False,
-                 move_limit: int | None = None):
+                 move_limit: int | None = None, symmetric: bool = False):
         self.policy = policy
         self.pass_when_offered = pass_when_offered
         self.move_limit = move_limit
+        self.symmetric = symmetric
 
     def get_move(self, state):
         return self.get_moves([state])[0]
@@ -51,7 +52,8 @@ class GreedyPolicyPlayer:
                 moves_lists.append(sensible)
         if not live:
             return out
-        dists = self.policy.batch_eval_state(live, moves_lists)
+        dists = self.policy.batch_eval_state(live, moves_lists,
+                                             symmetric=self.symmetric)
         for i, dist in zip(idx, dists):
             if dist:
                 out[i] = max(dist, key=lambda mp: mp[1])[0]
@@ -64,11 +66,13 @@ class ProbabilisticPolicyPlayer:
 
     def __init__(self, policy: CNNPolicy, temperature: float = 1.0,
                  seed: int | None = None, move_limit: int | None = 500,
-                 greedy_start: int | None = None):
+                 greedy_start: int | None = None,
+                 symmetric: bool = False):
         self.policy = policy
         self.temperature = float(temperature)
         self.move_limit = move_limit
         self.greedy_start = greedy_start
+        self.symmetric = symmetric
         self.rng = np.random.default_rng(seed)
 
     def get_move(self, state):
@@ -85,7 +89,8 @@ class ProbabilisticPolicyPlayer:
                 moves_lists.append(sensible)
         if not live:
             return out
-        dists = self.policy.batch_eval_state(live, moves_lists)
+        dists = self.policy.batch_eval_state(live, moves_lists,
+                                             symmetric=self.symmetric)
         for k, (i, dist) in enumerate(zip(idx, dists)):
             if not dist:
                 continue
@@ -106,7 +111,7 @@ class ProbabilisticPolicyPlayer:
 def build_player(kind: str, policy_path: str, value_path: str | None = None,
                  rollout_path: str | None = None, temperature: float = 0.67,
                  playouts: int = 100, leaf_batch: int = 8,
-                 lmbda: float = 0.5):
+                 lmbda: float = 0.5, symmetric: bool = False):
     """One agent factory for every CLI (GTP, tournament): build a
     ``greedy`` / ``probabilistic`` / ``mcts`` player from saved model
     specs."""
@@ -114,9 +119,10 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
 
     policy = NeuralNetBase.load_model(policy_path)
     if kind == "greedy":
-        return GreedyPolicyPlayer(policy)
+        return GreedyPolicyPlayer(policy, symmetric=symmetric)
     if kind == "probabilistic":
-        return ProbabilisticPolicyPlayer(policy, temperature=temperature)
+        return ProbabilisticPolicyPlayer(policy, temperature=temperature,
+                                         symmetric=symmetric)
     if kind == "mcts":
         from rocalphago_tpu.search.mcts import MCTSPlayer
 
@@ -126,7 +132,8 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
         rollout = NeuralNetBase.load_model(rollout_path) \
             if rollout_path else None
         return MCTSPlayer(value, policy, rollout=rollout, lmbda=lmbda,
-                          n_playout=playouts, leaf_batch=leaf_batch)
+                          n_playout=playouts, leaf_batch=leaf_batch,
+                          symmetric=symmetric)
     raise ValueError(f"unknown player kind {kind!r}")
 
 

@@ -107,3 +107,68 @@ def test_unknown_class_rejected(tmp_path):
         {"class": "NoSuchNet", "feature_list": ["board"], "board": 7}))
     with pytest.raises(ValueError, match="unknown network class"):
         NeuralNetBase.load_model(str(path))
+
+
+class TestSymmetricForward:
+    """AlphaGo-style evaluation-time dihedral ensembling."""
+
+    def test_policy_symmetric_distribution_is_invariant(self):
+        """The ensembled move distribution of a transformed board must
+        be the transform of the original's distribution."""
+        import jax
+        import jax.numpy as jnp
+        from rocalphago_tpu.training.symmetries import (
+            transform_action,
+            transform_planes,
+        )
+
+        size = 5
+        net = CNNPolicy(("board", "ones"), board=size, layers=2,
+                        filters_per_layer=4)
+        planes = jax.random.uniform(
+            jax.random.key(0),
+            (1, size, size, net.preprocess.output_dim))
+        base = np.asarray(
+            jax.nn.softmax(net.forward_symmetric(planes), -1))[0]
+        for t in range(8):
+            tp = transform_planes(planes[0], jnp.int32(t))[None]
+            got = np.asarray(
+                jax.nn.softmax(net.forward_symmetric(tp), -1))[0]
+            # probability of each point must follow it around the board
+            perm = np.asarray(jax.vmap(
+                lambda a: transform_action(a, jnp.int32(t), size))(
+                jnp.arange(size * size)))
+            np.testing.assert_allclose(got[perm], base, rtol=2e-2,
+                                       atol=1e-4)
+
+    def test_value_symmetric_is_invariant(self):
+        import jax
+        import jax.numpy as jnp
+        from rocalphago_tpu.training.symmetries import transform_planes
+
+        size = 5
+        net = CNNValue(("board", "ones"), board=size, layers=2,
+                       filters_per_layer=4, dense_units=8)
+        planes = jax.random.uniform(
+            jax.random.key(1),
+            (1, size, size, net.preprocess.output_dim))
+        base = float(net.forward_symmetric(planes)[0])
+        for t in range(8):
+            tp = transform_planes(planes[0], jnp.int32(t))[None]
+            assert float(net.forward_symmetric(tp)[0]) == \
+                pytest.approx(base, rel=2e-2, abs=1e-3)
+
+    def test_mcts_player_accepts_symmetric_flag(self):
+        from rocalphago_tpu.engine import pygo
+        from rocalphago_tpu.search.mcts import MCTSPlayer
+
+        policy = CNNPolicy(("board", "ones"), board=5, layers=2,
+                           filters_per_layer=4)
+        value = CNNValue(("board", "ones"), board=5, layers=2,
+                         filters_per_layer=4, dense_units=8)
+        player = MCTSPlayer(value, policy, lmbda=0.0, n_playout=6,
+                            leaf_batch=3, playout_depth=3, seed=0,
+                            symmetric=True)
+        state = pygo.GameState(size=5)
+        move = player.get_move(state)
+        assert state.is_legal(move)
