@@ -43,11 +43,15 @@ def main() -> None:
     res = run(net.params, net.params, jax.random.key(0))
     jax.device_get(res.winners)
 
-    reps = 3
-    t0 = time.time()
-    for r in range(1, reps + 1):
+    # adaptive reps: stop once ~2 minutes of measurement accumulate so
+    # the driver's round-end run always completes
+    reps, t0 = 0, time.time()
+    for r in range(1, 4):
         res = run(net.params, net.params, jax.random.key(r))
         jax.device_get(res.winners)
+        reps = r
+        if time.time() - t0 > 120:
+            break
     dt = (time.time() - t0) / reps
 
     games_per_min = batch / dt * 60.0

@@ -24,7 +24,7 @@ import warnings
 
 import numpy as np
 
-from rocalphago_tpu.data import sgf as sgflib
+from rocalphago_tpu.data import native, sgf as sgflib
 from rocalphago_tpu.engine import pygo
 from rocalphago_tpu.engine.jaxgo import GoConfig, GoState
 from rocalphago_tpu.features import DEFAULT_FEATURES, Preprocess
@@ -87,12 +87,16 @@ class GameConverter:
         Positions whose move is a pass are dropped unless
         ``include_passes`` (the policy output space is board points, as
         in the reference; pass handling lives at the agent layer).
+        Rules replay runs through the native C++ replayer when built
+        (exact pygo parity; see ``native/goreplay.cpp``), else pygo.
         """
         game = sgflib.parse(sgf_text)
         if game.size != self.board_size:
             raise sgflib.SGFError(
                 f"board size {game.size} != converter size "
                 f"{self.board_size}")
+        if native.available():
+            return self._convert_game_native(game, include_passes)
         n = self.cfg.num_points
         fields, actions = [], []
         for st, move, player in sgflib.replay(game):
@@ -101,13 +105,17 @@ class GameConverter:
             if player != st.current_player:
                 # out-of-turn move (free placement SGF) — skip position
                 continue
+            # snapshot with copies: pygo mutates stone_ages in place as
+            # the generator advances, so a view here would silently
+            # give every position the END-of-game ages (caught by the
+            # native-replayer differential test)
             fields.append((
-                np.asarray(st.board, np.int8).reshape(-1),
+                np.array(st.board, np.int8).reshape(-1),
                 np.int8(st.current_player),
                 np.int32(-1 if st.ko is None
                          else st.ko[0] * game.size + st.ko[1]),
                 np.int32(st.turns_played),
-                np.asarray(st.stone_ages, np.int32).reshape(-1),
+                np.array(st.stone_ages, np.int32).reshape(-1),
             ))
             actions.append(n if move is None
                            else move[0] * game.size + move[1])
@@ -117,6 +125,27 @@ class GameConverter:
                     np.zeros((0,), np.int32))
         return (self._encode_fields(fields),
                 np.asarray(actions, np.int32))
+
+    def _convert_game_native(self, game, include_passes: bool):
+        size = game.size
+        n = self.cfg.num_points
+        flat = lambda p: p[0] * size + p[1]  # noqa: E731
+        moves = np.asarray([n if mv is None else flat(mv)
+                            for _, mv in game.moves], np.int32)
+        colors = np.asarray([c for c, _ in game.moves], np.int8)
+        boards, to_move, kos, steps, ages = native.replay_arrays(
+            size, [flat(p) for p in game.setup_black],
+            [flat(p) for p in game.setup_white], moves, colors)
+        keep = [t for t in range(len(moves))
+                if (include_passes or moves[t] != n)
+                and colors[t] == to_move[t]]
+        if not keep:
+            return (np.zeros((0, size, size, self.pre.output_dim),
+                             np.uint8), np.zeros((0,), np.int32))
+        fields = [(boards[t], np.int8(to_move[t]), np.int32(kos[t]),
+                   np.int32(steps[t]), ages[t]) for t in keep]
+        return (self._encode_fields(fields),
+                np.asarray([moves[t] for t in keep], np.int32))
 
     # ------------------------------------------------------------- corpora
 

@@ -1,0 +1,139 @@
+"""ctypes binding for the native C++ game replayer.
+
+Builds ``native/goreplay.cpp`` with the system ``g++`` on first use
+(cached as ``native/libgoreplay.so``) and exposes
+:func:`replay_arrays`; every caller must handle :func:`available`
+being False (no compiler / unsupported platform) by falling back to
+the pure-Python ``pygo`` replay. See ``native/goreplay.cpp`` for
+parity notes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "goreplay.cpp")
+_LIB = os.path.join(_REPO, "native", "libgoreplay.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile to a temp path and atomically rename into place, so a
+    concurrent or killed build can never leave a truncated .so that
+    the mtime check would then trust forever."""
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            # stale/corrupt artifact (e.g. different arch) — rebuild once
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError:
+                return None
+        lib.go_replay.restype = ctypes.c_int
+        lib.go_replay.argtypes = [
+            ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int8), ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int8),
+            np.ctypeslib.ndpointer(np.int8),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class IllegalReplay(ValueError):
+    """A recorded move was illegal (ply index in ``.ply``)."""
+
+    def __init__(self, ply: int):
+        super().__init__(f"illegal move at ply {ply}")
+        self.ply = ply
+
+
+def replay_arrays(size: int, setup_black, setup_white, moves, colors):
+    """Replay a recorded game natively.
+
+    ``moves`` are flat actions (``size*size`` = pass), ``colors``
+    ±1 per ply. Returns pre-move snapshots
+    ``(boards int8 [T,N], to_move int8 [T], kos int32 [T],
+    steps int32 [T], ages int32 [T,N])``.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native replayer unavailable")
+    n = size * size
+    t = len(moves)
+    sb = np.ascontiguousarray(setup_black, np.int32).reshape(-1)
+    sw = np.ascontiguousarray(setup_white, np.int32).reshape(-1)
+    mv = np.ascontiguousarray(moves, np.int32).reshape(-1)
+    cl = np.ascontiguousarray(colors, np.int8).reshape(-1)
+    boards = np.empty((t, n), np.int8)
+    to_move = np.empty((t,), np.int8)
+    kos = np.empty((t,), np.int32)
+    steps = np.empty((t,), np.int32)
+    ages = np.empty((t, n), np.int32)
+    # ndpointer rejects zero-size views; give empties real storage
+    if t == 0:
+        boards = np.empty((1, n), np.int8)
+        to_move = np.empty((1,), np.int8)
+        kos = np.empty((1,), np.int32)
+        steps = np.empty((1,), np.int32)
+        ages = np.empty((1, n), np.int32)
+    rc = lib.go_replay(
+        size,
+        sb if sb.size else np.zeros(1, np.int32), sb.size,
+        sw if sw.size else np.zeros(1, np.int32), sw.size,
+        mv if mv.size else np.zeros(1, np.int32),
+        cl if cl.size else np.zeros(1, np.int8), t,
+        boards, to_move, kos, steps, ages)
+    if rc < 0:
+        raise IllegalReplay(-rc - 1)
+    return (boards[:t], to_move[:t], kos[:t], steps[:t], ages[:t])

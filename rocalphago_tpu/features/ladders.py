@@ -116,10 +116,17 @@ def _escaper_response(cfg: GoConfig, board, prey_pt, prey_color,
     return jnp.where(take1, L1, L2), jnp.where(take1, B1, B2)
 
 
-def _chase(cfg: GoConfig, board0, prey_pt, depth: int) -> jax.Array:
+def _chase(cfg: GoConfig, board0, prey_pt, depth: int,
+           enabled=True) -> jax.Array:
     """Chaser to move against a two-liberty prey; True if prey is
     ladder-captured. Each iteration = one full rung (chaser move +
-    forced escaper response)."""
+    forced escaper response).
+
+    ``enabled=False`` starts the loop already done — vital under
+    ``vmap`` over candidate lanes, where the while_loop runs until
+    EVERY lane converges: without the gate, empty/garbage lanes chase
+    to full ``depth`` on every call, making typical positions pay the
+    worst case."""
     n = cfg.num_points
     nbrs = neighbors_for(cfg.size)
     prey_color = board0[prey_pt].astype(jnp.int8)
@@ -178,9 +185,10 @@ def _chase(cfg: GoConfig, board0, prey_pt, depth: int) -> jax.Array:
             rung=c.rung + 1,
         )
 
-    init = Carry(board0, jnp.bool_(False), jnp.bool_(False), jnp.int32(0))
+    init = Carry(board0, ~jnp.asarray(enabled, jnp.bool_),
+                 jnp.bool_(False), jnp.int32(0))
     final = lax.while_loop(lambda c: ~c.done, body, init)
-    return final.captured
+    return final.captured & jnp.asarray(enabled, jnp.bool_)
 
 
 def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
@@ -221,9 +229,12 @@ def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
         libs1, gd1 = _prey_libs(cfg, board1, pr)
         respL, board2 = _escaper_response(cfg, board1, pr, -me,
                                           libs0=libs1, gd=gd1)
+        need_chase = ok & placed & (respL == 2)
         captured = jnp.where(
             respL <= 1, True,
-            jnp.where(respL >= 3, False, _chase(cfg, board2, pr, depth)))
+            jnp.where(respL >= 3, False,
+                      _chase(cfg, board2, pr, depth,
+                             enabled=need_chase)))
         return jnp.where(ok & placed, captured, False)
 
     captured = jax.vmap(lane)(move_pt, prey_pt, valid)
@@ -243,9 +254,12 @@ def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
     def lane(mv, pr, ok):
         board1, placed = _place(cfg, state.board, gd, mv, me)
         L, _ = _prey_libs(cfg, board1, pr)
+        need_chase = ok & placed & (L == 2)
         captured = jnp.where(
             L <= 1, True,
-            jnp.where(L >= 3, False, _chase(cfg, board1, pr, depth)))
+            jnp.where(L >= 3, False,
+                      _chase(cfg, board1, pr, depth,
+                             enabled=need_chase)))
         return jnp.where(ok & placed, ~captured, False)
 
     escaped = jax.vmap(lane)(move_pt, prey_pt, valid)

@@ -1,0 +1,95 @@
+"""Native C++ replayer: exact parity with the pygo oracle.
+
+The rebuild's native component (SURVEY.md §2a): rules bookkeeping for
+corpus conversion in C++, validated move-for-move against
+``engine.pygo`` on random games — the same oracle strategy the
+vectorized device engine is tested with.
+"""
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.data import native
+from rocalphago_tpu.data.convert import GameConverter
+from rocalphago_tpu.engine import pygo
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain")
+
+
+def random_game(size, seed, plies=50):
+    rng = np.random.default_rng(seed)
+    st = pygo.GameState(size=size, komi=5.5)
+    moves, colors, snaps = [], [], []
+    for _ in range(plies):
+        legal = st.get_legal_moves(include_eyes=True)
+        snaps.append((
+            np.asarray(st.board, np.int8).reshape(-1).copy(),
+            st.current_player,
+            -1 if st.ko is None else st.ko[0] * size + st.ko[1],
+            st.turns_played,
+            np.asarray(st.stone_ages, np.int32).reshape(-1).copy()))
+        mv = None if not legal or rng.random() < 0.05 \
+            else legal[rng.integers(len(legal))]
+        moves.append(size * size if mv is None
+                     else mv[0] * size + mv[1])
+        colors.append(st.current_player)
+        st.do_move(mv)
+        if st.is_end_of_game:
+            break
+    return moves[:len(snaps)], colors[:len(snaps)], snaps
+
+
+@pytest.mark.parametrize("size", [5, 9])
+def test_exact_parity_with_pygo(size):
+    for seed in range(10):
+        moves, colors, snaps = random_game(size, seed)
+        boards, to_move, kos, steps, ages = native.replay_arrays(
+            size, [], [], moves, colors)
+        for t, (b, p, ko, s, ag) in enumerate(snaps):
+            assert (boards[t] == b).all()
+            assert to_move[t] == p
+            assert kos[t] == ko
+            assert steps[t] == s
+            assert (ages[t] == ag).all()
+
+
+def test_illegal_move_reports_ply():
+    with pytest.raises(native.IllegalReplay) as e:
+        native.replay_arrays(5, [], [], [12, 12], [1, -1])
+    assert e.value.ply == 1
+
+
+def test_handicap_setup_matches_pygo():
+    size = 9
+    pts = [(2, 2), (6, 6)]
+    st = pygo.GameState(size=size)
+    st.place_handicaps(pts)
+    st.do_move((4, 4))  # white (handicap passes turn to white)
+    boards, to_move, _, steps, ages = native.replay_arrays(
+        size, [p[0] * size + p[1] for p in pts], [],
+        [4 * size + 4], [pygo.WHITE])
+    assert to_move[0] == pygo.WHITE
+    for p in pts:
+        assert boards[0][p[0] * size + p[1]] == pygo.BLACK
+        assert ages[0][p[0] * size + p[1]] == 0
+
+
+def test_converter_native_path_matches_pure(monkeypatch, tmp_path):
+    """convert_game must produce identical tensors with and without
+    the native replayer."""
+    from rocalphago_tpu.data import sgf as sgflib
+
+    moves, colors, _ = random_game(9, seed=3, plies=40)
+    game = sgflib.from_moves(
+        9, 5.5, [(c, None if m == 81 else divmod(m, 9))
+                 for c, m in zip(colors, moves)])
+    text = sgflib.render(game)
+
+    conv = GameConverter(("board", "ones", "turns_since", "liberties"),
+                         board_size=9)
+    s_native, a_native = conv.convert_game(text)
+    monkeypatch.setattr(native, "available", lambda: False)
+    s_pure, a_pure = conv.convert_game(text)
+    np.testing.assert_array_equal(a_native, a_pure)
+    np.testing.assert_array_equal(s_native, s_pure)

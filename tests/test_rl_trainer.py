@@ -14,6 +14,7 @@ import json
 import os
 
 import jax
+import jax.flatten_util  # noqa: F401 — used as jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 import optax

@@ -127,6 +127,7 @@ def test_kill_and_resume_is_bit_identical(corpus, tmp_path):
     (params, opt state, PRNG bits) and batch order is derived
     per-epoch, so preemption recovery is lossless."""
     import jax
+    import jax.flatten_util  # noqa: F401 — used as jax.flatten_util
 
     straight = SLTrainer(small_cfg(corpus, tmp_path / "a", epochs=2),
                          net=small_net())
