@@ -15,10 +15,20 @@ prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import jax
+
+# persistent XLA compile cache: repeat bench runs skip the 20-40s
+# first-compile cost of the big self-play program
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_comp_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+except Exception:  # noqa: BLE001 — older jax without the knobs
+    pass
 
 
 def main() -> None:
