@@ -10,34 +10,54 @@ prints ONE JSON line:
 ``vs_baseline`` is against the north-star target of 200 games/min on a
 16-chip v5e slice, prorated to the number of attached chips
 (BASELINE.md; the reference publishes no numbers of its own).
+
+Robustness contract (round-1 postmortem: one backend-init hiccup cost
+the whole round its perf story): the measurement runs in a CHILD
+process, the parent retries transient TPU-backend failures with
+backoff, falls back to a CPU measurement if the TPU never comes up,
+and on total failure still prints the JSON line (with an ``"error"``
+field) and exits 0.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import jax
-
-# persistent XLA compile cache: repeat bench runs skip the 20-40s
-# first-compile cost of the big self-play program
-try:
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.expanduser("~/.cache/jax_comp_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-except Exception:  # noqa: BLE001 — older jax without the knobs
-    pass
+METRIC = "selfplay_19x19_games_per_min"
+_CHILD_MARK = "_GRAFT_BENCH_CHILD"
+_CPU_MARK = "_GRAFT_BENCH_CPU"
 
 
-def main() -> None:
+def _measure() -> None:
+    """Child: run the benchmark on whatever backend the env selects."""
+    import jax
+
+    if os.environ.get(_CPU_MARK) == "1":
+        # env vars alone don't stick: sitecustomize re-pins
+        # jax_platforms at interpreter start (see tests/conftest.py),
+        # so the CPU fallback must override the config too
+        jax.config.update("jax_platforms", "cpu")
+
+    # persistent XLA compile cache: repeat bench runs skip the 20-40s
+    # first-compile cost of the big self-play program
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_comp_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:  # noqa: BLE001 — older jax without the knobs
+        pass
+
     from rocalphago_tpu.engine.jaxgo import GoConfig
     from rocalphago_tpu.models import CNNPolicy
     from rocalphago_tpu.search.selfplay import make_selfplay
 
     n_dev = len(jax.devices())
-    on_tpu = jax.devices()[0].platform == "tpu"
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
     batch = 128 if on_tpu else 16
     max_moves = 420 if on_tpu else 60
 
@@ -67,11 +87,79 @@ def main() -> None:
     games_per_min = batch / dt * 60.0
     target = 200.0 * (n_dev / 16.0)  # north star prorated per chip
     print(json.dumps({
-        "metric": "selfplay_19x19_games_per_min",
+        "metric": METRIC,
         "value": round(games_per_min, 2),
         "unit": "games/min",
         "vs_baseline": round(games_per_min / target, 3),
+        "platform": platform,
+        "n_devices": n_dev,
+        "batch": batch,
+        "max_moves": max_moves,
     }))
+
+
+def _run_child(extra_env: dict, timeout: float):
+    """Run the measurement child; return (parsed_json | None, err_str)."""
+    env = dict(os.environ)
+    env[_CHILD_MARK] = "1"
+    env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"child timed out after {timeout:.0f}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and parsed.get("metric") == METRIC:
+            return parsed, ""
+    tail = (proc.stderr or proc.stdout or "").strip()[-800:]
+    return None, f"rc={proc.returncode}: {tail}"
+
+
+def main() -> int:
+    if os.environ.get(_CHILD_MARK) == "1":
+        _measure()
+        return 0
+
+    cpu_env = {
+        _CPU_MARK: "1",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": " ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f),
+    }
+    # (env overrides, per-attempt timeout, backoff before the attempt);
+    # worst case (every attempt hangs to its timeout) stays under ~40
+    # minutes so the error JSON still lands inside a driver budget
+    attempts = [
+        ({}, 1200.0, 0.0),      # default backend (TPU when attached)
+        ({}, 600.0, 20.0),      # retry: transient UNAVAILABLE / contention
+        (cpu_env, 600.0, 0.0),  # last resort: measure on host CPU
+    ]
+    errors = []
+    for extra_env, timeout, backoff in attempts:
+        if backoff:
+            time.sleep(backoff)
+        parsed, err = _run_child(extra_env, timeout)
+        if parsed is not None:
+            print(json.dumps(parsed))
+            return 0
+        errors.append(err)
+        print(f"bench attempt failed: {err}", file=sys.stderr)
+
+    # never die without the JSON line
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "games/min",
+        "vs_baseline": 0.0,
+        "error": " | ".join(e[:200] for e in errors),
+    }))
+    return 0
 
 
 if __name__ == "__main__":

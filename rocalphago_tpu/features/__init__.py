@@ -14,5 +14,6 @@ from rocalphago_tpu.features.api import Preprocess  # noqa: F401
 from rocalphago_tpu.features.pyfeatures import (  # noqa: F401
     DEFAULT_FEATURES,
     FEATURE_PLANES,
+    VALUE_FEATURES,
     output_planes,
 )

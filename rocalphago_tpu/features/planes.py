@@ -189,6 +189,10 @@ def encode(cfg: GoConfig, state: GoState,
                 jnp.float32)[:, None]
         elif name == "zeros":
             f = jnp.zeros((n, 1), jnp.float32)
+        elif name == "color":
+            # AlphaGo's value-net 49th plane: 1 iff black to move
+            # (komi asymmetry; see pyfeatures module docstring)
+            f = jnp.broadcast_to((me == 1).astype(jnp.float32), (n, 1))
         else:
             raise KeyError(f"unknown feature {name!r}")
         out.append(f)

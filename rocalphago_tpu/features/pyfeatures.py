@@ -28,6 +28,12 @@ ladder_escape             1       move is a working ladder escape
 sensibleness              1       legal and does not fill own true eye
 zeros                     1       constant 0
 ========================  ======  =====================================
+
+One extra plane-group exists beyond the 48: ``color`` (1 plane,
+constant 1 when black is to move) — the AlphaGo *value* network's 49th
+input plane. Komi breaks color symmetry, so without it a value net
+cannot distinguish a position from its color-swapped mirror (outcomes
+differ by 2·komi). ``VALUE_FEATURES`` is the 49-plane value-net set.
 """
 
 from __future__ import annotations
@@ -42,11 +48,14 @@ DEFAULT_FEATURES = (
     "ladder_escape", "sensibleness", "zeros",
 )
 
+# the value net's 49-plane input: the 48 policy planes + player color
+VALUE_FEATURES = DEFAULT_FEATURES + ("color",)
+
 FEATURE_PLANES = {
     "board": 3, "ones": 1, "turns_since": 8, "liberties": 8,
     "capture_size": 8, "self_atari_size": 8, "liberties_after": 8,
     "ladder_capture": 1, "ladder_escape": 1, "sensibleness": 1,
-    "zeros": 1,
+    "zeros": 1, "color": 1,
 }
 
 
@@ -115,6 +124,8 @@ def state_to_planes(st: pygo.GameState,
                     f[x, y, 0] = 1.0
         elif name == "zeros":
             pass
+        elif name == "color":
+            f[:, :, 0] = 1.0 if me == pygo.BLACK else 0.0
         else:
             raise KeyError(f"unknown feature {name!r}")
         out.append(f)

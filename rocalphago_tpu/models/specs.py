@@ -14,7 +14,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from rocalphago_tpu.features import DEFAULT_FEATURES
+from rocalphago_tpu.features import DEFAULT_FEATURES, VALUE_FEATURES
 from rocalphago_tpu.models.policy import CNNPolicy
 from rocalphago_tpu.models.rollout import ROLLOUT_FEATURES, CNNRollout
 from rocalphago_tpu.models.value import CNNValue
@@ -33,9 +33,10 @@ def main(argv=None):
                     help="filters per conv layer (default 128; "
                          "rollout default 32)")
     ap.add_argument("--features", nargs="*", default=None,
-                    help=f"feature names (policy/value default: the "
-                         f"AlphaGo 48-plane set {', '.join(DEFAULT_FEATURES)}"
-                         f"; rollout default: {', '.join(ROLLOUT_FEATURES)})")
+                    help=f"feature names (policy default: the AlphaGo "
+                         f"48-plane set {', '.join(DEFAULT_FEATURES)}; "
+                         f"value default adds the 'color' plane (49); "
+                         f"rollout default: {', '.join(ROLLOUT_FEATURES)})")
     ap.add_argument("--seed", type=int, default=0)
     a = ap.parse_args(argv)
 
@@ -44,7 +45,7 @@ def main(argv=None):
         net = CNNPolicy(features, board=a.board, layers=a.layers,
                         filters_per_layer=a.filters or 128, seed=a.seed)
     elif a.kind == "value":
-        features = tuple(a.features) if a.features else DEFAULT_FEATURES
+        features = tuple(a.features) if a.features else VALUE_FEATURES
         net = CNNValue(features, board=a.board, layers=a.layers,
                        filters_per_layer=a.filters or 128, seed=a.seed)
     else:

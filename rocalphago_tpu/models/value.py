@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from rocalphago_tpu.features import VALUE_FEATURES
 from rocalphago_tpu.models.nn_util import ConvTrunk, NeuralNetBase, neuralnet
 
 
@@ -46,7 +47,16 @@ class ValueNet(nn.Module):
 
 @neuralnet
 class CNNValue(NeuralNetBase):
-    """Scalar position evaluator."""
+    """Scalar position evaluator.
+
+    Defaults to the 49-plane ``VALUE_FEATURES`` input (the 48 policy
+    planes + the player-color plane): komi breaks color symmetry, so
+    the color plane is what lets the net value a position differently
+    from its color-swapped mirror.
+    """
+
+    def __init__(self, feature_list=VALUE_FEATURES, **kwargs):
+        super().__init__(feature_list, **kwargs)
 
     @staticmethod
     def create_network(board: int = 19, input_planes: int = 49,

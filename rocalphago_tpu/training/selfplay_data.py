@@ -225,7 +225,8 @@ def run_generator(argv=None) -> dict:
     ap.add_argument("--n-positions", type=int, required=True)
     ap.add_argument("--value-features", nargs="*", default=None,
                     help="feature names for the recorded planes "
-                         "(default: the SL net's feature list)")
+                         "(default: the SL net's feature list + the "
+                         "'color' plane — the 49-plane value input)")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--max-moves", type=int, default=500)
     ap.add_argument("--temperature", type=float, default=1.0)
@@ -233,8 +234,12 @@ def run_generator(argv=None) -> dict:
     a = ap.parse_args(argv)
     sl = NeuralNetBase.load_model(a.sl_model_json)
     rl = NeuralNetBase.load_model(a.rl_model_json)
-    features = tuple(a.value_features) if a.value_features \
-        else sl.feature_list
+    if a.value_features:
+        features = tuple(a.value_features)
+    elif "color" in sl.feature_list:
+        features = sl.feature_list
+    else:
+        features = sl.feature_list + ("color",)
     gen = ValueDataGenerator(sl, rl, features, batch=a.batch,
                              max_moves=a.max_moves,
                              temperature=a.temperature)

@@ -12,13 +12,16 @@ from rocalphago_tpu.engine import jaxgo, pygo
 from rocalphago_tpu.engine.jaxgo import GoConfig
 from rocalphago_tpu.features import (
     DEFAULT_FEATURES,
+    VALUE_FEATURES,
     Preprocess,
     output_planes,
     pyfeatures,
 )
 from rocalphago_tpu.features import planes as jplanes
 
-NON_LADDER = tuple(f for f in DEFAULT_FEATURES
+# the 49-plane value set minus the ladder planes, so the random-game
+# differential covers the color plane too
+NON_LADDER = tuple(f for f in VALUE_FEATURES
                    if not f.startswith("ladder"))
 
 
@@ -120,6 +123,21 @@ class TestLadders:
 class TestAPI:
     def test_output_dim_default_is_48(self):
         assert output_planes(DEFAULT_FEATURES) == 48
+
+    def test_value_features_is_49(self):
+        assert output_planes(VALUE_FEATURES) == 49
+
+    def test_color_plane_tracks_player_to_move(self):
+        cfg = GoConfig(size=5)
+        pre = Preprocess(("color",), cfg=cfg)
+        pst = pygo.GameState(size=5)
+        t = np.asarray(pre.state_to_tensor(jaxgo.from_pygo(cfg, pst)))
+        assert t.all()          # black to move → all ones
+        pst.do_move((2, 2))
+        t = np.asarray(pre.state_to_tensor(jaxgo.from_pygo(cfg, pst)))
+        assert not t.any()      # white to move → all zeros
+        assert np.array_equal(
+            t[0], pyfeatures.state_to_planes(pst, ("color",)))
 
     def test_state_to_tensor_shapes(self):
         cfg = GoConfig(size=5)
