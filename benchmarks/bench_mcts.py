@@ -25,14 +25,24 @@ def main() -> None:
     ap.add_argument("--leaf-batch", type=int, default=16)
     ap.add_argument("--lmbda", type=float, default=0.0,
                     help="0 = value-net only (no rollouts)")
+    ap.add_argument("--device-rollout", action="store_true",
+                    help="rollouts as one on-device scan per wave "
+                         "(device_rollout_fn) instead of host rules")
+    ap.add_argument("--rollout-limit", type=int, default=500)
     args = ap.parse_args()
 
     policy = CNNPolicy(board=args.board, layers=12,
                        filters_per_layer=128)
     value = CNNValue(board=args.board, layers=12, filters_per_layer=128)
-    player = MCTSPlayer(value, policy, lmbda=args.lmbda,
+    rollout = None
+    if args.device_rollout:
+        from rocalphago_tpu.models import CNNRollout
+        rollout = CNNRollout(board=args.board)
+    player = MCTSPlayer(value, policy, rollout=rollout, lmbda=args.lmbda,
                         n_playout=args.playouts,
-                        leaf_batch=args.leaf_batch, seed=0)
+                        rollout_limit=args.rollout_limit,
+                        leaf_batch=args.leaf_batch, seed=0,
+                        device_rollout=args.device_rollout)
     state = pygo.GameState(size=args.board)
     player.get_move(state.copy())      # warmup/compile
 
@@ -43,7 +53,8 @@ def main() -> None:
     dt = (time.time() - t0) / args.reps
     report("mcts_playouts", args.playouts / dt, "sims/s",
            playouts=args.playouts, leaf_batch=args.leaf_batch,
-           board=args.board, lmbda=args.lmbda)
+           board=args.board, lmbda=args.lmbda,
+           device_rollout=args.device_rollout)
 
 
 if __name__ == "__main__":

@@ -82,10 +82,13 @@ def load_hdf5(path: str):
 
 
 def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
-                  path: str | None = None):
+                  path: str | None = None, write: bool = True):
     """Shuffled train/val/test index split; persisted to ``path`` (npz)
     so interrupted runs resume with the identical split (the
-    reference's ``shuffle.npz`` behavior)."""
+    reference's ``shuffle.npz`` behavior). ``write=False``
+    (non-coordinator processes) still reads an existing file but never
+    creates one — the permutation is a pure function of ``seed``, so
+    every process computes the identical split regardless."""
     if path is not None:
         try:
             z = np.load(path)
@@ -108,7 +111,7 @@ def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
     train = perm[:n_train]
     val = perm[n_train:n_train + n_val]
     test = perm[n_train + n_val:]
-    if path is not None:
+    if path is not None and write:
         np.savez(path, train=train, val=val, test=test)
     return train, val, test
 
@@ -116,7 +119,7 @@ def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
 def batch_iterator(dataset, indices: np.ndarray, batch_size: int,
                    rng: np.random.Generator, epochs: int | None = None,
                    drop_remainder: bool = True,
-                   shard_window: int | None = 4):
+                   shard_window: int | None = 4, skip: int = 0):
     """Yield host (states, actions) batches, reshuffling every epoch.
 
     Shuffling is two-level when the corpus spans many shards: shard
@@ -125,6 +128,11 @@ def batch_iterator(dataset, indices: np.ndarray, batch_size: int,
     touches shards the dataset cache holds resident (a global
     permutation would decompress nearly every shard per minibatch).
     ``shard_window=None`` restores the global permutation.
+
+    ``skip`` drops the first ``skip`` batches of the FIRST epoch only —
+    index arithmetic, no shard reads — the mid-epoch resume cursor:
+    with the same ``rng`` seed the epoch's batch order is reproduced
+    and the already-consumed prefix is skipped.
     """
     starts = getattr(dataset, "_starts", None)
     epoch = 0
@@ -142,7 +150,8 @@ def batch_iterator(dataset, indices: np.ndarray, batch_size: int,
             order = np.concatenate(chunks)
         end = (len(order) // batch_size) * batch_size if drop_remainder \
             else len(order)
-        for i in range(0, end, batch_size):
+        start = (skip * batch_size) if epoch == 0 else 0
+        for i in range(start, end, batch_size):
             yield dataset.gather(order[i:i + batch_size])
         epoch += 1
 

@@ -175,25 +175,27 @@ def new_states(cfg: GoConfig, batch: int) -> GoState:
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (batch,) + x.shape), one)
 
 
-def from_pygo(cfg: GoConfig, st) -> GoState:
+def from_pygo(cfg: GoConfig, st, *, with_history: bool = True) -> GoState:
     """Bridge a host-side :class:`pygo.GameState` into engine state.
 
     Used at the GTP/SGF boundary where positions are built move-by-move
     on the host. The position hash is recomputed from the board; the
     superko history carries the positions pygo recorded (up to
-    ``cfg.max_history``, most recent kept).
+    ``cfg.max_history``, most recent kept). ``with_history=False``
+    skips the history hashing (correct whenever
+    ``cfg.enforce_superko`` is off — e.g. the MCTS device-rollout
+    path, which converts whole leaf waves per call).
     """
-    n = cfg.num_points
     zob = _tables(cfg.size)[2]
     board = np.asarray(st.board, dtype=np.int8).reshape(-1)
 
     def pos_hash(flat_board):
         h = np.zeros(2, np.uint32)
-        for p in range(n):
-            if flat_board[p] == BLACK:
-                h ^= zob[p, 0]
-            elif flat_board[p] == WHITE:
-                h ^= zob[p, 1]
+        black_keys = zob[flat_board == BLACK, 0]
+        white_keys = zob[flat_board == WHITE, 1]
+        for keys in (black_keys, white_keys):
+            if len(keys):
+                h ^= np.bitwise_xor.reduce(keys, axis=0)
         return h
 
     # Place historical hashes so that the engine's future writes (at
@@ -202,11 +204,13 @@ def from_pygo(cfg: GoConfig, st) -> GoState:
     # ``(step_count - 1) % H``. ``_position_history`` is insertion-
     # ordered (dict), so the suffix really is the most recent positions.
     hist = np.zeros((cfg.max_history, 2), np.uint32)
-    seen = [np.frombuffer(b, dtype=np.int8)
-            for b in st._position_history.keys()]
-    recent = seen[-cfg.max_history:]
-    for i, flat in enumerate(reversed(recent)):
-        hist[(st.turns_played - 1 - i) % cfg.max_history] = pos_hash(flat)
+    if with_history:
+        seen = [np.frombuffer(b, dtype=np.int8)
+                for b in st._position_history.keys()]
+        recent = seen[-cfg.max_history:]
+        for i, flat in enumerate(reversed(recent)):
+            hist[(st.turns_played - 1 - i) % cfg.max_history] = \
+                pos_hash(flat)
 
     ko = -1 if st.ko is None else st.ko[0] * cfg.size + st.ko[1]
     passes = 0

@@ -70,11 +70,16 @@ class TrainCheckpointer:
 
 class MetadataWriter:
     """Append-per-epoch ``metadata.json`` (reference
-    ``MetadataWriterCallback`` parity — tooling reads this file)."""
+    ``MetadataWriterCallback`` parity — tooling reads this file).
 
-    def __init__(self, path: str, header: dict | None = None):
+    ``enabled=False`` (non-coordinator processes in a multi-host run)
+    keeps the in-memory record but never touches the filesystem."""
+
+    def __init__(self, path: str, header: dict | None = None,
+                 enabled: bool = True):
         self.path = path
-        if os.path.exists(path):
+        self.enabled = enabled
+        if enabled and os.path.exists(path):
             with open(path) as f:
                 self.data = json.load(f)
         else:
@@ -92,6 +97,8 @@ class MetadataWriter:
         self._flush()
 
     def _flush(self) -> None:
+        if not self.enabled:
+            return
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self.data, f, indent=2)

@@ -48,6 +48,15 @@ def distributed_init(coordinator: str | None = None,
         jax.distributed.initialize()
 
 
+def is_coordinator() -> bool:
+    """True on the process that owns artifact writes — metadata.json,
+    metrics.jsonl, weight exports, the persisted shuffle split. Orbax
+    checkpoint saves are NOT guarded by this: every process must
+    participate in a multi-host save (each holds addressable shards).
+    Single-process runs are always the coordinator."""
+    return jax.process_index() == 0
+
+
 def make_mesh(num_devices: int | None = None,
               model_parallel: int = 1) -> Mesh:
     """A ``(data, model)`` mesh over the first ``num_devices`` devices.

@@ -128,15 +128,18 @@ class MCTS:
 
     # ---------------------------------------------------------- playouts
 
-    def _descend(self, state):
+    def _descend(self, state, path: list | None = None):
         """Walk from the root to a leaf (≤ playout_depth plies),
-        mutating ``state`` along the way. Returns the leaf node."""
+        mutating ``state`` along the way. Returns the leaf node;
+        ``path`` (if given) collects every node stepped through."""
         node = self._root
         for _ in range(self._L):
             if node.is_leaf():
                 break
             move, node = node.select(self._c_puct)
             state.do_move(move)
+            if path is not None:
+                path.append(node)
         return node
 
     def _playout(self, state) -> None:
@@ -245,16 +248,24 @@ class ParallelMCTS(MCTS):
                    key=lambda ac: ac[1]._n_visits)[0]
 
     def _wave(self, state, width: int) -> None:
-        # descend under virtual loss; duplicate arrivals at the same
-        # node (forced when the tree is tiny) share one evaluation
-        paths = []
+        # descend under virtual loss applied to EVERY node on the path
+        # (standard APV-MCTS: upper levels must look worse too, or
+        # later descents in the wave re-trace the same line and leaf
+        # diversity collapses); duplicate arrivals at the same node
+        # (forced when the tree is tiny) share one evaluation
+        paths = []                   # per playout: nodes under vloss
+        leaves = []                  # per playout: its leaf node
         uniq_idx: dict = {}          # id(node) -> index below
         nodes, leaf_states = [], []
         for _ in range(width):
             st = state.copy()
-            node = self._descend(st)
-            node.add_virtual_loss()
-            paths.append(node)
+            path: list = []
+            node = self._descend(st, path)
+            vpath = path or [node]
+            for nd in vpath:
+                nd.add_virtual_loss()
+            paths.append(vpath)
+            leaves.append(node)
             if id(node) not in uniq_idx:
                 uniq_idx[id(node)] = len(nodes)
                 nodes.append(node)
@@ -285,9 +296,10 @@ class ParallelMCTS(MCTS):
                 values[i] = 0.0 if w == 0 else (
                     1.0 if w == st.current_player else -1.0)
 
-        for node in paths:
-            node.revert_virtual_loss()
-        for node in paths:
+        for vpath in paths:
+            for nd in vpath:
+                nd.revert_virtual_loss()
+        for node in leaves:
             i = uniq_idx[id(node)]
             if priors[i]:
                 node.expand(priors[i])
@@ -297,14 +309,73 @@ class ParallelMCTS(MCTS):
 # --------------------------------------------------------------- wiring
 
 
+def device_rollout_fn(rollout_net, rollout_limit: int = 500,
+                      temperature: float = 1.0, min_batch: int = 8,
+                      seed: int = 0):
+    """``batch_rollout`` callable that plays the wave's leaves to
+    terminal FULLY on device (the ``mcts.py`` module-docstring promise;
+    SURVEY.md §3.3 rebuild note — no host ``do_move`` per ply).
+
+    Bridges the host leaf states into one batched :class:`GoState`
+    (history hashing skipped — the net cfg has superko off), runs the
+    compiled :func:`selfplay.make_device_rollout` scan once, and maps
+    the area-scored winners back to each entry player's perspective.
+    Waves are padded up to ``min_batch`` so every call hits the same
+    compiled shape (``step`` freezes padded/finished games).
+
+    Scoring uses the *game's* komi, read from the wave's leaf states —
+    not the net cfg's default — so rollout outcomes agree with the
+    host path's ``get_winner()`` (one compiled program per distinct
+    komi, cached; a game's komi never changes mid-search).
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine import jaxgo
+    from rocalphago_tpu.search.selfplay import make_device_rollout
+
+    base_cfg = rollout_net.cfg
+    runs: dict = {}       # komi -> (cfg, compiled rollout)
+    key_box = [jax.random.key(seed)]
+
+    def for_komi(komi: float):
+        if komi not in runs:
+            cfg = dataclasses.replace(base_cfg, komi=komi)
+            runs[komi] = (cfg, make_device_rollout(
+                cfg, rollout_net.feature_list, rollout_net.module.apply,
+                rollout_limit=rollout_limit, temperature=temperature))
+        return runs[komi]
+
+    def batch_rollout(states):
+        cfg, run = for_komi(float(states[0].komi))
+        entry = [s.current_player for s in states]
+        dev = [jaxgo.from_pygo(cfg, s, with_history=False)
+               for s in states]
+        pad = max(min_batch - len(dev), 0)
+        dev.extend([dev[0]] * pad)
+        batched = jax.tree.map(lambda *xs: jnp.stack(xs), *dev)
+        key_box[0], sub = jax.random.split(key_box[0])
+        winners = np.asarray(jax.device_get(
+            run(rollout_net.params, batched, sub)))
+        return [0.0 if w == 0 else (1.0 if w == p else -1.0)
+                for w, p in zip(winners[:len(states)], entry)]
+
+    return batch_rollout
+
+
 def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
-                 rng=None, symmetric: bool = False):
+                 rng=None, symmetric: bool = False,
+                 device_rollout: bool = False, leaf_batch: int = 8):
     """Batch callables for :class:`ParallelMCTS` from the framework
     nets: one jitted forward per net per wave.
 
     ``rollout`` (a fast policy net — or the SL policy itself, as the
     reference does when no rollout net is trained) drives lockstep
-    batched playouts-to-terminal on host rules. ``symmetric``
+    batched playouts-to-terminal: on host rules by default, or — with
+    ``device_rollout=True`` — as one compiled on-device scan per wave
+    via :func:`device_rollout_fn` (the TPU-class path). ``symmetric``
     ensembles priors/values over the 8 board symmetries (AlphaGo's
     evaluation-time averaging; 8× eval cost, rollouts excluded).
     """
@@ -319,6 +390,13 @@ def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
         return value.batch_eval_state(states, symmetric=symmetric)
 
     rollout_net = rollout or policy
+
+    if device_rollout:
+        return (batch_value, batch_policy,
+                device_rollout_fn(rollout_net,
+                                  rollout_limit=rollout_limit,
+                                  min_batch=leaf_batch,
+                                  seed=int(rng.integers(2**31))))
 
     def batch_rollout(states):
         entry_players = [s.current_player for s in states]
@@ -365,11 +443,13 @@ class MCTSPlayer:
                  c_puct: float = 5.0, rollout_limit: int = 500,
                  playout_depth: int = 20, n_playout: int = 100,
                  leaf_batch: int = 8, seed: int | None = None,
-                 symmetric: bool = False):
+                 symmetric: bool = False, device_rollout: bool = False):
         rng = np.random.default_rng(seed)
         bv, bp, br = net_backends(policy, value, rollout,
                                   rollout_limit=rollout_limit, rng=rng,
-                                  symmetric=symmetric)
+                                  symmetric=symmetric,
+                                  device_rollout=device_rollout,
+                                  leaf_batch=leaf_batch)
         self.mcts = ParallelMCTS(bv, bp, br, lmbda=lmbda, c_puct=c_puct,
                                  rollout_limit=rollout_limit,
                                  playout_depth=playout_depth,

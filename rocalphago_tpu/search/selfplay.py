@@ -140,3 +140,52 @@ def make_selfplay(cfg: GoConfig, features: tuple, apply_a: Callable,
                           params_b, rng, batch, max_moves, temperature)
 
     return run
+
+
+def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
+                        rollout_limit: int = 500,
+                        temperature: float = 1.0):
+    """Jitted ``(params, states, rng) -> winners`` rollout-to-terminal.
+
+    The MCTS λ-mix's rollout leg, fully on device (SURVEY.md §3.3
+    rebuild note): play a *batched* :class:`GoState` — e.g. a wave of
+    leaves bridged via :func:`jaxgo.from_pygo` — to the end of the game
+    (≤ ``rollout_limit`` further plies) with one rollout net playing
+    both colors, then area-score. Finished or padded entries stay
+    frozen (``step`` is a no-op on done games). Returns int32 ``[B]``
+    winners (+1 black / -1 white / 0 draw); callers translate to the
+    entry player's perspective.
+
+    Same scan skeleton as :func:`play_games`, minus the two-net color
+    split: rollouts use a single policy, so every ply is exactly one
+    full-batch forward.
+    """
+    n = cfg.num_points
+    vgd = jax.vmap(lambda board: group_data(
+        cfg, board, with_member=needs_member(features),
+        with_zxor=cfg.enforce_superko))
+    enc = jax.vmap(lambda s, g: encode(cfg, s, features=features, gd=g))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(step, cfg))
+
+    @jax.jit
+    def run(params, states: GoState, rng: jax.Array) -> jax.Array:
+        def ply(carry, _):
+            states, rng = carry
+            rng, sub = jax.random.split(rng)
+            gd = vgd(states.board)
+            planes = enc(states, gd)
+            logits = apply_fn(params, planes)
+            sens = vsens(states, gd)
+            neg = jnp.finfo(logits.dtype).min
+            masked = jnp.where(sens, logits / temperature, neg)
+            action = jax.random.categorical(sub, masked, axis=-1)
+            must_pass = ~sens.any(axis=-1)
+            action = jnp.where(must_pass, n, action).astype(jnp.int32)
+            return (vstep(states, action), rng), None
+
+        (final, _), _ = lax.scan(ply, (states, rng), None,
+                                 length=rollout_limit)
+        return jax.vmap(functools.partial(winner, cfg))(final)
+
+    return run

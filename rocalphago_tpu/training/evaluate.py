@@ -1,0 +1,128 @@
+"""Standalone model evaluation: model.json + corpus → metric JSON.
+
+The measurement path for BASELINE.md metric 1 (SL policy top-1 move
+accuracy on held-out KGS positions) — and its value-net analogue —
+without running a trainer: load any registered net from its JSON spec,
+stream a converted corpus through the jitted forward, and print one
+JSON line with the metric(s). The reference has no equivalent CLI (its
+accuracy only appears inside Keras ``fit`` logs); this fills the
+metric-plumbing gap called out in round 1.
+
+Usage::
+
+    python -m rocalphago_tpu.training.evaluate model.json corpus-prefix
+        [--split test --shuffle-npz out/shuffle.npz]
+        [--minibatch 256] [--max-batches N]
+
+With ``--shuffle-npz`` the persisted trainer split is honored, so the
+reported number is on exactly the positions the trainer never touched;
+otherwise the whole corpus is evaluated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax
+import numpy as np
+
+from rocalphago_tpu.data.pipeline import ShardedDataset, batch_iterator
+from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.training.sl import (
+    make_eval_step as make_policy_eval_step,
+    pad_batch,
+)
+from rocalphago_tpu.training.value import (
+    make_eval_step as make_value_eval_step,
+)
+
+
+def evaluate_model(net: NeuralNetBase, dataset: ShardedDataset,
+                   indices: np.ndarray, minibatch: int = 256,
+                   max_batches: int | None = None,
+                   num_devices: int | None = None) -> dict:
+    """Loss/top-1 (policy-shaped nets) or MSE (value nets) over
+    ``indices``; streaming, one compiled shape (short batches padded
+    with zero weights)."""
+    mesh = meshlib.make_mesh(num_devices)
+    dwidth = mesh.shape[meshlib.DATA_AXIS]
+    if minibatch % dwidth:
+        minibatch = dwidth * max(minibatch // dwidth, 1)
+    is_value = dataset.manifest.get("targets") == "outcome"
+    n = net.board * net.board
+    if is_value:
+        eval_step = jax.jit(make_value_eval_step(net.module.apply))
+    else:
+        eval_step = jax.jit(make_policy_eval_step(net.module.apply, n))
+
+    sums: dict[str, float] = {}
+    count = 0.0
+    rng = np.random.default_rng(0)
+    it = batch_iterator(dataset, indices, minibatch, rng, epochs=1,
+                        drop_remainder=False)
+    for i, (planes, targets) in enumerate(it):
+        if max_batches is not None and i >= max_batches:
+            break
+        planes, targets, weights = pad_batch(planes, targets, minibatch)
+        planes, targets, weights = meshlib.shard_batch(
+            mesh, (planes, targets, weights))
+        m = jax.device_get(eval_step(net.params, planes, targets,
+                                     weights))
+        c = float(m.pop("count"))
+        for k, v in m.items():
+            sums[k] = sums.get(k, 0.0) + float(v) * c
+        count += c
+    if not count:
+        return {"positions": 0}
+    out = {k: v / count for k, v in sums.items()}
+    out["positions"] = int(count)
+    if "accuracy" in out:
+        out["top1"] = out.pop("accuracy")
+    return out
+
+
+def pick_split(dataset, split: str, shuffle_npz: str | None):
+    if shuffle_npz is None:
+        return np.arange(len(dataset))
+    z = np.load(shuffle_npz)
+    if split not in z:
+        raise ValueError(f"split {split!r} not in {shuffle_npz} "
+                         f"(has {sorted(z.keys())})")
+    return z[split]
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(
+        description="Evaluate a saved model on a converted corpus")
+    ap.add_argument("model_json")
+    ap.add_argument("corpus", help="npz shard prefix")
+    ap.add_argument("--split", default="test",
+                    choices=("train", "val", "test"))
+    ap.add_argument("--shuffle-npz", default=None,
+                    help="trainer split file; restricts to --split")
+    ap.add_argument("--minibatch", "-B", type=int, default=256)
+    ap.add_argument("--max-batches", type=int, default=None)
+    ap.add_argument("--num-devices", type=int, default=None)
+    a = ap.parse_args(argv)
+
+    net = NeuralNetBase.load_model(a.model_json)
+    dataset = ShardedDataset(a.corpus)
+    if dataset.planes != net.preprocess.output_dim:
+        raise ValueError(
+            f"corpus has {dataset.planes} planes but the model needs "
+            f"{net.preprocess.output_dim}")
+    indices = pick_split(dataset, a.split, a.shuffle_npz)
+    result = dict(evaluate_model(net, dataset, indices,
+                                 minibatch=a.minibatch,
+                                 max_batches=a.max_batches,
+                                 num_devices=a.num_devices),
+                  model=a.model_json, split=a.split)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -145,8 +145,15 @@ def make_rl_iteration(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
 
         updates, opt_state = tx.update(grads, state.opt_state, params)
         params = optax.apply_updates(params, updates)
+        # win rate over DECIDED games (draws excluded, reported
+        # separately) — counting draws as losses biases the learner
+        # win-rate low on integer-komi configs
+        wins = (z > 0).sum()
+        decided = (z != 0).sum()
         metrics = {
-            "win_rate": (z > 0).mean(),
+            "win_rate": jnp.where(decided > 0,
+                                  wins / jnp.maximum(decided, 1), 0.5),
+            "draw_rate": (z == 0).mean(),
             "mean_moves": result.num_moves.astype(jnp.float32).mean(),
         }
         new = RLState(params, opt_state, state.iteration + 1,
@@ -160,11 +167,13 @@ class OpponentPool:
     """Directory of past learner snapshots, sampled uniformly each
     iteration (reference opponent-pool semantics)."""
 
-    def __init__(self, directory: str, net: NeuralNetBase):
+    def __init__(self, directory: str, net: NeuralNetBase,
+                 write: bool = True):
         self.directory = directory
         self.net = net
+        self.write = write
         os.makedirs(directory, exist_ok=True)
-        if not self.snapshots():
+        if write and not self.snapshots():
             self.add(net.params, 0)
 
     def snapshots(self) -> list:
@@ -172,21 +181,55 @@ class OpponentPool:
             os.path.join(self.directory, "opponent.*.flax.msgpack")))
 
     def add(self, params, iteration: int) -> None:
+        if not self.write:
+            return
         self.net.params = jax.device_get(params)
         self.net.save_weights(os.path.join(
             self.directory, f"opponent.{iteration:05d}.flax.msgpack"))
 
-    def sample(self, seed, iteration: int):
+    def sample(self, seed, iteration: int,
+               save_every: int | None = None):
         """Uniform draw over the current pool, seeded by (seed,
         iteration) — stateless, so an interrupted-and-resumed run makes
-        the same choices as an uninterrupted one with no RNG replay."""
-        paths = self.snapshots()
+        the same choices as an uninterrupted one with no RNG replay.
+        ``self.net.params`` is used only as a read-only deserialization
+        template (never mutated — no scratch-slot reentrancy hazard).
+
+        With ``save_every`` the candidate set is RECONSTRUCTED from the
+        save schedule (snapshots land at iterations 0, save_every,
+        2·save_every, …) instead of listing the directory — every host
+        of a multi-host run computes the identical choice even when
+        shared-filesystem listings lag the coordinator's writes; the
+        read then waits briefly for the chosen file to become visible.
+        Without it (single-process default) the directory listing is
+        the candidate set."""
+        from flax import serialization
+
         rng = np.random.default_rng(
             np.random.SeedSequence([seed, iteration]))
-        path = paths[rng.integers(len(paths))]
-        template = self.net.params
-        self.net.load_weights(path)
-        params, self.net.params = self.net.params, template
+        if save_every:
+            iters = [0] + [k * save_every for k in
+                           range(1, iteration // save_every + 1)]
+            pick = iters[rng.integers(len(iters))]
+            path = os.path.join(
+                self.directory, f"opponent.{pick:05d}.flax.msgpack")
+            deadline = time.time() + (30.0 if jax.process_count() > 1
+                                      else 0.0)
+            while not os.path.exists(path):
+                if time.time() >= deadline:
+                    raise FileNotFoundError(
+                        f"opponent snapshot {path} not visible "
+                        "(multi-host: the coordinator writes them; a "
+                        "shared filesystem is required)")
+                time.sleep(0.5)
+        else:
+            paths = self.snapshots()
+            if not paths:
+                raise FileNotFoundError(
+                    f"no opponent snapshots in {self.directory}")
+            path = paths[rng.integers(len(paths))]
+        with open(path, "rb") as f:
+            params = serialization.from_bytes(self.net.params, f.read())
         return params, os.path.basename(path)
 
 
@@ -213,12 +256,17 @@ class RLTrainer:
             opt_state=tx.init(self.net.params),
             iteration=jnp.int32(0),
             rng=pack_rng(jax.random.key(cfg.seed))))
+        # multi-host: artifact files are coordinator-only; Orbax saves
+        # stay all-process (SURVEY.md §2b "Multi-host")
+        self.coord = meshlib.is_coordinator()
         self.pool = OpponentPool(
-            os.path.join(cfg.out_dir, "opponents"), self.net)
+            os.path.join(cfg.out_dir, "opponents"), self.net,
+            write=self.coord)
         self.ckpt = TrainCheckpointer(
             os.path.join(cfg.out_dir, "checkpoints"))
         self.metrics = MetricsLogger(
-            os.path.join(cfg.out_dir, "metrics.jsonl"))
+            os.path.join(cfg.out_dir, "metrics.jsonl")
+            if self.coord else None, echo=self.coord)
         self.start_iteration = 0
         self._maybe_resume()
 
@@ -235,10 +283,12 @@ class RLTrainer:
         meta = MetadataWriter(
             os.path.join(cfg.out_dir, "metadata.json"),
             header={"cmd": " ".join(sys.argv),
-                    "config": dataclasses.asdict(cfg)})
+                    "config": dataclasses.asdict(cfg)},
+            enabled=self.coord)
         final = {}
         for it in range(self.start_iteration, cfg.iterations):
-            opp_params, opp_name = self.pool.sample(cfg.seed, it)
+            opp_params, opp_name = self.pool.sample(
+                cfg.seed, it, save_every=cfg.save_every)
             opp_params = meshlib.replicate(self.mesh, opp_params)
             t0 = time.time()
             self.state, m = self._iteration(self.state, opp_params)
@@ -261,6 +311,8 @@ class RLTrainer:
         return final
 
     def _export_weights(self, iteration: int) -> None:
+        if not self.coord:
+            return
         self.net.params = jax.device_get(self.state.params)
         weights = os.path.join(
             self.cfg.out_dir, f"weights.{iteration:05d}.flax.msgpack")
@@ -271,6 +323,8 @@ class RLTrainer:
 
 def run_training(argv=None) -> dict:
     """CLI parity with the reference RL trainer."""
+    # multi-host bring-up (DCN); no-op for single-process runs
+    meshlib.distributed_init()
     ap = argparse.ArgumentParser(
         description="REINFORCE policy training via self-play")
     ap.add_argument("model_json")

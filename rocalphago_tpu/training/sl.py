@@ -68,6 +68,8 @@ class SLConfig:
     num_devices: int | None = None
     max_validation_batches: int = 200
     epoch_length: int | None = None   # steps per epoch; None = full pass
+    save_every: int | None = None     # also checkpoint every N steps
+    #                                   (mid-epoch preemption recovery)
 
 
 class SLState(NamedTuple):
@@ -198,10 +200,14 @@ class SLTrainer:
             out_shardings=rep)
 
         self.tx = tx
+        # multi-host: artifact files are coordinator-only; Orbax saves
+        # stay all-process (SURVEY.md §2b "Multi-host")
+        self.coord = meshlib.is_coordinator()
         self.ckpt = TrainCheckpointer(
             os.path.join(cfg.out_dir, "checkpoints"))
         self.metrics = MetricsLogger(
-            os.path.join(cfg.out_dir, "metrics.jsonl"))
+            os.path.join(cfg.out_dir, "metrics.jsonl")
+            if self.coord else None, echo=self.coord)
 
         key = jax.random.key(cfg.seed)
         self.state = meshlib.replicate(self.mesh, SLState(
@@ -212,8 +218,10 @@ class SLTrainer:
 
         self.train_idx, self.val_idx, self.test_idx = split_indices(
             len(self.dataset), cfg.train_val_test, seed=cfg.seed,
-            path=os.path.join(cfg.out_dir, "shuffle.npz"))
+            path=os.path.join(cfg.out_dir, "shuffle.npz"),
+            write=self.coord)
         self.start_epoch = 0
+        self._resume_skip = 0
         self._maybe_resume()
 
     # ----------------------------------------------------------- resume
@@ -223,10 +231,14 @@ class SLTrainer:
         if restored is None:
             return
         self.state = meshlib.replicate(self.mesh, SLState(*restored))
-        steps_per_epoch = self._steps_per_epoch()
-        self.start_epoch = int(restored.step) // max(steps_per_epoch, 1)
+        # the data cursor is derived, not stored: batch order within an
+        # epoch is a pure function of (seed, epoch) — see run() — so
+        # step % steps_per_epoch IS the number of consumed batches, and
+        # a mid-epoch kill resumes at exactly the next unseen batch
+        self.start_epoch, self._resume_skip = divmod(
+            int(restored.step), max(self._steps_per_epoch(), 1))
         self.metrics.log("resume", step=int(restored.step),
-                         epoch=self.start_epoch)
+                         epoch=self.start_epoch, skip=self._resume_skip)
 
     def _steps_per_epoch(self) -> int:
         if self.cfg.epoch_length:
@@ -241,28 +253,35 @@ class SLTrainer:
             os.path.join(cfg.out_dir, "metadata.json"),
             header={"cmd": " ".join(sys.argv),
                     "config": dataclasses.asdict(cfg),
-                    "dataset_positions": len(self.dataset)})
+                    "dataset_positions": len(self.dataset)},
+            enabled=self.coord)
         steps_per_epoch = self._steps_per_epoch()
         # host RNG seeded per-epoch → identical batch order on re-run
         # of the same epoch after resume (reference shuffle.npz trick)
         final = {}
         for epoch in range(self.start_epoch, cfg.epochs):
+            skip = self._resume_skip if epoch == self.start_epoch else 0
             host_rng = np.random.default_rng(
                 np.random.SeedSequence([cfg.seed, epoch]))
             it = batch_iterator(self.dataset, self.train_idx,
-                                cfg.minibatch, host_rng, epochs=1)
+                                cfg.minibatch, host_rng, epochs=1,
+                                skip=skip)
             it = (meshlib.shard_batch(self.mesh, b)
                   for b in it)
             t0 = time.time()
             losses, accs = [], []
             for i, (planes, actions) in enumerate(
                     device_prefetch(it, size=2)):
-                if i >= steps_per_epoch:
+                if i >= steps_per_epoch - skip:
                     break
                 self.state, m = self._train_step(
                     self.state, planes, actions)
                 losses.append(m["loss"])
                 accs.append(m["accuracy"])
+                if cfg.save_every:
+                    gstep = epoch * steps_per_epoch + skip + len(losses)
+                    if gstep % cfg.save_every == 0:
+                        self.ckpt.save(gstep, jax.device_get(self.state))
             if not losses:
                 raise ValueError(
                     f"train split ({len(self.train_idx)} positions) "
@@ -284,6 +303,16 @@ class SLTrainer:
             self.ckpt.save(step, jax.device_get(self.state))
             self._export_weights(epoch)
             final = entry
+        # held-out test-split metric (BASELINE.md metric 1: top-1 move
+        # accuracy) — recorded in metadata.json for tooling and
+        # reportable standalone via training.evaluate
+        if len(self.test_idx):
+            test = self.evaluate(self.test_idx)
+            final = dict(final, test_loss=test["loss"],
+                         test_accuracy=test["accuracy"])
+            meta.update(test_loss=test["loss"],
+                        test_accuracy=test["accuracy"])
+            self.metrics.log("test", **test)
         self.ckpt.wait()
         return final
 
@@ -316,6 +345,8 @@ class SLTrainer:
         (``weights.NNNNN``-style) plus ``model.json`` — a loadable
         spec always pointing at the latest weights, so downstream
         stages (RL, GTP) can consume ``out_dir/model.json`` directly."""
+        if not self.coord:
+            return
         self.net.params = jax.device_get(self.state.params)
         weights = os.path.join(
             self.cfg.out_dir, f"weights.{epoch:05d}.flax.msgpack")
@@ -325,6 +356,9 @@ class SLTrainer:
 
 def run_training(argv=None) -> dict:
     """CLI parity with the reference trainer."""
+    # multi-host bring-up (DCN) before any backend touch; no-op for
+    # single-process runs (SURVEY.md §7 step 7)
+    meshlib.distributed_init()
     ap = argparse.ArgumentParser(
         description="Supervised policy training on expert games")
     ap.add_argument("model_json")
@@ -341,6 +375,9 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--num-devices", type=int, default=None)
     ap.add_argument("--epoch-length", type=int, default=None)
+    ap.add_argument("--save-every", type=int, default=None,
+                    help="extra checkpoint every N steps (mid-epoch "
+                         "preemption recovery)")
     a = ap.parse_args(argv)
     cfg = SLConfig(
         model_json=a.model_json, train_data=a.train_data, out_dir=a.out_dir,
@@ -348,7 +385,8 @@ def run_training(argv=None) -> dict:
         learning_rate=a.learning_rate, decay=a.decay, momentum=a.momentum,
         train_val_test=tuple(a.train_val_test),
         symmetries=not a.no_symmetries, seed=a.seed,
-        num_devices=a.num_devices, epoch_length=a.epoch_length)
+        num_devices=a.num_devices, epoch_length=a.epoch_length,
+        save_every=a.save_every)
     return SLTrainer(cfg).run()
 
 

@@ -64,6 +64,7 @@ class ValueConfig:
     num_devices: int | None = None
     max_validation_batches: int = 200
     epoch_length: int | None = None
+    save_every: int | None = None     # also checkpoint every N steps
 
 
 class ValueState(NamedTuple):
@@ -159,10 +160,14 @@ class ValueTrainer:
             in_shardings=(state_sh.params, batch_sh, z_sh, z_sh),
             out_shardings=rep)
 
+        # multi-host: artifact files are coordinator-only; Orbax saves
+        # stay all-process (SURVEY.md §2b "Multi-host")
+        self.coord = meshlib.is_coordinator()
         self.ckpt = TrainCheckpointer(
             os.path.join(cfg.out_dir, "checkpoints"))
         self.metrics = MetricsLogger(
-            os.path.join(cfg.out_dir, "metrics.jsonl"))
+            os.path.join(cfg.out_dir, "metrics.jsonl")
+            if self.coord else None, echo=self.coord)
         self.state = meshlib.replicate(self.mesh, ValueState(
             params=self.net.params,
             opt_state=opt_state0,
@@ -170,8 +175,10 @@ class ValueTrainer:
             rng=pack_rng(jax.random.key(cfg.seed))))
         self.train_idx, self.val_idx, self.test_idx = split_indices(
             len(self.dataset), cfg.train_val_test, seed=cfg.seed,
-            path=os.path.join(cfg.out_dir, "shuffle.npz"))
+            path=os.path.join(cfg.out_dir, "shuffle.npz"),
+            write=self.coord)
         self.start_epoch = 0
+        self._resume_skip = 0
         self._maybe_resume()
 
     def _maybe_resume(self):
@@ -179,10 +186,13 @@ class ValueTrainer:
         if restored is None:
             return
         self.state = meshlib.replicate(self.mesh, ValueState(*restored))
-        self.start_epoch = int(restored.step) // max(
-            self._steps_per_epoch(), 1)
+        # derived data cursor: batch order is a pure function of
+        # (seed, epoch), so step % steps_per_epoch = consumed batches
+        # (same scheme as SLTrainer._maybe_resume)
+        self.start_epoch, self._resume_skip = divmod(
+            int(restored.step), max(self._steps_per_epoch(), 1))
         self.metrics.log("resume", step=int(restored.step),
-                         epoch=self.start_epoch)
+                         epoch=self.start_epoch, skip=self._resume_skip)
 
     def _steps_per_epoch(self) -> int:
         if self.cfg.epoch_length:
@@ -195,22 +205,29 @@ class ValueTrainer:
             os.path.join(cfg.out_dir, "metadata.json"),
             header={"cmd": " ".join(sys.argv),
                     "config": dataclasses.asdict(cfg),
-                    "dataset_positions": len(self.dataset)})
+                    "dataset_positions": len(self.dataset)},
+            enabled=self.coord)
         steps_per_epoch = self._steps_per_epoch()
         final = {}
         for epoch in range(self.start_epoch, cfg.epochs):
+            skip = self._resume_skip if epoch == self.start_epoch else 0
             host_rng = np.random.default_rng(
                 np.random.SeedSequence([cfg.seed, epoch]))
             it = batch_iterator(self.dataset, self.train_idx,
-                                cfg.minibatch, host_rng, epochs=1)
+                                cfg.minibatch, host_rng, epochs=1,
+                                skip=skip)
             it = (meshlib.shard_batch(self.mesh, b) for b in it)
             t0 = time.time()
             losses = []
             for i, (planes, z) in enumerate(device_prefetch(it, size=2)):
-                if i >= steps_per_epoch:
+                if i >= steps_per_epoch - skip:
                     break
                 self.state, m = self._train_step(self.state, planes, z)
                 losses.append(m["mse"])
+                if cfg.save_every:
+                    gstep = epoch * steps_per_epoch + skip + len(losses)
+                    if gstep % cfg.save_every == 0:
+                        self.ckpt.save(gstep, jax.device_get(self.state))
             if not losses:
                 raise ValueError(
                     f"train split ({len(self.train_idx)} positions) "
@@ -231,6 +248,12 @@ class ValueTrainer:
             self.ckpt.save(step, jax.device_get(self.state))
             self._export_weights(epoch)
             final = entry
+        # held-out test-split MSE (AlphaGo paper reports train+test MSE)
+        if len(self.test_idx):
+            test = self.evaluate(self.test_idx)
+            final = dict(final, test_mse=test["mse"])
+            meta.update(test_mse=test["mse"])
+            self.metrics.log("test", **test)
         self.ckpt.wait()
         return final
 
@@ -256,6 +279,8 @@ class ValueTrainer:
         return {"mse": mse_sum / count}
 
     def _export_weights(self, epoch: int) -> None:
+        if not self.coord:
+            return
         self.net.params = jax.device_get(self.state.params)
         weights = os.path.join(
             self.cfg.out_dir, f"weights.{epoch:05d}.flax.msgpack")
@@ -266,6 +291,8 @@ class ValueTrainer:
 
 def run_training(argv=None) -> dict:
     """CLI parity with the reference value trainer."""
+    # multi-host bring-up (DCN); no-op for single-process runs
+    meshlib.distributed_init()
     ap = argparse.ArgumentParser(
         description="Value network regression on self-play outcomes")
     ap.add_argument("model_json")
@@ -283,6 +310,9 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--num-devices", type=int, default=None)
     ap.add_argument("--epoch-length", type=int, default=None)
+    ap.add_argument("--save-every", type=int, default=None,
+                    help="extra checkpoint every N steps (mid-epoch "
+                         "preemption recovery)")
     a = ap.parse_args(argv)
     cfg = ValueConfig(
         model_json=a.model_json, train_data=a.train_data,
@@ -290,7 +320,8 @@ def run_training(argv=None) -> dict:
         learning_rate=a.learning_rate, decay=a.decay,
         momentum=a.momentum, train_val_test=tuple(a.train_val_test),
         symmetries=not a.no_symmetries, seed=a.seed,
-        num_devices=a.num_devices, epoch_length=a.epoch_length)
+        num_devices=a.num_devices, epoch_length=a.epoch_length,
+        save_every=a.save_every)
     return ValueTrainer(cfg).run()
 
 

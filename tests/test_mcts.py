@@ -11,12 +11,13 @@ import numpy as np
 import pytest
 
 from rocalphago_tpu.engine import pygo
-from rocalphago_tpu.models import CNNPolicy, CNNValue
+from rocalphago_tpu.models import CNNPolicy, CNNRollout, CNNValue
 from rocalphago_tpu.search.mcts import (
     MCTS,
     MCTSPlayer,
     ParallelMCTS,
     TreeNode,
+    device_rollout_fn,
     net_backends,
 )
 
@@ -205,6 +206,50 @@ def test_mcts_player_end_to_end():
     state.do_move(move)
     move2 = player.get_move(state)
     assert state.is_legal(move2)
+
+
+class TestDeviceRollout:
+    """device_rollout_fn: the on-device rollout-to-terminal leg."""
+
+    def make_rollout_net(self):
+        return CNNRollout(("board", "ones"), board=SIZE, filters=4)
+
+    def test_outcomes_are_signed_and_padded_calls_work(self):
+        br = device_rollout_fn(self.make_rollout_net(),
+                               rollout_limit=40, min_batch=4, seed=0)
+        states = [pygo.GameState(size=SIZE, komi=0.5),
+                  pygo.GameState(size=SIZE, komi=0.5)]
+        states[1].do_move((2, 2))
+        outs = br(states)          # 2 states < min_batch 4 → padded
+        assert len(outs) == 2
+        assert all(o in (-1.0, 0.0, 1.0) for o in outs)
+
+    def test_finished_game_scores_as_it_stands(self):
+        st = pygo.GameState(size=SIZE, komi=0.5)
+        st.do_move((2, 2))
+        st.do_move(pygo.PASS_MOVE, pygo.WHITE)
+        st.do_move(pygo.PASS_MOVE, pygo.BLACK)
+        assert st.is_end_of_game     # Black wins by area + komi<1
+        br = device_rollout_fn(self.make_rollout_net(),
+                               rollout_limit=10, min_batch=2, seed=0)
+        # entry player is White (after Black's pass); Black won → -1
+        out = br([st])[0]
+        expected = 1.0 if st.get_winner() == st.current_player else -1.0
+        assert out == expected
+
+    def test_mcts_player_with_device_rollouts(self):
+        policy = CNNPolicy(("board", "ones"), board=SIZE, layers=2,
+                           filters_per_layer=4)
+        value = CNNValue(("board", "ones"), board=SIZE, layers=2,
+                         filters_per_layer=4, dense_units=8)
+        player = MCTSPlayer(value, policy,
+                            rollout=self.make_rollout_net(),
+                            lmbda=0.5, n_playout=8, leaf_batch=4,
+                            rollout_limit=12, playout_depth=3, seed=0,
+                            device_rollout=True)
+        state = pygo.GameState(size=SIZE)
+        move = player.get_move(state)
+        assert state.is_legal(move)
 
 
 def test_mcts_player_alternating_game_stays_synced():

@@ -1,0 +1,93 @@
+"""Multi-host wiring tests (single-process simulation).
+
+The reference has no distributed layer (SURVEY.md §2c); the rebuild's
+multi-host story is ``jax.distributed`` bring-up + coordinator-only
+artifact writes. Real DCN needs multiple processes, so these tests
+exercise the seams: ``distributed_init`` dispatch, and that a
+non-coordinator trainer process writes NO artifact files while still
+training (checkpoint saves stay all-process for Orbax).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.training.sl import SLTrainer
+
+from tests.test_sl_trainer import small_cfg, small_net, write_dataset
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    prefix = str(tmp_path / "data" / "corpus")
+    os.makedirs(tmp_path / "data")
+    write_dataset(prefix)
+    return prefix
+
+
+def test_distributed_init_noop_single_process(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        meshlib.jax.distributed, "initialize",
+        lambda *a, **k: calls.append((a, k)))
+    meshlib.distributed_init()          # no coordinator, 1 process
+    assert calls == []
+
+
+def test_distributed_init_dispatches_multiprocess(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        meshlib.jax.distributed, "initialize",
+        lambda *a, **k: calls.append(k))
+    meshlib.distributed_init(coordinator="host0:1234",
+                             num_processes=2, process_id=1)
+    assert calls and calls[0]["num_processes"] == 2
+    calls.clear()
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    meshlib.distributed_init()          # env-driven pod bring-up
+    assert len(calls) == 1
+
+
+def test_coordinator_is_true_single_process():
+    assert meshlib.is_coordinator()
+
+
+def test_non_coordinator_writes_no_artifacts(corpus, tmp_path,
+                                             monkeypatch):
+    """A process with ``is_coordinator() == False`` must train (Orbax
+    checkpoints land — every process participates in multi-host saves)
+    but never touch metadata/metrics/weights/shuffle files."""
+    monkeypatch.setattr(meshlib, "is_coordinator", lambda: False)
+    out = tmp_path / "out"
+    trainer = SLTrainer(small_cfg(corpus, out, epochs=1),
+                        net=small_net())
+    result = trainer.run()
+    trainer.ckpt.close()
+    assert result["step"] > 0
+    assert not (out / "metadata.json").exists()
+    assert not (out / "metrics.jsonl").exists()
+    assert not (out / "shuffle.npz").exists()
+    assert not (out / "model.json").exists()
+    assert (out / "checkpoints").is_dir()
+    assert os.listdir(out / "checkpoints")
+
+
+def test_non_coordinator_split_matches_coordinator(corpus, tmp_path,
+                                                   monkeypatch):
+    """The shuffle split is a pure function of the seed, so a
+    non-coordinator (which never reads or writes shuffle.npz on a cold
+    start) computes the identical split."""
+    out_a = tmp_path / "a"
+    t_coord = SLTrainer(small_cfg(corpus, out_a, epochs=1),
+                        net=small_net())
+    monkeypatch.setattr(meshlib, "is_coordinator", lambda: False)
+    out_b = tmp_path / "b"
+    t_worker = SLTrainer(small_cfg(corpus, out_b, epochs=1),
+                         net=small_net())
+    np.testing.assert_array_equal(t_coord.train_idx, t_worker.train_idx)
+    np.testing.assert_array_equal(t_coord.test_idx, t_worker.test_idx)
+    t_coord.ckpt.close()
+    t_worker.ckpt.close()
