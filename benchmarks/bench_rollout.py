@@ -1,0 +1,54 @@
+"""Rollout-net forward latency + on-device rollout throughput.
+
+The AlphaGo paper's rollout policy is valued for its ~2 µs/move
+forward (SURVEY.md §6); the TPU analogue of that number is (a) the
+batched forward latency of ``CNNRollout`` and (b) the end-to-end
+steps/s of :func:`search.selfplay.make_device_rollout`, which is what
+MCTS actually pays per wave with ``device_rollout=True``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks._harness import report, std_parser, timed  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig, new_states
+    from rocalphago_tpu.models import CNNRollout
+    from rocalphago_tpu.search.selfplay import make_device_rollout
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--rollout-limit", type=int, default=100)
+    args = ap.parse_args()
+    batch = args.batch or 64
+
+    net = CNNRollout(board=args.board)
+    planes = jax.numpy.zeros(
+        (batch, args.board, args.board, net.preprocess.output_dim),
+        jax.numpy.float32)
+
+    per_call = timed(lambda: jax.device_get(net.forward(planes)),
+                     reps=max(args.reps * 10, 10),
+                     profile_dir=args.profile)
+    report("rollout_forward", per_call * 1e6 / batch, "us/position",
+           batch=batch, board=args.board)
+
+    cfg = GoConfig(size=args.board)
+    run = make_device_rollout(cfg, net.feature_list, net.module.apply,
+                              rollout_limit=args.rollout_limit)
+    states = new_states(cfg, batch)
+    per_rollout = timed(
+        lambda: jax.device_get(run(net.params, states, jax.random.key(1))),
+        reps=args.reps, profile_dir=args.profile)
+    report("device_rollout_steps", batch * args.rollout_limit / per_rollout,
+           "board-steps/s", batch=batch, board=args.board,
+           rollout_limit=args.rollout_limit)
+
+
+if __name__ == "__main__":
+    main()

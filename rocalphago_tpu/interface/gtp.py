@@ -117,7 +117,7 @@ class GTPEngine:
         self.player = player
         self.name = name
         self.version = version
-        self.size = 19
+        self.size = self._player_board() or 19
         self.komi = 7.5
         self.state = pygo.GameState(size=self.size, komi=self.komi)
         self._undo_stack: list = []
@@ -153,9 +153,24 @@ class GTPEngine:
         self._undo_stack.clear()
         reset_player(self.player)
 
+    def _player_board(self):
+        """Fixed board size the wrapped player's nets were built for
+        (None when the player is size-agnostic)."""
+        board = getattr(self.player, "board", None)
+        if board is None:
+            policy = getattr(self.player, "policy", None)
+            board = getattr(policy, "board", None)
+        return board
+
     def cmd_boardsize(self, args):
         size = int(args[0])
         if not 2 <= size <= 25:
+            raise ValueError("unacceptable size")
+        # the nets are compiled for a fixed board; accepting another
+        # size would only fail later inside genmove with an opaque
+        # shape error — reply per GTP instead
+        net_board = self._player_board()
+        if net_board is not None and size != net_board:
             raise ValueError("unacceptable size")
         self.size = size
         self._new_game()
@@ -206,19 +221,31 @@ class GTPEngine:
     def cmd_play(self, args):
         color = parse_color(args[0])
         move = vertex_to_move(args[1], self.size)
+        prev = self.state.current_player
         self.state.current_player = color
-        if move is not None and not self.state.is_legal(move):
-            raise ValueError("illegal move")
-        self._apply_move(move, color)
+        try:
+            if move is not None and not self.state.is_legal(move):
+                raise ValueError("illegal move")
+            self._apply_move(move, color)
+        except Exception:
+            # a rejected command must leave the GameState untouched,
+            # including the side to move
+            self.state.current_player = prev
+            raise
         return ""
 
     def cmd_genmove(self, args):
         color = parse_color(args[0])
+        prev = self.state.current_player
         self.state.current_player = color
-        move = self.player.get_move(self.state)
-        if move is not None and not self.state.is_legal(move):
-            move = None
-        self._apply_move(move, color)
+        try:
+            move = self.player.get_move(self.state)
+            if move is not None and not self.state.is_legal(move):
+                move = None
+            self._apply_move(move, color)
+        except Exception:
+            self.state.current_player = prev
+            raise
         return move_to_vertex(move, self.size)
 
     def cmd_undo(self, args):
@@ -310,7 +337,8 @@ def make_player(args):
                             args.rollout, temperature=args.temperature,
                             playouts=args.playouts,
                             leaf_batch=args.leaf_batch,
-                            lmbda=args.lmbda, symmetric=args.symmetric)
+                            lmbda=args.lmbda, symmetric=args.symmetric,
+                            device_rollout=args.device_rollout)
     except ValueError as e:
         raise SystemExit(str(e))
 
@@ -331,6 +359,9 @@ def main(argv=None):
     ap.add_argument("--leaf-batch", type=int, default=8)
     ap.add_argument("--symmetric", action="store_true",
                     help="ensemble evals over the 8 board symmetries")
+    ap.add_argument("--device-rollout", action="store_true",
+                    help="mcts rollouts as one on-device scan per "
+                         "wave instead of host rules")
     a = ap.parse_args(argv)
     run_gtp(make_player(a))
 

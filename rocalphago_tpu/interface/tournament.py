@@ -33,18 +33,28 @@ def play_match(black, white, size: int = 19, komi: float = 7.5,
 def run_tournament(player_a, player_b, games: int, size: int = 19,
                    komi: float = 7.5, move_limit: int = 722,
                    log=None, names=("A", "B")) -> dict:
-    """``games`` games, colors alternating; returns the tally."""
-    wins = {names[0]: 0, names[1]: 0, "draw": 0}
+    """``games`` games, colors alternating; returns the tally.
+
+    The tally is kept by player INDEX (0 / 1 / draw) and mapped to
+    ``names`` only for display — duplicate or reserved display names
+    can't corrupt the counts, and are rejected up front."""
+    if len(set(names)) != 2 or "draw" in names:
+        raise ValueError(
+            f"names must be two distinct labels, neither 'draw'; "
+            f"got {names!r}")
+    tally = [0, 0, 0]                 # wins A, wins B, draws
     for g in range(games):
-        black, white = (player_a, player_b) if g % 2 == 0 \
+        a_is_black = g % 2 == 0
+        black, white = (player_a, player_b) if a_is_black \
             else (player_b, player_a)
-        black_name = names[0] if g % 2 == 0 else names[1]
-        white_name = names[1] if g % 2 == 0 else names[0]
+        black_name, white_name = (names if a_is_black
+                                  else names[::-1])
         w = play_match(black, white, size=size, komi=komi,
                        move_limit=move_limit)
-        winner = black_name if w == pygo.BLACK else \
-            white_name if w == pygo.WHITE else "draw"
-        wins[winner] += 1
+        idx = 2 if w == 0 else (0 if (w == pygo.BLACK) == a_is_black
+                                else 1)
+        tally[idx] += 1
+        winner = "draw" if idx == 2 else names[idx]
         entry = {"game": g, "black": black_name, "white": white_name,
                  "winner": winner}
         if log:
@@ -52,11 +62,13 @@ def run_tournament(player_a, player_b, games: int, size: int = 19,
             log.flush()
         print(f"game {g}: {black_name}(B) vs {white_name}(W) -> "
               f"{winner}", file=sys.stderr)
-    total = max(games, 1)
+    decided = max(tally[0] + tally[1], 1)
     return {"games": games,
-            "wins": wins,
-            "win_rate_a": wins[names[0]] / total,
-            "win_rate_b": wins[names[1]] / total}
+            "wins": {names[0]: tally[0], names[1]: tally[1],
+                     "draw": tally[2]},
+            # win rates are over decided games; draws reported apart
+            "win_rate_a": tally[0] / decided,
+            "win_rate_b": tally[1] / decided}
 
 
 def _build_player(spec: str, temperature: float, playouts: int):

@@ -36,6 +36,13 @@ from rocalphago_tpu.features import DEFAULT_FEATURES, Preprocess
 
 NEURALNETS: dict[str, type] = {}
 
+# Model-spec format version, bumped whenever the flax param-tree layout
+# changes (e.g. a trunk refactor renames conv1.. → trunk/*): loading a
+# spec written under another format fails with a clear message instead
+# of a deep deserialization error. Specs without the field predate the
+# versioning and are assumed current.
+SPEC_FORMAT = 2
+
 
 class ConvTrunk(nn.Module):
     """The AlphaGo conv trunk shared by policy and value nets: a
@@ -167,6 +174,7 @@ class NeuralNetBase:
         """Write the JSON spec (+ weights beside it unless given)."""
         spec = {
             "class": type(self).__name__,
+            "format": SPEC_FORMAT,
             "feature_list": list(self.feature_list),
             "board": self.board,
             "kwargs": self.spec_kwargs,
@@ -191,13 +199,32 @@ class NeuralNetBase:
 
     def load_weights(self, weights_file: str):
         with open(weights_file, "rb") as f:
-            self.params = serialization.from_bytes(self.params, f.read())
+            data = f.read()
+        try:
+            self.params = serialization.from_bytes(self.params, data)
+        except (ValueError, KeyError) as e:
+            # legacy specs carry no format field, so layout mismatches
+            # (pre-ConvTrunk exports) surface here — fail with the
+            # format story instead of a bare msgpack/pytree error
+            raise ValueError(
+                f"{weights_file} does not match this architecture's "
+                f"parameter tree (model-spec format {SPEC_FORMAT}); "
+                "the weights were exported under an older layout — "
+                "re-export the model with the matching framework "
+                f"version ({e})") from e
 
     @staticmethod
     def load_model(json_file: str) -> "NeuralNetBase":
         """Rebuild any registered network from its JSON spec."""
         with open(json_file) as f:
             spec = json.load(f)
+        fmt = spec.get("format", SPEC_FORMAT)
+        if fmt != SPEC_FORMAT:
+            raise ValueError(
+                f"{json_file} is model-spec format {fmt}, this build "
+                f"reads format {SPEC_FORMAT}: its weights use an "
+                "incompatible parameter-tree layout — re-export the "
+                "model with the matching framework version")
         cls = NEURALNETS.get(spec.get("class"))
         if cls is None:
             raise ValueError(
@@ -261,3 +288,85 @@ def legal_moves_mask_host(state: pygo.GameState) -> np.ndarray:
     for (x, y) in state.get_legal_moves(include_eyes=True):
         mask[x * state.size + y] = True
     return mask
+
+
+class PointPolicyEval:
+    """Host-facing evaluation for nets whose output is logits over
+    board points — shared by ``CNNPolicy`` and ``CNNRollout`` (the
+    reference's ``eval_state`` / ``batch_eval_state`` /
+    ``_select_moves_and_normalize`` surface). Mixed into a
+    :class:`NeuralNetBase` subclass."""
+
+    def _symmetric_spec(self):
+        """Inverse-map the point probabilities of each transform, then
+        return ``log p̄`` — which behaves as logits under the masked
+        softmax (renormalizing over the legal support recovers the
+        averaged distribution)."""
+        from rocalphago_tpu.training.symmetries import (
+            inverse_transform_planes,
+        )
+
+        s = self.board
+
+        def per_transform(logits, t):
+            probs = jax.nn.softmax(logits, axis=-1)
+            grids = probs.reshape(-1, s, s, 1)
+            inv = jax.vmap(
+                lambda g: inverse_transform_planes(g, t))(grids)
+            return inv.reshape(-1, s * s)
+
+        return per_transform, lambda mean: jnp.log(mean + 1e-30)
+
+    def eval_state(self, state, moves=None):
+        """Distribution over legal moves of one state →
+        ``[((x, y), prob), ...]`` (the reference's
+        ``_select_moves_and_normalize`` semantics). ``moves`` optionally
+        restricts the support (an empty list means "no moves");
+        it must contain only legal moves — entries are NOT re-checked
+        against the rules."""
+        return self.batch_eval_state(
+            [state], [moves] if moves is not None else None)[0]
+
+    def batch_eval_state(self, states, moves_lists=None,
+                         symmetric: bool = False):
+        """Lockstep evaluation of many states: one forward and one
+        masked-softmax device call for the whole batch.
+
+        ``moves_lists[i]``, when given, becomes the support for state
+        ``i`` verbatim (callers pass pre-computed legal/sensible
+        subsets; re-deriving legality here would double the host cost
+        of the search hot path). ``symmetric`` ensembles the forward
+        over the 8 board symmetries (8× device work)."""
+        states = self._as_state_list(states)
+        planes = self._states_to_planes(states)
+        logits = self.forward_symmetric(planes) if symmetric \
+            else self.forward(planes)
+        sizes, legal_rows = [], []
+        for i, state in enumerate(states):
+            size = state.size if isinstance(state, pygo.GameState) \
+                else self.board
+            if moves_lists is not None and moves_lists[i] is not None:
+                # callers pass a subset of legal moves; building the
+                # mask from it directly skips the per-point legality
+                # scan (the expensive host computation)
+                legal = np.zeros((size * size,), bool)
+                for (x, y) in moves_lists[i]:
+                    legal[x * size + y] = True
+            else:
+                legal = self._legal_for(state)
+            sizes.append(size)
+            legal_rows.append(legal)
+        legal_b = np.stack(legal_rows)
+        probs = np.asarray(masked_probs(logits, jnp.asarray(legal_b)))
+        out = []
+        for i, size in enumerate(sizes):
+            out.append([((int(p) // size, int(p) % size),
+                         float(probs[i, p]))
+                        for p in np.flatnonzero(legal_b[i])])
+        return out
+
+    def _legal_for(self, state) -> np.ndarray:
+        if isinstance(state, pygo.GameState):
+            return legal_moves_mask_host(state)
+        mask = np.asarray(jaxgo.legal_mask(self.cfg, state))
+        return mask[:-1]

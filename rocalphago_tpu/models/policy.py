@@ -17,15 +17,12 @@ from __future__ import annotations
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from rocalphago_tpu.engine import jaxgo, pygo
 from rocalphago_tpu.models.nn_util import (
     ConvTrunk,
     NeuralNetBase,
     PointHead,
-    legal_moves_mask_host,
-    masked_probs,
+    PointPolicyEval,
     neuralnet,
 )
 
@@ -53,8 +50,11 @@ class PolicyNet(nn.Module):
 
 
 @neuralnet
-class CNNPolicy(NeuralNetBase):
-    """Move-probability network over board points."""
+class CNNPolicy(PointPolicyEval, NeuralNetBase):
+    """Move-probability network over board points. Host-facing
+    evaluation (``eval_state`` / ``batch_eval_state`` / symmetry
+    ensembling) comes from :class:`PointPolicyEval`, shared with the
+    rollout net."""
 
     @staticmethod
     def create_network(board: int = 19, input_planes: int = 48,
@@ -66,81 +66,3 @@ class CNNPolicy(NeuralNetBase):
                          filters_per_layer=filters_per_layer,
                          filter_width_1=filter_width_1,
                          filter_width_K=filter_width_K)
-
-    # ------------------------------------------------ symmetry ensemble
-
-    def _symmetric_spec(self):
-        """Inverse-map the point probabilities of each transform, then
-        return ``log p̄`` — which behaves as logits under the masked
-        softmax (renormalizing over the legal support recovers the
-        averaged distribution)."""
-        from rocalphago_tpu.training.symmetries import (
-            inverse_transform_planes,
-        )
-
-        s = self.board
-
-        def per_transform(logits, t):
-            probs = jax.nn.softmax(logits, axis=-1)
-            grids = probs.reshape(-1, s, s, 1)
-            inv = jax.vmap(
-                lambda g: inverse_transform_planes(g, t))(grids)
-            return inv.reshape(-1, s * s)
-
-        return per_transform, lambda mean: jnp.log(mean + 1e-30)
-
-    # -------------------------------------------------- host-facing eval
-
-    def eval_state(self, state, moves=None):
-        """Distribution over legal moves of one state →
-        ``[((x, y), prob), ...]`` (the reference's
-        ``_select_moves_and_normalize`` semantics). ``moves`` optionally
-        restricts the support (an empty list means "no moves");
-        it must contain only legal moves — entries are NOT re-checked
-        against the rules."""
-        return self.batch_eval_state(
-            [state], [moves] if moves is not None else None)[0]
-
-    def batch_eval_state(self, states, moves_lists=None,
-                         symmetric: bool = False):
-        """Lockstep evaluation of many states: one forward and one
-        masked-softmax device call for the whole batch.
-
-        ``moves_lists[i]``, when given, becomes the support for state
-        ``i`` verbatim (callers pass pre-computed legal/sensible
-        subsets; re-deriving legality here would double the host cost
-        of the search hot path). ``symmetric`` ensembles the forward
-        over the 8 board symmetries (8× device work)."""
-        states = self._as_state_list(states)
-        planes = self._states_to_planes(states)
-        logits = self.forward_symmetric(planes) if symmetric \
-            else self.forward(planes)
-        sizes, legal_rows = [], []
-        for i, state in enumerate(states):
-            size = state.size if isinstance(state, pygo.GameState) \
-                else self.board
-            if moves_lists is not None and moves_lists[i] is not None:
-                # callers pass a subset of legal moves; building the
-                # mask from it directly skips the per-point legality
-                # scan (the expensive host computation)
-                legal = np.zeros((size * size,), bool)
-                for (x, y) in moves_lists[i]:
-                    legal[x * size + y] = True
-            else:
-                legal = self._legal_for(state)
-            sizes.append(size)
-            legal_rows.append(legal)
-        legal_b = np.stack(legal_rows)
-        probs = np.asarray(masked_probs(logits, jnp.asarray(legal_b)))
-        out = []
-        for i, size in enumerate(sizes):
-            out.append([((int(p) // size, int(p) % size),
-                         float(probs[i, p]))
-                        for p in np.flatnonzero(legal_b[i])])
-        return out
-
-    def _legal_for(self, state) -> np.ndarray:
-        if isinstance(state, pygo.GameState):
-            return legal_moves_mask_host(state)
-        mask = np.asarray(jaxgo.legal_mask(self.cfg, state))
-        return mask[:-1]

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from rocalphago_tpu.models.nn_util import (
     NeuralNetBase,
     PointHead,
+    PointPolicyEval,
     neuralnet,
 )
 
@@ -44,8 +45,9 @@ class RolloutNet(nn.Module):
 
 
 @neuralnet
-class CNNRollout(NeuralNetBase):
-    """Fast policy for MCTS rollouts (same eval API as CNNPolicy)."""
+class CNNRollout(PointPolicyEval, NeuralNetBase):
+    """Fast policy for MCTS rollouts (same eval API as CNNPolicy, via
+    the shared :class:`PointPolicyEval` mixin)."""
 
     def __init__(self, feature_list=ROLLOUT_FEATURES, **kwargs):
         super().__init__(feature_list, **kwargs)

@@ -444,6 +444,7 @@ class MCTSPlayer:
                  playout_depth: int = 20, n_playout: int = 100,
                  leaf_batch: int = 8, seed: int | None = None,
                  symmetric: bool = False, device_rollout: bool = False):
+        self.board = policy.board   # GTP boardsize validation
         rng = np.random.default_rng(seed)
         bv, bp, br = net_backends(policy, value, rollout,
                                   rollout_limit=rollout_limit, rng=rng,

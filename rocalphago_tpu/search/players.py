@@ -111,7 +111,8 @@ class ProbabilisticPolicyPlayer:
 def build_player(kind: str, policy_path: str, value_path: str | None = None,
                  rollout_path: str | None = None, temperature: float = 0.67,
                  playouts: int = 100, leaf_batch: int = 8,
-                 lmbda: float = 0.5, symmetric: bool = False):
+                 lmbda: float = 0.5, symmetric: bool = False,
+                 device_rollout: bool = False):
     """One agent factory for every CLI (GTP, tournament): build a
     ``greedy`` / ``probabilistic`` / ``mcts`` player from saved model
     specs."""
@@ -133,7 +134,8 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
             if rollout_path else None
         return MCTSPlayer(value, policy, rollout=rollout, lmbda=lmbda,
                           n_playout=playouts, leaf_batch=leaf_batch,
-                          symmetric=symmetric)
+                          symmetric=symmetric,
+                          device_rollout=device_rollout)
     raise ValueError(f"unknown player kind {kind!r}")
 
 

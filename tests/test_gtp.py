@@ -162,6 +162,32 @@ def test_play_after_game_over_keeps_undo_stack(engine):
     assert not engine.state.is_end_of_game
 
 
+class FixedBoardPlayer(ScriptedPlayer):
+    """Scripted player advertising a fixed net board size."""
+
+    board = 9
+
+
+def test_boardsize_rejected_when_net_is_fixed():
+    engine = GTPEngine(FixedBoardPlayer())
+    assert engine.size == 9              # adopted from the player
+    ok(engine, "boardsize 9")
+    reply = fail(engine, "boardsize 13")  # net compiled for 9
+    assert "unacceptable size" in reply
+    assert engine.size == 9
+    fail(engine, "boardsize 1")          # below GTP minimum
+
+
+def test_rejected_command_leaves_state_untouched(engine):
+    ok(engine, "boardsize 9")
+    ok(engine, "play black E5")
+    before = engine.state.current_player
+    fail(engine, "play white E5")        # occupied → rejected
+    assert engine.state.current_player == before
+    fail(engine, "play black Z9")        # bad vertex
+    assert engine.state.current_player == before
+
+
 def test_final_score(engine):
     ok(engine, "boardsize 5")
     ok(engine, "komi 0.5")

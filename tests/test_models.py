@@ -109,6 +109,20 @@ def test_unknown_class_rejected(tmp_path):
         NeuralNetBase.load_model(str(path))
 
 
+def test_stale_spec_format_rejected(tmp_path, policy):
+    """A spec written under another param-tree layout era must fail
+    with a clear message, not a deep deserialization error."""
+    import json
+    path = tmp_path / "m.json"
+    policy.save_model(str(path))
+    spec = json.loads(path.read_text())
+    assert spec["format"] == 2           # current format recorded
+    spec["format"] = 1
+    path.write_text(json.dumps(spec))
+    with pytest.raises(ValueError, match="format"):
+        NeuralNetBase.load_model(str(path))
+
+
 class TestSymmetricForward:
     """AlphaGo-style evaluation-time dihedral ensembling."""
 

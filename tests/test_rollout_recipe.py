@@ -1,0 +1,74 @@
+"""Rollout-policy end-to-end recipe (BASELINE north star names
+"rollout-policy convnets"; round-1 gap: the module existed with no
+training recipe).
+
+Drives the real pipeline at tiny scale: SGF corpus → converter with
+the ROLLOUT_FEATURES subset (20 planes) → SL-train ``CNNRollout`` →
+the trained net plugs into ``MCTSPlayer(rollout=…)`` for both host and
+on-device rollouts.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.data.convert import GameConverter
+from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.models import CNNPolicy, CNNValue
+from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.models.rollout import ROLLOUT_FEATURES, CNNRollout
+from rocalphago_tpu.search.mcts import MCTSPlayer
+from rocalphago_tpu.training.sl import SLConfig, SLTrainer
+
+SGF_DIR = os.path.join(os.path.dirname(__file__), "test_data")
+SIZE = 9
+
+
+@pytest.fixture(scope="module")
+def rollout_corpus(tmp_path_factory):
+    prefix = str(tmp_path_factory.mktemp("rollout") / "corpus")
+    conv = GameConverter(ROLLOUT_FEATURES, board_size=SIZE)
+    conv.sgfs_to_shards(conv._iter_sgf_files(SGF_DIR, recurse=False),
+                        prefix)
+    return prefix
+
+
+def test_converter_emits_rollout_planes(rollout_corpus):
+    with open(f"{rollout_corpus}-manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["planes"] == 20          # 3+1+8+8
+    assert manifest["features"] == list(ROLLOUT_FEATURES)
+    assert manifest["shard_counts"]
+
+
+def test_rollout_net_trains_and_drives_mcts(rollout_corpus, tmp_path):
+    out = tmp_path / "out"
+    net = CNNRollout(board=SIZE, filters=8)
+    cfg = SLConfig(train_data=rollout_corpus, out_dir=str(out),
+                   minibatch=16, epochs=1, learning_rate=0.05,
+                   train_val_test=(0.8, 0.1, 0.1), symmetries=False,
+                   seed=0, max_validation_batches=2)
+    result = SLTrainer(cfg, net=net).run()
+    assert np.isfinite(result["train_loss"])
+    assert result["step"] > 0
+
+    # the exported spec round-trips as a CNNRollout
+    trained = NeuralNetBase.load_model(str(out / "model.json"))
+    assert isinstance(trained, CNNRollout)
+    assert trained.feature_list == ROLLOUT_FEATURES
+
+    # ... and is consumable as the MCTS rollout policy, host + device
+    policy = CNNPolicy(("board", "ones"), board=SIZE, layers=2,
+                       filters_per_layer=4)
+    value = CNNValue(("board", "ones"), board=SIZE, layers=2,
+                     filters_per_layer=4, dense_units=8)
+    for device_rollout in (False, True):
+        player = MCTSPlayer(value, policy, rollout=trained, lmbda=1.0,
+                            n_playout=4, leaf_batch=2, rollout_limit=8,
+                            playout_depth=2, seed=0,
+                            device_rollout=device_rollout)
+        state = pygo.GameState(size=SIZE)
+        move = player.get_move(state)
+        assert move is None or state.is_legal(move)

@@ -7,6 +7,8 @@ SURVEY.md §7 step 6) at test scale: tiny nets, small board, few games.
 import io
 import json
 
+import pytest
+
 from rocalphago_tpu.models import CNNPolicy
 from rocalphago_tpu.interface.tournament import play_match, run_tournament
 from rocalphago_tpu.search.players import (
@@ -41,4 +43,15 @@ def test_run_tournament_alternates_colors_and_tallies():
     entries = [json.loads(line) for line in
                log.getvalue().strip().splitlines()]
     assert [e["black"] for e in entries] == ["A", "B", "A", "B"]
-    assert 0.0 <= tally["win_rate_a"] + tally["win_rate_b"] <= 1.0 + 1e-9
+    # win rates are over decided games, draws tallied separately
+    decided = tally["wins"]["A"] + tally["wins"]["B"]
+    if decided:
+        assert tally["win_rate_a"] + tally["win_rate_b"] == \
+            pytest.approx(1.0)
+
+
+def test_run_tournament_rejects_bad_names():
+    a, b = make_players()
+    for names in (("X", "X"), ("draw", "B")):
+        with pytest.raises(ValueError, match="names"):
+            run_tournament(a, b, games=1, size=SIZE, names=names)
