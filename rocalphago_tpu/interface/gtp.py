@@ -156,11 +156,9 @@ class GTPEngine:
     def _player_board(self):
         """Fixed board size the wrapped player's nets were built for
         (None when the player is size-agnostic)."""
-        board = getattr(self.player, "board", None)
-        if board is None:
-            policy = getattr(self.player, "policy", None)
-            board = getattr(policy, "board", None)
-        return board
+        from rocalphago_tpu.search.players import player_board
+
+        return player_board(self.player)
 
     def cmd_boardsize(self, args):
         size = int(args[0])

@@ -71,18 +71,29 @@ def run_tournament(player_a, player_b, games: int, size: int = 19,
             "win_rate_b": tally[1] / decided}
 
 
-def _build_player(spec: str, temperature: float, playouts: int):
-    """``kind:policy.json[:value.json[:rollout.json]]`` → agent."""
-    from rocalphago_tpu.search.players import build_player
+def _build_player(spec: str, temperature: float, playouts: int,
+                  device_rollout: bool = False, board: int | None = None):
+    """``kind:policy.json[:value.json[:rollout.json]]`` → agent.
+    With ``board``, reject nets compiled for a different size up front
+    (the same guard GTP's boardsize applies) instead of crashing with
+    a shape error mid-game."""
+    from rocalphago_tpu.search.players import build_player, player_board
 
     parts = spec.split(":")
     try:
-        return build_player(parts[0], parts[1],
-                            parts[2] if len(parts) > 2 else None,
-                            parts[3] if len(parts) > 3 else None,
-                            temperature=temperature, playouts=playouts)
+        player = build_player(parts[0], parts[1],
+                              parts[2] if len(parts) > 2 else None,
+                              parts[3] if len(parts) > 3 else None,
+                              temperature=temperature, playouts=playouts,
+                              device_rollout=device_rollout)
     except (ValueError, IndexError) as e:
         raise SystemExit(f"bad player spec {spec!r}: {e}")
+    net_board = player_board(player)
+    if board is not None and net_board is not None and net_board != board:
+        raise SystemExit(
+            f"player {spec!r} nets are compiled for board "
+            f"{net_board}, but the tournament is --board {board}")
+    return player
 
 
 def main(argv=None):
@@ -96,10 +107,15 @@ def main(argv=None):
     ap.add_argument("--move-limit", type=int, default=722)
     ap.add_argument("--temperature", type=float, default=0.67)
     ap.add_argument("--playouts", type=int, default=100)
+    ap.add_argument("--device-rollout", action="store_true",
+                    help="mcts rollouts as one on-device scan per "
+                         "wave instead of host rules")
     ap.add_argument("--log", default=None, help="JSONL game log path")
     a = ap.parse_args(argv)
-    pa = _build_player(a.player_a, a.temperature, a.playouts)
-    pb = _build_player(a.player_b, a.temperature, a.playouts)
+    pa = _build_player(a.player_a, a.temperature, a.playouts,
+                       device_rollout=a.device_rollout, board=a.board)
+    pb = _build_player(a.player_b, a.temperature, a.playouts,
+                       device_rollout=a.device_rollout, board=a.board)
     log = open(a.log, "w") if a.log else None
     try:
         tally = run_tournament(pa, pb, a.games, size=a.board,

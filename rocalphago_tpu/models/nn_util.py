@@ -156,6 +156,25 @@ class NeuralNetBase:
         return self.preprocess.states_to_tensor(batched)
 
     @staticmethod
+    def _pad_bucket(planes: jax.Array, min_bucket: int = 8):
+        """Pad the batch axis up to the next power-of-two bucket.
+
+        Host-facing eval batch sizes vary call to call (MCTS waves
+        dedup to different leaf counts, game batches shrink as games
+        finish); without bucketing every first-seen size costs a full
+        XLA compile of the forward — 20–40s on TPU. Returns
+        ``(padded_planes, real_batch)``; callers slice outputs back to
+        ``real_batch``."""
+        b = planes.shape[0]
+        bucket = min_bucket
+        while bucket < b:
+            bucket *= 2
+        if bucket == b:
+            return planes, b
+        pad = jnp.zeros((bucket - b,) + planes.shape[1:], planes.dtype)
+        return jnp.concatenate([planes, pad]), b
+
+    @staticmethod
     def _as_state_list(states):
         """Normalize eval inputs to a list of single-game states
         (splits a batched ``GoState`` into per-game views)."""
@@ -203,15 +222,15 @@ class NeuralNetBase:
         try:
             self.params = serialization.from_bytes(self.params, data)
         except (ValueError, KeyError) as e:
-            # legacy specs carry no format field, so layout mismatches
-            # (pre-ConvTrunk exports) surface here — fail with the
-            # format story instead of a bare msgpack/pytree error
+            # surface pytree mismatches with the likely causes instead
+            # of a bare msgpack error; don't over-claim which one it is
             raise ValueError(
                 f"{weights_file} does not match this architecture's "
-                f"parameter tree (model-spec format {SPEC_FORMAT}); "
-                "the weights were exported under an older layout — "
-                "re-export the model with the matching framework "
-                f"version ({e})") from e
+                "parameter tree: the file may belong to a different "
+                "network class/size, be corrupt or truncated, or have "
+                "been exported under an older param-tree layout "
+                f"(current model-spec format {SPEC_FORMAT}). "
+                f"Underlying error: {e}") from e
 
     @staticmethod
     def load_model(json_file: str) -> "NeuralNetBase":
@@ -338,7 +357,17 @@ class PointPolicyEval:
         of the search hot path). ``symmetric`` ensembles the forward
         over the 8 board symmetries (8× device work)."""
         states = self._as_state_list(states)
-        planes = self._states_to_planes(states)
+        return self.dists_from_planes(
+            states, self._states_to_planes(states), moves_lists,
+            symmetric=symmetric)
+
+    def dists_from_planes(self, states, planes, moves_lists=None,
+                          symmetric: bool = False):
+        """As :meth:`batch_eval_state`, from already-encoded ``planes``
+        — the seam that lets a caller encode ONCE and share the planes
+        between nets (the MCTS wave's policy/value fusion: the 48-plane
+        encode dominates wave cost, so paying it twice halves sims/s)."""
+        planes, b = self._pad_bucket(planes)
         logits = self.forward_symmetric(planes) if symmetric \
             else self.forward(planes)
         sizes, legal_rows = [], []
@@ -357,6 +386,10 @@ class PointPolicyEval:
             sizes.append(size)
             legal_rows.append(legal)
         legal_b = np.stack(legal_rows)
+        if logits.shape[0] > b:      # padded rows: all-illegal → zeros
+            legal_b = np.concatenate(
+                [legal_b, np.zeros((logits.shape[0] - b,
+                                    legal_b.shape[1]), bool)])
         probs = np.asarray(masked_probs(logits, jnp.asarray(legal_b)))
         out = []
         for i, size in enumerate(sizes):

@@ -82,5 +82,12 @@ class CNNValue(NeuralNetBase):
     def batch_eval_state(self, states,
                          symmetric: bool = False) -> np.ndarray:
         planes = self._states_to_planes(self._as_state_list(states))
+        return self.values_from_planes(planes, symmetric=symmetric)
+
+    def values_from_planes(self, planes,
+                           symmetric: bool = False) -> np.ndarray:
+        """Forward from already-encoded planes (encode-sharing seam;
+        see ``PointPolicyEval.dists_from_planes``)."""
+        planes, b = self._pad_bucket(planes)  # stable compiled shapes
         fwd = self.forward_symmetric if symmetric else self.forward
-        return np.asarray(fwd(planes))
+        return np.asarray(fwd(planes))[:b]

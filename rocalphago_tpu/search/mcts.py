@@ -228,13 +228,17 @@ class ParallelMCTS(MCTS):
     def __init__(self, batch_value_fn, batch_policy_fn, batch_rollout_fn,
                  lmbda: float = 0.5, c_puct: float = 5.0,
                  rollout_limit: int = 500, playout_depth: int = 20,
-                 n_playout: int = 10000, leaf_batch: int = 8, rng=None):
+                 n_playout: int = 10000, leaf_batch: int = 8, rng=None,
+                 batch_policy_value_fn=None):
         super().__init__(batch_value_fn, batch_policy_fn, batch_rollout_fn,
                          lmbda=lmbda, c_puct=c_puct,
                          rollout_limit=rollout_limit,
                          playout_depth=playout_depth, n_playout=n_playout,
                          rng=rng)
         self._leaf_batch = leaf_batch
+        # optional fused evaluator: (states, want_priors flags) →
+        # (priors list, values) off ONE shared encode per wave
+        self._pv = batch_policy_value_fn
 
     def get_move(self, state):
         waves, rem = divmod(self._n_playout, self._leaf_batch)
@@ -276,15 +280,28 @@ class ParallelMCTS(MCTS):
         need_priors = [i for i in live if nodes[i].is_leaf()]
         priors = [None] * len(nodes)
         values = np.zeros(len(nodes))
-        if need_priors:
-            dists = self._policy([leaf_states[i] for i in need_priors])
-            for i, pri in zip(need_priors, dists):
-                priors[i] = pri
         if live:
             live_states = [leaf_states[i] for i in live]
-            if self._lmbda < 1.0:
-                vals = np.asarray(self._value(live_states), np.float64)
-                values[live] += (1.0 - self._lmbda) * vals
+            if self._pv is not None and self._lmbda < 1.0:
+                # fused path: one shared encode for priors AND values
+                need = set(need_priors)
+                dists, vals = self._pv(live_states,
+                                       [i in need for i in live])
+                for k, i in enumerate(live):
+                    if dists[k] is not None:
+                        priors[i] = dists[k]
+                values[live] += (1.0 - self._lmbda) * np.asarray(
+                    vals, np.float64)
+            else:
+                if need_priors:
+                    dists = self._policy(
+                        [leaf_states[i] for i in need_priors])
+                    for i, pri in zip(need_priors, dists):
+                        priors[i] = pri
+                if self._lmbda < 1.0:
+                    vals = np.asarray(self._value(live_states),
+                                      np.float64)
+                    values[live] += (1.0 - self._lmbda) * vals
             if self._lmbda > 0.0:
                 outs = np.asarray(
                     self._rollout([s.copy() for s in live_states]),
@@ -389,6 +406,33 @@ def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
     def batch_value(states):
         return value.batch_eval_state(states, symmetric=symmetric)
 
+    # Fused wave evaluation: when the value features are exactly the
+    # policy features + the color plane (the AlphaGo 48/49 layout),
+    # the expensive 48-plane encode is paid ONCE per wave and shared —
+    # the policy forward reads a prefix slice of the value planes.
+    # (Symmetric mode keeps the separate paths: the two nets ensemble
+    # differently.)
+    batch_policy_value = None
+    nested = (tuple(value.feature_list[:-1]) == tuple(policy.feature_list)
+              and value.feature_list[-1] == "color")
+    if nested and not symmetric:
+        n_policy_planes = policy.preprocess.output_dim
+
+        def batch_policy_value(states, want_priors):
+            planes = value._states_to_planes(states)
+            vals = value.values_from_planes(planes)
+            priors = [None] * len(states)
+            pidx = [i for i, w in enumerate(want_priors) if w]
+            if pidx:
+                sub = [states[i] for i in pidx]
+                sensible = [s.get_legal_moves(include_eyes=False)
+                            for s in sub]
+                pplanes = planes[np.asarray(pidx)][..., :n_policy_planes]
+                for i, d in zip(pidx, policy.dists_from_planes(
+                        sub, pplanes, sensible)):
+                    priors[i] = d
+            return priors, vals
+
     rollout_net = rollout or policy
 
     if device_rollout:
@@ -396,7 +440,8 @@ def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
                 device_rollout_fn(rollout_net,
                                   rollout_limit=rollout_limit,
                                   min_batch=leaf_batch,
-                                  seed=int(rng.integers(2**31))))
+                                  seed=int(rng.integers(2**31))),
+                batch_policy_value)
 
     def batch_rollout(states):
         entry_players = [s.current_player for s in states]
@@ -425,7 +470,7 @@ def net_backends(policy, value, rollout=None, rollout_limit: int = 500,
             outs.append(0.0 if w == 0 else (1.0 if w == player else -1.0))
         return outs
 
-    return batch_value, batch_policy, batch_rollout
+    return batch_value, batch_policy, batch_rollout, batch_policy_value
 
 
 class MCTSPlayer:
@@ -446,16 +491,17 @@ class MCTSPlayer:
                  symmetric: bool = False, device_rollout: bool = False):
         self.board = policy.board   # GTP boardsize validation
         rng = np.random.default_rng(seed)
-        bv, bp, br = net_backends(policy, value, rollout,
-                                  rollout_limit=rollout_limit, rng=rng,
-                                  symmetric=symmetric,
-                                  device_rollout=device_rollout,
-                                  leaf_batch=leaf_batch)
+        bv, bp, br, bpv = net_backends(policy, value, rollout,
+                                       rollout_limit=rollout_limit,
+                                       rng=rng, symmetric=symmetric,
+                                       device_rollout=device_rollout,
+                                       leaf_batch=leaf_batch)
         self.mcts = ParallelMCTS(bv, bp, br, lmbda=lmbda, c_puct=c_puct,
                                  rollout_limit=rollout_limit,
                                  playout_depth=playout_depth,
                                  n_playout=n_playout,
-                                 leaf_batch=leaf_batch, rng=rng)
+                                 leaf_batch=leaf_batch, rng=rng,
+                                 batch_policy_value_fn=bpv)
         self._tree_history: list | None = None
 
     def _sync_tree(self, history: list) -> None:

@@ -139,6 +139,16 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
     raise ValueError(f"unknown player kind {kind!r}")
 
 
+def player_board(player) -> int | None:
+    """Fixed board size the player's nets were compiled for, or None
+    for size-agnostic players (shared by the GTP boardsize guard and
+    the tournament CLI's --board validation)."""
+    board = getattr(player, "board", None)
+    if board is None:
+        board = getattr(getattr(player, "policy", None), "board", None)
+    return board
+
+
 def reset_player(player) -> None:
     """Clear any per-game search state (new game starting)."""
     mcts = getattr(player, "mcts", None)

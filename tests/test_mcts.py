@@ -252,6 +252,35 @@ class TestDeviceRollout:
         assert state.is_legal(move)
 
 
+def test_fused_policy_value_path_matches_separate():
+    """With the canonical nested feature layout (value = policy +
+    color) the wave evaluator shares one encode; the search must be
+    identical to the separate-backends path (same nets, same seed)."""
+    policy = CNNPolicy(("board", "ones"), board=SIZE, layers=2,
+                       filters_per_layer=4)
+    value = CNNValue(("board", "ones", "color"), board=SIZE, layers=2,
+                     filters_per_layer=4, dense_units=8)
+
+    def run(force_separate):
+        rng = np.random.default_rng(0)
+        bv, bp, br, bpv = net_backends(policy, value, rng=rng)
+        if force_separate:
+            bpv = None
+        else:
+            assert bpv is not None, "nested layout must fuse"
+        mcts = ParallelMCTS(bv, bp, br, lmbda=0.0, n_playout=24,
+                            leaf_batch=8, playout_depth=4,
+                            rng=np.random.default_rng(1),
+                            batch_policy_value_fn=bpv)
+        state = pygo.GameState(size=SIZE)
+        move = mcts.get_move(state)
+        visits = {m: c._n_visits
+                  for m, c in mcts._root._children.items()}
+        return move, visits
+
+    assert run(False) == run(True)
+
+
 def test_mcts_player_alternating_game_stays_synced():
     """Regression: opponent moves between get_move calls must re-root
     or reset the reused subtree, never desync it (a desynced tree
