@@ -53,32 +53,45 @@ def _measure() -> None:
 
     from rocalphago_tpu.engine.jaxgo import GoConfig
     from rocalphago_tpu.models import CNNPolicy
-    from rocalphago_tpu.search.selfplay import make_selfplay
+    from rocalphago_tpu.search.selfplay import host_winners, play_games
 
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    batch = 128 if on_tpu else 16
-    max_moves = 420 if on_tpu else 60
+    # CPU numbers are a liveness fallback, not the perf story — keep
+    # the program small enough that compile + one rep fits the attempt
+    # timeout comfortably
+    batch = 64 if on_tpu else 8
+    max_moves = 300 if on_tpu else 40
 
     cfg = GoConfig(size=19)
     net = CNNPolicy(board=19, layers=12, filters_per_layer=128)
-    run = make_selfplay(cfg, net.feature_list, net.module.apply,
-                        net.module.apply, batch=batch,
-                        max_moves=max_moves, temperature=1.0)
+
+    # terminal scoring happens on host: it shaves the whole-board
+    # region labeling off the compiled program (smaller graph for the
+    # experimental backend to chew), and costs microseconds per game
+    @jax.jit
+    def run(params_a, params_b, rng):
+        res = play_games(cfg, net.feature_list, net.module.apply,
+                         params_a, net.module.apply, params_b, rng,
+                         batch, max_moves, temperature=1.0,
+                         score_on_device=False)
+        return res.final.board, res.num_moves
+
+    def one(r):
+        boards, _ = run(net.params, net.params, jax.random.key(r))
+        return host_winners(cfg, jax.device_get(boards))
 
     # compile (excluded from timing); jax.device_get forces a host
     # transfer, which waits for real completion even on backends where
     # block_until_ready returns early (axon tunnel)
-    res = run(net.params, net.params, jax.random.key(0))
-    jax.device_get(res.winners)
+    one(0)
 
     # adaptive reps: stop once ~2 minutes of measurement accumulate so
     # the driver's round-end run always completes
     reps, t0 = 0, time.time()
     for r in range(1, 4):
-        res = run(net.params, net.params, jax.random.key(r))
-        jax.device_get(res.winners)
+        one(r)
         reps = r
         if time.time() - t0 > 120:
             break
@@ -96,6 +109,22 @@ def _measure() -> None:
         "batch": batch,
         "max_moves": max_moves,
     }))
+
+
+def _preflight(timeout: float = 90.0) -> bool:
+    """Can the default (TPU) backend run a tiny matmul right now?
+
+    The axon tunnel can wedge (a killed client mid-execution leaves
+    the worker unresponsive); attempting the big program then burns
+    the whole per-attempt timeout. A 90s probe decides cheaply."""
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((256, 256)); print((x @ x).sum())")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=timeout)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def _run_child(extra_env: dict, timeout: float):
@@ -133,17 +162,26 @@ def main() -> int:
             if "xla_force_host_platform_device_count" not in f),
     }
     # (env overrides, per-attempt timeout, backoff before the attempt);
-    # worst case (every attempt hangs to its timeout) stays under ~40
-    # minutes so the error JSON still lands inside a driver budget
+    # worst case — every preflight passes yet every child hangs to its
+    # timeout — is 90+1080+20+90+540+540 ≈ 39.3 min, inside a ~40-min
+    # driver budget, and the error JSON still lands. TPU attempts are
+    # gated on the preflight so a wedged tunnel costs 90s each, not
+    # the full attempt timeout.
     attempts = [
-        ({}, 1200.0, 0.0),      # default backend (TPU when attached)
-        ({}, 600.0, 20.0),      # retry: transient UNAVAILABLE / contention
-        (cpu_env, 600.0, 0.0),  # last resort: measure on host CPU
+        ({}, 1080.0, 0.0, True),    # default backend (TPU if attached)
+        ({}, 540.0, 20.0, True),    # retry: transient UNAVAILABLE
+        (cpu_env, 540.0, 0.0, False),  # last resort: host CPU
     ]
     errors = []
-    for extra_env, timeout, backoff in attempts:
+    for extra_env, timeout, backoff, needs_preflight in attempts:
         if backoff:
             time.sleep(backoff)
+        if needs_preflight and not _preflight():
+            errors.append("preflight failed: default backend "
+                          "unresponsive")
+            print("bench: skipping backend attempt (preflight failed)",
+                  file=sys.stderr)
+            continue
         parsed, err = _run_child(extra_env, timeout)
         if parsed is not None:
             print(json.dumps(parsed))

@@ -79,6 +79,41 @@ def _prey_libs(cfg: GoConfig, board, prey_pt):
     return jnp.where(board[prey_pt] == 0, 0, libs), gd
 
 
+def _dilate2d(size: int, m):
+    """bool [size, size] → self ∪ 4-neighborhood, via pad + static
+    slices (pure vector ops, same trick as ``compute_labels``)."""
+    p = jnp.pad(m, 1)
+    return (m | p[2:, 1:-1] | p[:-2, 1:-1]
+            | p[1:-1, 2:] | p[1:-1, :-2])
+
+
+def _local_prey_libs(cfg: GoConfig, board, prey_pt):
+    """Liberty count of the group at ``prey_pt`` — EXACT, via a local
+    connected-component fill (dilate-within-color to fixpoint) instead
+    of the whole-board labeling. Converges in group-diameter steps
+    (4 unrolled per trip), so for the small, incrementally-grown prey
+    groups of a ladder read it replaces the most expensive inner
+    ``group_data`` calls (7 full flood fills per rung → 3) at
+    identical results."""
+    size = cfg.size
+    color = board[prey_pt]
+    own = (board == color).reshape(size, size)
+    seed = jnp.zeros((size, size), jnp.bool_).at[
+        prey_pt // size, prey_pt % size].set(color != 0)
+
+    def body(carry):
+        mask, _ = carry
+        new = mask
+        for _ in range(4):
+            new = _dilate2d(size, new) & own
+        return new | mask, mask
+
+    mask, _ = lax.while_loop(lambda c: (c[0] != c[1]).any(), body,
+                             (seed, jnp.zeros_like(seed)))
+    libs = _dilate2d(size, mask) & (board == 0).reshape(size, size)
+    return jnp.where(color == 0, 0, libs.sum().astype(jnp.int32))
+
+
 def _escaper_response(cfg: GoConfig, board, prey_pt, prey_color,
                       libs0=None, gd=None):
     """Best forced response of a prey in atari: extend at the last
@@ -107,7 +142,7 @@ def _escaper_response(cfg: GoConfig, board, prey_pt, prey_color,
 
     def try_move(pt, enabled):
         b1, ok = _place(cfg, board, gd, pt, prey_color)
-        L, _ = _prey_libs(cfg, b1, prey_pt)
+        L = _local_prey_libs(cfg, b1, prey_pt)
         return jnp.where(enabled & ok, L, -1), b1
 
     L1, B1 = try_move(ext, libs0 >= 1)

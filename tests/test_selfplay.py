@@ -45,6 +45,17 @@ def test_selfplay_terminates_and_scores(result):
     assert (moves > 2).all() and (moves <= 80).all()
 
 
+def test_host_winners_matches_device_scoring(result):
+    """The host scorer benchmarks rely on must agree with the device
+    winner() on real final boards."""
+    from rocalphago_tpu.search.selfplay import host_winners
+
+    cfg = GoConfig(size=SIZE)
+    device = np.asarray(result.winners)
+    host = host_winners(cfg, np.asarray(result.final.board))
+    np.testing.assert_array_equal(device, host)
+
+
 def test_selfplay_trajectories_replay_legally(result):
     """Replaying the recorded actions through the host oracle engine
     must raise no IllegalMove and reproduce the final boards."""
