@@ -276,33 +276,7 @@ class GameState:
         Empty regions touching only one color count for that color;
         neutral (dame) regions touching both count for neither.
         """
-        board = self.board
-        visited = np.zeros_like(board, dtype=bool)
-        black = int(np.sum(board == BLACK))
-        white = int(np.sum(board == WHITE))
-        for x in range(self.size):
-            for y in range(self.size):
-                if board[x, y] != EMPTY or visited[x, y]:
-                    continue
-                region, borders = [], set()
-                frontier = [(x, y)]
-                while frontier:
-                    p = frontier.pop()
-                    if visited[p]:
-                        continue
-                    visited[p] = True
-                    region.append(p)
-                    for n in self.get_neighbors(p):
-                        if board[n] == EMPTY:
-                            if not visited[n]:
-                                frontier.append(n)
-                        else:
-                            borders.add(int(board[n]))
-                if borders == {BLACK}:
-                    black += len(region)
-                elif borders == {WHITE}:
-                    white += len(region)
-        return float(black), float(white) + self.komi
+        return score_board(self.board, self.komi)
 
     def get_winner(self):
         """BLACK, WHITE, or 0 for a drawn game (reference:
@@ -316,6 +290,44 @@ class GameState:
 
     def get_current_player(self):
         return self.current_player
+
+
+def score_board(board: np.ndarray, komi: float):
+    """Area (Chinese) scores ``(black, white + komi)`` of a raw board
+    array — the single scoring implementation behind both
+    :meth:`GameState.get_scores` and the benchmarks' batched host
+    scorer (:func:`rocalphago_tpu.search.selfplay.host_winners`)."""
+    board = np.asarray(board)
+    size = board.shape[0]
+    visited = np.zeros_like(board, dtype=bool)
+    black = int(np.sum(board == BLACK))
+    white = int(np.sum(board == WHITE))
+    for x in range(size):
+        for y in range(size):
+            if board[x, y] != EMPTY or visited[x, y]:
+                continue
+            region, borders = [], set()
+            frontier = [(x, y)]
+            while frontier:
+                p = frontier.pop()
+                if visited[p]:
+                    continue
+                visited[p] = True
+                region.append(p)
+                px, py = p
+                for nx, ny in ((px + 1, py), (px - 1, py),
+                               (px, py + 1), (px, py - 1)):
+                    if 0 <= nx < size and 0 <= ny < size:
+                        if board[nx, ny] == EMPTY:
+                            if not visited[nx, ny]:
+                                frontier.append((nx, ny))
+                        else:
+                            borders.add(int(board[nx, ny]))
+            if borders == {BLACK}:
+                black += len(region)
+            elif borders == {WHITE}:
+                white += len(region)
+    return float(black), float(white) + komi
 
 
 def _group_on(board: np.ndarray, point, size: int):

@@ -129,9 +129,10 @@ def play_games(cfg: GoConfig, features: tuple,
     if score_on_device:
         winners = jax.vmap(functools.partial(winner, cfg))(final)
     else:
-        # caller scores the final boards on host (:func:`host_winners`)
-        # — keeps the whole-board region labeling out of the program
-        winners = jnp.zeros((batch,), jnp.int32)
+        # caller scores the final boards on host (:func:`host_winners`);
+        # sentinel 2 (impossible winner value) so accidentally reading
+        # .winners fails loudly instead of looking like all-draws
+        winners = jnp.full((batch,), 2, jnp.int32)
     return SelfplayResult(final, actions, live, winners,
                           live.sum(axis=0, dtype=jnp.int32))
 
@@ -152,42 +153,20 @@ def make_selfplay(cfg: GoConfig, features: tuple, apply_a: Callable,
 def host_winners(cfg: GoConfig, boards: np.ndarray) -> np.ndarray:
     """Area-score final boards on HOST: int32 [B] (+1/-1/0).
 
-    Equivalent to ``vmap(winner)`` but in numpy — benchmarks use it to
-    keep whole-board region labeling out of the compiled program
-    (scoring happens once per game; a host BFS is microseconds and
-    shrinks the XLA graph the experimental TPU backend must handle).
+    Equivalent to ``vmap(winner)`` but in numpy (the oracle's
+    :func:`pygo.score_board` per board) — benchmarks use it to keep
+    whole-board region labeling out of the compiled program (scoring
+    happens once per game; a host BFS is microseconds and shrinks the
+    XLA graph the experimental TPU backend must handle).
     """
+    from rocalphago_tpu.engine.pygo import score_board
+
     size = cfg.size
     boards = np.asarray(boards, np.int8).reshape(-1, size, size)
     out = np.zeros(len(boards), np.int32)
     for b, board in enumerate(boards):
-        black = int((board == 1).sum())
-        white = int((board == -1).sum())
-        visited = np.zeros((size, size), bool)
-        for x in range(size):
-            for y in range(size):
-                if board[x, y] != 0 or visited[x, y]:
-                    continue
-                region, borders, frontier = [], set(), [(x, y)]
-                while frontier:
-                    px, py = frontier.pop()
-                    if visited[px, py]:
-                        continue
-                    visited[px, py] = True
-                    region.append((px, py))
-                    for nx, ny in ((px + 1, py), (px - 1, py),
-                                   (px, py + 1), (px, py - 1)):
-                        if 0 <= nx < size and 0 <= ny < size:
-                            v = board[nx, ny]
-                            if v == 0:
-                                frontier.append((nx, ny))
-                            else:
-                                borders.add(int(v))
-                if borders == {1}:
-                    black += len(region)
-                elif borders == {-1}:
-                    white += len(region)
-        diff = black - (white + cfg.komi)
+        black, white = score_board(board, cfg.komi)
+        diff = black - white
         out[b] = 0 if diff == 0 else (1 if diff > 0 else -1)
     return out
 
