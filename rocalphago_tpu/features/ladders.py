@@ -70,7 +70,7 @@ def _place(cfg: GoConfig, board, gd: GroupData, action, color):
     ok = (board[action] == 0) & (has_empty | own_safe | cap_k.any())
 
     new_board = jnp.where(captured, 0, board).at[action].set(color)
-    return jnp.where(ok, new_board, board), ok
+    return jnp.where(ok, new_board, board), ok, captured & ok
 
 
 def _prey_libs(cfg: GoConfig, board, prey_pt):
@@ -90,11 +90,11 @@ def _dilate2d(size: int, m):
 def _local_prey_libs(cfg: GoConfig, board, prey_pt):
     """Liberty count of the group at ``prey_pt`` — EXACT, via a local
     connected-component fill (dilate-within-color to fixpoint) instead
-    of the whole-board labeling. Converges in group-diameter steps
-    (4 unrolled per trip), so for the small, incrementally-grown prey
-    groups of a ladder read it replaces the most expensive inner
-    ``group_data`` calls (7 full flood fills per rung → 3) at
-    identical results."""
+    of the whole-board labeling; converges in group-diameter steps
+    (4 unrolled per trip). Used where a single post-move group must be
+    measured outside the algebraic rung path (the ladder_escape
+    opening move, whose extension may merge groups), and by tests as
+    an independent check of ``_escaper_response_fast``'s algebra."""
     size = cfg.size
     color = board[prey_pt]
     own = (board == color).reshape(size, size)
@@ -114,41 +114,117 @@ def _local_prey_libs(cfg: GoConfig, board, prey_pt):
     return jnp.where(color == 0, 0, libs.sum().astype(jnp.int32))
 
 
-def _escaper_response(cfg: GoConfig, board, prey_pt, prey_color,
-                      libs0=None, gd=None):
-    """Best forced response of a prey in atari: extend at the last
-    liberty or counter-capture an adjacent chasing group in atari.
-    Returns (libs_after_best, board_after_best); libs -1 if no legal
-    response exists. Pass ``(libs0, gd)`` when the caller already
-    analyzed ``board`` — each dropped ``group_data`` call removes a
-    full flood fill from the sequential ladder read."""
+def _escaper_response_fast(cfg: GoConfig, b1, prey_pt, prey_color,
+                           prey_mask, gd0, c_pt, cap0):
+    """Best forced response of a prey left in atari by the chaser's
+    move at ``c_pt``: extend at the last liberty, or counter-capture an
+    adjacent chasing group in atari. Unlike a recompute-everything
+    formulation, this derives the whole 2-ply analysis from the rung's
+    single pre-move analysis ``gd0`` — ZERO extra flood fills.
+
+    Exactness (case by case, ``chaser = -prey_color``):
+
+    * the chaser's move cannot change the PREY group's membership
+      (it fills a liberty or captures other prey-colored groups), so
+      ``prey_mask`` from ``gd0`` is valid on ``b1``;
+    * chaser groups touching ``c_pt`` merged into one group ``Gc``;
+      its mask/liberties are computed directly;
+    * a chaser group adjacent to a stone the chaser's move captured
+      (``cap0``) GAINED at least one liberty, so it has ≥2 now and can
+      be neither a counter-capture target nor capturable — excluding
+      them outright is exact;
+    * every other chaser group is untouched, so ``gd0`` lib counts
+      hold, and a 1-liberty group's last liberty is any empty point
+      adjacent to it;
+    * prey-colored groups surviving on ``b1`` are unchanged, so merges
+      from an extension are unions of ``gd0`` label masks.
+
+    Returns ``(preyL1, libs_after_best, board_after_best)`` where
+    ``preyL1`` is the prey's liberty count on ``b1`` (callers gate on
+    it); libs_after_best is -1 when no legal response exists.
+    """
     n = cfg.num_points
-    nbrs = neighbors_for(cfg.size)
-    if gd is None:
-        libs0, gd = _prey_libs(cfg, board, prey_pt)
-    lab_pad = jnp.concatenate([gd.labels, jnp.full((1,), n, jnp.int32)])
-    root = gd.labels[prey_pt]
-    empty = board == 0
-    adj_prey = (lab_pad[nbrs] == root).any(axis=1)
+    size = cfg.size
+    nbrs = neighbors_for(size)
+    chaser = -prey_color
+    lab_pad0 = jnp.concatenate(
+        [gd0.labels, jnp.full((1,), n, jnp.int32)])
+    b1_pad = jnp.concatenate([b1, jnp.zeros((1,), b1.dtype)])
+    empty1 = b1 == 0
 
-    ext = jnp.argmax(empty & adj_prey).astype(jnp.int32)
+    def dil(mask):
+        return _dilate2d(size, mask.reshape(size, size)).reshape(-1)
 
-    chaser_atari = (board == -prey_color) & adj_prey & (
-        gd.lib_counts[gd.labels] == 1)
-    have_cap = chaser_atari.any()
-    cap_root = gd.labels[jnp.argmax(chaser_atari)]
-    cap_adj = (lab_pad[nbrs] == cap_root).any(axis=1)
-    cap_pt = jnp.argmax(empty & cap_adj).astype(jnp.int32)
+    dil_prey = dil(prey_mask)
+    prey_libs1 = empty1 & dil_prey
+    preyL1 = prey_libs1.sum().astype(jnp.int32)
+    ext_pt = jnp.argmax(prey_libs1).astype(jnp.int32)
+
+    # the merged chaser group around c_pt
+    c_nbr_roots = lab_pad0[nbrs[c_pt]]
+    c_nbr_chaser = b1_pad[nbrs[c_pt]] == chaser
+    gc_mask = (gd0.labels[:, None] == jnp.where(
+        c_nbr_chaser, c_nbr_roots, -2)[None, :]).any(axis=1)
+    gc_mask = gc_mask.at[c_pt].set(True)
+    gc_pad = jnp.concatenate([gc_mask, jnp.zeros((1,), jnp.bool_)])
+    gc_nlibs = (empty1 & dil(gc_mask)).sum()
+
+    # chaser groups that gained a liberty from the chaser-move capture
+    gained_pt = (b1 == chaser) & dil(cap0)
+    gained_root = jnp.zeros((n + 1,), jnp.bool_).at[gd0.labels].max(
+        gained_pt)
+
+    # counter-capture target: first (lowest-index) chaser stone
+    # adjacent to the prey whose group is in atari on b1
+    adj_prey = (b1 == chaser) & dil(prey_mask)
+    atari_pts = adj_prey & jnp.where(
+        gc_mask, gc_nlibs == 1,
+        (gd0.lib_counts[gd0.labels] == 1) & ~gained_root[gd0.labels])
+    have_cap = atari_pts.any()
+    target = jnp.argmax(atari_pts).astype(jnp.int32)
+    target_mask = jnp.where(gc_mask[target], gc_mask,
+                            gd0.labels == gd0.labels[target])
+    cap_pt = jnp.argmax(empty1 & dil(target_mask)).astype(jnp.int32)
 
     def try_move(pt, enabled):
-        b1, ok = _place(cfg, board, gd, pt, prey_color)
-        L = _local_prey_libs(cfg, b1, prey_pt)
-        return jnp.where(enabled & ok, L, -1), b1
+        onehot = jnp.zeros((n,), jnp.bool_).at[pt].set(True)
+        pt_nbr_roots = lab_pad0[nbrs[pt]]
+        pt_nbr_chaser = b1_pad[nbrs[pt]] == chaser
+        pt_nbr_in_gc = gc_pad[nbrs[pt]]
+        valid = nbrs[pt] < n
+        # chaser groups captured by the response: adjacent, in atari
+        # (their last liberty must then be pt itself)
+        old_cap_k = (valid & pt_nbr_chaser & ~pt_nbr_in_gc
+                     & (gd0.lib_counts[pt_nbr_roots] == 1)
+                     & ~gained_root[pt_nbr_roots])
+        esc_cap = (gd0.labels[:, None] == jnp.where(
+            old_cap_k, pt_nbr_roots, -2)[None, :]).any(axis=1)
+        gc_capped = (valid & pt_nbr_chaser & pt_nbr_in_gc).any() \
+            & (gc_nlibs == 1)
+        esc_cap = esc_cap | (gc_capped & gc_mask)
+        # the played stone's cluster: {pt} ∪ surviving own-color
+        # neighbor groups. It joins the PREY's component only when pt
+        # itself is adjacent to the prey (two distinct same-color
+        # groups are never orthogonally adjacent, so a merge partner
+        # cannot bridge them) — a counter-capture played away from the
+        # prey must not donate its own liberties to the prey's count.
+        merge_k = valid & (b1_pad[nbrs[pt]] == prey_color)
+        merge_mask = (gd0.labels[:, None] == jnp.where(
+            merge_k, pt_nbr_roots, -2)[None, :]).any(axis=1)
+        cluster = onehot | merge_mask
+        empty2 = (empty1 & ~onehot) | esc_cap
+        comp = jnp.where(dil_prey[pt], prey_mask | cluster, prey_mask)
+        L2 = (empty2 & dil(comp)).sum().astype(jnp.int32)
+        # move legality = the played stone's own group keeps a liberty
+        legal = (empty2 & dil(cluster)).any()
+        okm = enabled & empty1[pt] & legal
+        b2 = jnp.where(esc_cap, jnp.int8(0), b1).at[pt].set(prey_color)
+        return jnp.where(okm, L2, -1), jnp.where(okm, b2, b1)
 
-    L1, B1 = try_move(ext, libs0 >= 1)
+    L1, B1 = try_move(ext_pt, preyL1 >= 1)
     L2, B2 = try_move(cap_pt, have_cap)
     take1 = L1 >= L2
-    return jnp.where(take1, L1, L2), jnp.where(take1, B1, B2)
+    return preyL1, jnp.where(take1, L1, L2), jnp.where(take1, B1, B2)
 
 
 def _chase(cfg: GoConfig, board0, prey_pt, depth: int,
@@ -172,13 +248,14 @@ def _chase(cfg: GoConfig, board0, prey_pt, depth: int,
         captured: jax.Array
         rung: jax.Array
 
-    def option_outcome(board, gd, lib_pt, enabled):
+    def option_outcome(board, gd, prey_mask, lib_pt, enabled):
         """Chaser fills ``lib_pt``; returns (outcome, board after the
-        escaper's forced response)."""
-        b1, ok = _place(cfg, board, gd, lib_pt, -prey_color)
-        preyL, gd1 = _prey_libs(cfg, b1, prey_pt)
-        respL, b2 = _escaper_response(cfg, b1, prey_pt, prey_color,
-                                      libs0=preyL, gd=gd1)
+        escaper's forced response). One flood fill per RUNG (the
+        caller's ``gd``) — the post-move analysis is pure mask algebra
+        (see ``_escaper_response_fast``)."""
+        b1, ok, cap0 = _place(cfg, board, gd, lib_pt, -prey_color)
+        preyL, respL, b2 = _escaper_response_fast(
+            cfg, b1, prey_pt, prey_color, prey_mask, gd, lib_pt, cap0)
         resp_logic = jnp.where(
             respL <= 1, _CAPTURED,
             jnp.where(respL >= 3, _ESCAPED, _CONTINUE))
@@ -193,13 +270,14 @@ def _chase(cfg: GoConfig, board0, prey_pt, depth: int,
         lab_pad = jnp.concatenate(
             [gd.labels, jnp.full((1,), n, jnp.int32)])
         root = gd.labels[prey_pt]
+        prey_mask = gd.labels == root
         empty = board == 0
         lib_pts = empty & (lab_pad[nbrs] == root).any(axis=1)
         l1 = jnp.argmax(lib_pts).astype(jnp.int32)
         l2 = jnp.argmax(lib_pts & (jnp.arange(n) != l1)).astype(jnp.int32)
 
-        o1, b1 = option_outcome(board, gd, l1, L == 2)
-        o2, b2 = option_outcome(board, gd, l2, L == 2)
+        o1, b1 = option_outcome(board, gd, prey_mask, l1, L == 2)
+        o2, b2 = option_outcome(board, gd, prey_mask, l2, L == 2)
         pick1 = o1 <= o2
         o = jnp.where(pick1, o1, o2)
         nb = jnp.where(pick1, b1, b2)
@@ -259,11 +337,12 @@ def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
         cfg, state, gd, legal, prey_libs=2, prey_is_opp=True, lanes=lanes)
 
     def lane(mv, pr, ok):
-        board1, placed = _place(cfg, state.board, gd, mv, me)
-        # prey is now in atari; its forced response decides the opening
-        libs1, gd1 = _prey_libs(cfg, board1, pr)
-        respL, board2 = _escaper_response(cfg, board1, pr, -me,
-                                          libs0=libs1, gd=gd1)
+        board1, placed, cap0 = _place(cfg, state.board, gd, mv, me)
+        # prey is now in atari; its forced response decides the
+        # opening — derived from the plane-level gd, no refill
+        prey_mask = gd.labels == gd.labels[pr]
+        _, respL, board2 = _escaper_response_fast(
+            cfg, board1, pr, -me, prey_mask, gd, mv, cap0)
         need_chase = ok & placed & (respL == 2)
         captured = jnp.where(
             respL <= 1, True,
@@ -287,8 +366,9 @@ def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
         cfg, state, gd, legal, prey_libs=1, prey_is_opp=False, lanes=lanes)
 
     def lane(mv, pr, ok):
-        board1, placed = _place(cfg, state.board, gd, mv, me)
-        L, _ = _prey_libs(cfg, board1, pr)
+        board1, placed, _ = _place(cfg, state.board, gd, mv, me)
+        # own extension may merge groups — local fill stays exact
+        L = _local_prey_libs(cfg, board1, pr)
         need_chase = ok & placed & (L == 2)
         captured = jnp.where(
             L <= 1, True,

@@ -172,6 +172,68 @@ class TestLadderDifferential:
             n_captures = int(ora[:, :, 0].sum())
             assert n_captures == (0 if breaker else 1)
 
+    def test_escaper_response_algebra_self_consistent(self):
+        """Property check of the loop-free rung algebra: for random
+        chase openings, the reported response liberty count must equal
+        an independent local-fill measurement of the prey group on the
+        returned board (regression: a counter-capture played AWAY from
+        the prey once donated its own liberties to the prey's count)."""
+        import jax.numpy as jnp
+
+        from rocalphago_tpu.engine.jaxgo import group_data
+        from rocalphago_tpu.features import ladders
+
+        cfg = GoConfig(size=7, komi=5.5)
+        rng = np.random.default_rng(7)
+        checked = 0
+        for _ in range(60):
+            st = pygo.GameState(size=7, komi=5.5)
+            for _ in range(int(rng.integers(6, 26))):
+                legal = st.get_legal_moves(include_eyes=False)
+                if not legal or st.is_end_of_game:
+                    break
+                st.do_move(legal[rng.integers(len(legal))])
+            if st.is_end_of_game:
+                continue
+            jst = jaxgo.from_pygo(cfg, st)
+            gd = group_data(cfg, jst.board, with_member=False,
+                            with_zxor=False)
+            # find a 2-liberty opponent group and one of its liberties
+            me = int(jst.turn)
+            opp = (np.asarray(jst.board) == -me)
+            labels = np.asarray(gd.labels)
+            libcounts = np.asarray(gd.lib_counts)
+            roots = {labels[p] for p in np.flatnonzero(opp)
+                     if libcounts[labels[p]] == 2}
+            for root in sorted(roots)[:2]:
+                prey_pt = int(np.flatnonzero(labels == root)[0])
+                prey_mask = jnp.asarray(labels == root)
+                empty = np.asarray(jst.board) == 0
+                dil = np.asarray(ladders._dilate2d(
+                    7, jnp.asarray(labels == root).reshape(7, 7))
+                ).reshape(-1)
+                libs = np.flatnonzero(empty & dil)
+                if not len(libs):
+                    continue
+                c = int(libs[0])
+                b1, ok, cap0 = ladders._place(
+                    cfg, jst.board, gd, jnp.int32(c), jnp.int8(me))
+                if not bool(ok):
+                    continue
+                preyL, respL, b2 = ladders._escaper_response_fast(
+                    cfg, b1, jnp.int32(prey_pt), jnp.int8(-me),
+                    prey_mask, gd, jnp.int32(c), cap0)
+                if int(respL) < 0:
+                    continue
+                oracle = int(ladders._local_prey_libs(
+                    cfg, b2, jnp.int32(prey_pt)))
+                assert int(respL) == oracle, (
+                    f"algebraic respL {int(respL)} != local-fill "
+                    f"{oracle}\nboard:\n"
+                    f"{np.asarray(b2).reshape(7, 7)}")
+                checked += 1
+        assert checked >= 10
+
     def test_random_position_disagreement_rate_bounded(self):
         rng_master = np.random.default_rng(20260729)
         cells = disagreements = 0
