@@ -1,0 +1,99 @@
+"""Pallas TPU kernel: batched connected-component labeling.
+
+The engine's hottest primitive is the whole-board flood fill behind
+``jaxgo.compute_labels`` (group analysis for stepping, legality,
+features, scoring). The XLA formulation is a convergence
+``while_loop`` of min-propagation sweeps; this kernel is the
+TPU-native alternative: one grid cell per board, the whole fixpoint
+iteration running over a VMEM-resident board with zero HBM round
+trips between sweeps.
+
+Design notes (see ``/opt/skills/guides/pallas_guide.md``):
+
+* the board is tiny (≤ 25×25), so each program holds it entirely in
+  VMEM; the grid parallelizes over the batch;
+* min-propagation uses pad + static-slice shifts — pure VPU vector
+  ops; there are NO gathers (TPU vector units have no efficient
+  arbitrary gather, so the pointer-jumping trick the XLA path uses is
+  deliberately omitted here);
+* the loop is a ``fori_loop`` with a STATIC trip count chosen so the
+  result is provably exact: each sweep propagates the min label one
+  step along group connectivity, the longest possible propagation
+  chain is N-1 (a serpentine group filling the board), and the bound
+  rounds up from there. No convergence check is needed — extra sweeps
+  are idempotent.
+
+The kernel is exact but OPT-IN: the default engine path stays on the
+XLA ``while_loop`` (early exit usually wins on sparse boards, and the
+attached TPU backend is experimental). ``benchmarks/bench_labels.py``
+compares both; flipping the engine over is a one-line change in
+``jaxgo.compute_labels`` if measurements favor the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _sweeps_for(num_points: int) -> int:
+    """Static sweep count that PROVES convergence: min labels advance
+    ≥1 connectivity step per sweep and the longest chain is N-1."""
+    return num_points
+
+
+def _label_kernel(board_ref, out_ref, *, size: int, sweeps: int):
+    n = size * size
+    board = board_ref[...].reshape(size, size)
+    stone = board != 0
+    sentinel = jnp.int32(n)
+    init = jnp.where(
+        stone, jnp.arange(n, dtype=jnp.int32).reshape(size, size),
+        sentinel)
+
+    def shifted(x, dx, dy, fill):
+        p = jnp.pad(x, 1, constant_values=fill)
+        return p[1 + dx:1 + dx + size, 1 + dy:1 + dy + size]
+
+    links = [(shifted(board, dx, dy, 0) == board) & stone
+             for dx, dy in ((1, 0), (-1, 0), (0, 1), (0, -1))]
+
+    def sweep(_, lab):
+        for link, (dx, dy) in zip(links, ((1, 0), (-1, 0), (0, 1),
+                                          (0, -1))):
+            nb = shifted(lab, dx, dy, sentinel)
+            lab = jnp.minimum(lab, jnp.where(link, nb, sentinel))
+        return lab
+
+    lab = jax.lax.fori_loop(0, sweeps, sweep, init)
+    out_ref[...] = lab.reshape(1, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("size", "interpret"))
+def pallas_labels(boards: jax.Array, size: int,
+                  interpret: bool = False) -> jax.Array:
+    """Connected-component root (min flat index) per point for a BATCH
+    of boards: int8 ``[B, N]`` → int32 ``[B, N]`` (``N`` = sentinel
+    for empty points). Semantics identical to
+    ``jaxgo.compute_labels`` vmapped over the batch.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter — the
+    CI path on CPU-only hosts (tests/test_ops.py differential-checks
+    it against the XLA implementation).
+    """
+    batch, n = boards.shape
+    if n != size * size:
+        raise ValueError(f"boards have {n} points, size² is {size * size}")
+    kernel = functools.partial(_label_kernel, size=size,
+                               sweeps=_sweeps_for(n))
+    return pl.pallas_call(
+        kernel,
+        grid=(batch,),
+        in_specs=[pl.BlockSpec((1, n), lambda b: (b, 0))],
+        out_specs=pl.BlockSpec((1, n), lambda b: (b, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch, n), jnp.int32),
+        interpret=interpret,
+    )(boards)
