@@ -53,16 +53,27 @@ def _measure() -> None:
 
     from rocalphago_tpu.engine.jaxgo import GoConfig
     from rocalphago_tpu.models import CNNPolicy
-    from rocalphago_tpu.search.selfplay import host_winners, play_games
+    from rocalphago_tpu.search.selfplay import (
+        host_winners,
+        make_selfplay_chunked,
+    )
 
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    # TPU sizing (both measured on the attached v5e chip):
+    # - chunked segments, because the tunnel's worker crashes past
+    #   ~40s of device execution — 60 plies at batch 16 ≈ 13s/segment;
+    # - batch 16, because per-ply cost scales SUPERLINEARLY with batch
+    #   (the vmap'd fixpoint while_loops stall on the slowest board:
+    #   0.22 s/ply at batch 16 vs 1.6 s/ply at batch 64), so games/min
+    #   peaks at small batch on one chip.
     # CPU numbers are a liveness fallback, not the perf story — keep
     # the program small enough that compile + one rep fits the attempt
-    # timeout comfortably
-    batch = 64 if on_tpu else 8
+    # timeout comfortably.
+    batch = 16 if on_tpu else 8
     max_moves = 300 if on_tpu else 40
+    chunk = 60 if on_tpu else 40
 
     cfg = GoConfig(size=19)
     net = CNNPolicy(board=19, layers=12, filters_per_layer=128)
@@ -70,17 +81,14 @@ def _measure() -> None:
     # terminal scoring happens on host: it shaves the whole-board
     # region labeling off the compiled program (smaller graph for the
     # experimental backend to chew), and costs microseconds per game
-    @jax.jit
-    def run(params_a, params_b, rng):
-        res = play_games(cfg, net.feature_list, net.module.apply,
-                         params_a, net.module.apply, params_b, rng,
-                         batch, max_moves, temperature=1.0,
-                         score_on_device=False)
-        return res.final.board, res.num_moves
+    run = make_selfplay_chunked(
+        cfg, net.feature_list, net.module.apply, net.module.apply,
+        batch, max_moves, chunk=chunk, temperature=1.0,
+        score_on_device=False)
 
     def one(r):
-        boards, _ = run(net.params, net.params, jax.random.key(r))
-        return host_winners(cfg, jax.device_get(boards))
+        res = run(net.params, net.params, jax.random.key(r))
+        return host_winners(cfg, jax.device_get(res.final.board))
 
     # compile (excluded from timing); jax.device_get forces a host
     # transfer, which waits for real completion even on backends where
@@ -108,6 +116,7 @@ def _measure() -> None:
         "n_devices": n_dev,
         "batch": batch,
         "max_moves": max_moves,
+        "chunk": chunk,
     }))
 
 

@@ -4,8 +4,8 @@ The engine's hottest primitive is the whole-board flood fill behind
 ``jaxgo.compute_labels`` (group analysis for stepping, legality,
 features, scoring). The XLA formulation is a convergence
 ``while_loop`` of min-propagation sweeps; this kernel is the
-TPU-native alternative: one grid cell per board, the whole fixpoint
-iteration running over a VMEM-resident board with zero HBM round
+TPU-native alternative: 8 boards per grid cell, the whole fixpoint
+iteration running over VMEM-resident boards with zero HBM round
 trips between sweeps.
 
 Design notes:

@@ -16,6 +16,7 @@ from rocalphago_tpu.search.players import (  # noqa: F401
 from rocalphago_tpu.search.selfplay import (  # noqa: F401
     SelfplayResult,
     make_selfplay,
+    make_selfplay_chunked,
     play_games,
     sensible_mask,
 )

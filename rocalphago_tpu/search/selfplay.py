@@ -70,25 +70,17 @@ def _half_swap(x: jax.Array, swap: jax.Array) -> jax.Array:
                     x)
 
 
-def play_games(cfg: GoConfig, features: tuple,
-               apply_a: Callable, params_a,
-               apply_b: Callable, params_b,
-               rng: jax.Array, batch: int, max_moves: int = 500,
-               temperature: float = 1.0,
-               score_on_device: bool = True) -> SelfplayResult:
-    """Play ``batch`` lockstep games of net A vs net B.
-
-    First half of the batch: A is Black; second half: B is Black
-    (callers average both colors for unbiased win-rates, as the
-    reference's RL trainer does). ``apply_*`` map (params, planes
-    [B',s,s,F]) → logits [B', N]. Fully jit-compatible; wrap in
-    ``jax.jit`` with static ``cfg/features/batch/max_moves``.
-    """
+def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
+              apply_b: Callable, batch: int, temperature: float):
+    """Shared scan body for :func:`play_games` and
+    :func:`make_selfplay_chunked`: one ply of lockstep two-net
+    self-play, parameterized over net params so the chunked runner can
+    trace it in a standalone jit. Owns the even-batch invariant: the
+    half-batch color split slices at ``batch // 2``."""
     if batch % 2:
         raise ValueError(
             f"batch must be even (half-and-half color split), got {batch}")
     n = cfg.num_points
-    states = new_states(cfg, batch)
     vgd = jax.vmap(lambda board: group_data(
         cfg, board, with_member=needs_member(features),
         with_zxor=cfg.enforce_superko))
@@ -97,8 +89,7 @@ def play_games(cfg: GoConfig, features: tuple,
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
 
-    def ply(carry, t):
-        states, rng = carry
+    def ply(params_a, params_b, states, rng, t):
         rng, sub = jax.random.split(rng)
         # one flood fill per ply, shared by the encoder and the
         # sensibleness mask
@@ -122,10 +113,26 @@ def play_games(cfg: GoConfig, features: tuple,
 
         live = ~states.done
         new = vstep(states, action)
+        return new, rng, action, live
+
+    return ply
+
+
+def _scan_plies(ply, params_a, params_b, states, rng, ts):
+    """Scan ``ply`` over the ply indices ``ts``; returns
+    ``(states, rng, actions [T,B], live [T,B])``."""
+    def body(carry, t):
+        states, rng = carry
+        new, rng, action, live = ply(params_a, params_b, states, rng, t)
         return (new, rng), (action, live)
 
-    (final, _), (actions, live) = lax.scan(
-        ply, (states, rng), jnp.arange(max_moves))
+    (states, rng), (actions, live) = lax.scan(body, (states, rng), ts)
+    return states, rng, actions, live
+
+
+def _finish(cfg: GoConfig, final, actions, live,
+            score_on_device: bool, batch: int) -> SelfplayResult:
+    """Shared result assembly for both runners."""
     if score_on_device:
         winners = jax.vmap(functools.partial(winner, cfg))(final)
     else:
@@ -137,6 +144,27 @@ def play_games(cfg: GoConfig, features: tuple,
                           live.sum(axis=0, dtype=jnp.int32))
 
 
+def play_games(cfg: GoConfig, features: tuple,
+               apply_a: Callable, params_a,
+               apply_b: Callable, params_b,
+               rng: jax.Array, batch: int, max_moves: int = 500,
+               temperature: float = 1.0,
+               score_on_device: bool = True) -> SelfplayResult:
+    """Play ``batch`` lockstep games of net A vs net B.
+
+    First half of the batch: A is Black; second half: B is Black
+    (callers average both colors for unbiased win-rates, as the
+    reference's RL trainer does). ``apply_*`` map (params, planes
+    [B',s,s,F]) → logits [B', N]. Fully jit-compatible; wrap in
+    ``jax.jit`` with static ``cfg/features/batch/max_moves``.
+    """
+    states = new_states(cfg, batch)
+    ply = _make_ply(cfg, features, apply_a, apply_b, batch, temperature)
+    final, _, actions, live = _scan_plies(
+        ply, params_a, params_b, states, rng, jnp.arange(max_moves))
+    return _finish(cfg, final, actions, live, score_on_device, batch)
+
+
 def make_selfplay(cfg: GoConfig, features: tuple, apply_a: Callable,
                   apply_b: Callable, batch: int, max_moves: int = 500,
                   temperature: float = 1.0):
@@ -146,6 +174,63 @@ def make_selfplay(cfg: GoConfig, features: tuple, apply_a: Callable,
     def run(params_a, params_b, rng):
         return play_games(cfg, features, apply_a, params_a, apply_b,
                           params_b, rng, batch, max_moves, temperature)
+
+    return run
+
+
+def make_selfplay_chunked(cfg: GoConfig, features: tuple,
+                          apply_a: Callable, apply_b: Callable,
+                          batch: int, max_moves: int = 500,
+                          chunk: int = 100, temperature: float = 1.0,
+                          score_on_device: bool = True):
+    """Chunked variant of :func:`make_selfplay` for backends that kill
+    long-running programs.
+
+    The attached single-chip TPU tunnel's worker crashes on device
+    programs past roughly 40s of execution (measured: a 19×19
+    batch-16 self-play scan survives 120 plies ≈ 31s and dies at 200);
+    a monolithic ``max_moves``-ply scan therefore can't run there.
+    This runner jits ONE ``chunk``-ply scan segment and drives it from
+    a host loop, carrying the batched :class:`GoState` **device-
+    resident** between calls — per-segment runtime stays under the
+    watchdog, host↔device traffic is one tiny dispatch per segment,
+    and a single compile serves any ``max_moves`` (the segment program
+    takes the ply offset as a traced scalar, so odd/even color phases
+    share the compile too).
+
+    Returns ``(params_a, params_b, rng) -> SelfplayResult`` with
+    bit-identical move selection to :func:`play_games` given the same
+    rng (the per-ply ``random.split`` chain is preserved across the
+    segment boundary by threading the rng through the carry).
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    ply = _make_ply(cfg, features, apply_a, apply_b, batch, temperature)
+
+    @functools.partial(jax.jit, static_argnames=("length",))
+    def segment(params_a, params_b, states, rng, offset, length):
+        return _scan_plies(ply, params_a, params_b, states, rng,
+                           offset + jnp.arange(length))
+
+    finish = jax.jit(functools.partial(
+        _finish, cfg, score_on_device=score_on_device, batch=batch))
+
+    def run(params_a, params_b, rng) -> SelfplayResult:
+        states = new_states(cfg, batch)
+        acts = [jnp.zeros((0, batch), jnp.int32)]   # max_moves=0 parity
+        lives = [jnp.zeros((0, batch), bool)]
+        for offset in range(0, max_moves, chunk):
+            # exact remainder segment (one extra compile at most) so
+            # no ply beyond max_moves ever runs — results stay
+            # bit-identical to the monolithic scan
+            length = min(chunk, max_moves - offset)
+            states, rng, actions, live = segment(
+                params_a, params_b, states, rng, jnp.int32(offset),
+                length)
+            acts.append(actions)
+            lives.append(live)
+        return finish(states, jnp.concatenate(acts),
+                      jnp.concatenate(lives))
 
     return run
 

@@ -18,7 +18,10 @@ from rocalphago_tpu.search.players import (
     ProbabilisticPolicyPlayer,
     ValuePlayer,
 )
-from rocalphago_tpu.search.selfplay import make_selfplay
+from rocalphago_tpu.search.selfplay import (
+    make_selfplay,
+    make_selfplay_chunked,
+)
 
 SIZE = 5
 FEATURES = ("board", "ones")
@@ -83,6 +86,29 @@ def test_selfplay_deterministic_given_key(policy):
     b = run(policy.params, policy.params, jax.random.key(7))
     np.testing.assert_array_equal(np.asarray(a.actions),
                                   np.asarray(b.actions))
+
+
+def test_chunked_selfplay_bit_identical(policy):
+    """The chunked runner (TPU watchdog workaround) must reproduce the
+    monolithic scan exactly — including a non-divisible remainder
+    segment (25 plies in chunks of 10 → segments of 10/10/5)."""
+    cfg = GoConfig(size=SIZE)
+    mono = make_selfplay(cfg, FEATURES, policy.module.apply,
+                         policy.module.apply, batch=4, max_moves=25)
+    chunked = make_selfplay_chunked(cfg, FEATURES, policy.module.apply,
+                                    policy.module.apply, batch=4,
+                                    max_moves=25, chunk=10)
+    a = mono(policy.params, policy.params, jax.random.key(3))
+    b = chunked(policy.params, policy.params, jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(a.actions),
+                                  np.asarray(b.actions))
+    np.testing.assert_array_equal(np.asarray(a.live), np.asarray(b.live))
+    np.testing.assert_array_equal(np.asarray(a.winners),
+                                  np.asarray(b.winners))
+    np.testing.assert_array_equal(np.asarray(a.final.board),
+                                  np.asarray(b.final.board))
+    np.testing.assert_array_equal(np.asarray(a.num_moves),
+                                  np.asarray(b.num_moves))
 
 
 def test_greedy_player_moves_are_sensible(policy):
