@@ -316,6 +316,23 @@ def neighbor_analysis(cfg: GoConfig, board: jax.Array, labels: jax.Array):
             jax.vmap(_dedup_mask)(lab_pad[nbrs]), nbrs < n)
 
 
+def lib_counts_from_labels(cfg: GoConfig, board: jax.Array,
+                           labels: jax.Array) -> jax.Array:
+    """Loop-free liberty recount given ``labels``: int32 ``[N+1]``
+    distinct-empty-point counts per group root (sentinel row ``N`` is
+    0). Each empty point contributes one liberty to each *distinct*
+    adjacent group via the deduped ``[N,4]`` scatter-add. Shared by
+    :func:`group_data` and the ladder reader's carried incremental
+    labeling (``features/ladders.py``)."""
+    n = cfg.num_points
+    empty = board == 0
+    _, nbr_root, uniq, _ = neighbor_analysis(cfg, board, labels)
+    contrib = empty[:, None] & uniq & (nbr_root < n)
+    lib_counts = jnp.zeros((n + 1,), jnp.int32).at[
+        jnp.where(contrib, nbr_root, n)].add(contrib.astype(jnp.int32))
+    return lib_counts.at[n].set(0)
+
+
 def group_data(cfg: GoConfig, board: jax.Array, *,
                with_member: bool = False,
                with_zxor: bool = False) -> GroupData:
@@ -328,19 +345,13 @@ def group_data(cfg: GoConfig, board: jax.Array, *,
     ``with_zxor`` (superko legality) explicitly.
     """
     n = cfg.num_points
-    nbrs = neighbors_for(cfg.size)
     labels = compute_labels(cfg, board)
     empty = board == 0
 
     sizes = jnp.zeros((n + 1,), jnp.int32).at[labels].add(
         (~empty).astype(jnp.int32))
 
-    # each empty point adds 1 liberty to each *distinct* adjacent group
-    _, nbr_root, uniq, _ = neighbor_analysis(cfg, board, labels)
-    contrib = empty[:, None] & uniq & (nbr_root < n)
-    lib_counts = jnp.zeros((n + 1,), jnp.int32).at[
-        jnp.where(contrib, nbr_root, n)].add(contrib.astype(jnp.int32))
-    lib_counts = lib_counts.at[n].set(0)
+    lib_counts = lib_counts_from_labels(cfg, board, labels)
 
     member = None
     zxor = None

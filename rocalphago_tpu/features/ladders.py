@@ -32,12 +32,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from rocalphago_tpu.engine.jaxgo import (
-    neighbor_analysis,
     GoConfig,
     GoState,
     GroupData,
     _dedup_mask,
-    group_data,
+    lib_counts_from_labels,
+    neighbor_analysis,
     neighbors_for,
 )
 
@@ -73,10 +73,41 @@ def _place(cfg: GoConfig, board, gd: GroupData, action, color):
     return jnp.where(ok, new_board, board), ok, captured & ok
 
 
-def _prey_libs(cfg: GoConfig, board, prey_pt):
-    gd = group_data(cfg, board)
-    libs = gd.lib_counts[gd.labels[prey_pt]]
-    return jnp.where(board[prey_pt] == 0, 0, libs), gd
+_labels_lib_counts = lib_counts_from_labels
+
+
+def _relabel_place(cfg: GoConfig, board, labels, pt, color, cap_mask,
+                   enabled):
+    """Incremental group labels after placing ``color`` at ``pt``
+    (legality pre-checked by the caller) and removing the captured
+    stones ``cap_mask``.
+
+    Exact with ZERO flood fills: ladder reading only ever *adds* one
+    stone at a time and removes whole captured groups, and neither
+    operation can split a group — so the min-flat-index labeling of
+    :func:`jaxgo.compute_labels` is maintained by pure mask algebra:
+    the new stone unions its same-color neighbor groups under
+    ``min(pt, their roots)`` (the min of a union of min-rooted groups),
+    and captured points revert to the empty sentinel ``N``.
+
+    ``enabled=False`` returns the inputs unchanged (vital under vmap:
+    disabled lanes must not corrupt their carried analysis).
+    """
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
+    my = nbrs[pt]
+    same = (my < n) & (board_pad[my] == color)
+    roots = jnp.where(same, lab_pad[my], n)
+    new_root = jnp.minimum(roots.min(), pt).astype(jnp.int32)
+    merged = (labels[:, None] == jnp.where(
+        same, roots, -2)[None, :]).any(axis=1)
+    labels1 = jnp.where(merged, new_root, labels).at[pt].set(new_root)
+    labels1 = jnp.where(cap_mask, n, labels1)
+    board1 = jnp.where(cap_mask, jnp.int8(0), board).at[pt].set(color)
+    return (jnp.where(enabled, board1, board),
+            jnp.where(enabled, labels1, labels))
 
 
 def _dilate2d(size: int, m):
@@ -91,10 +122,11 @@ def _local_prey_libs(cfg: GoConfig, board, prey_pt):
     """Liberty count of the group at ``prey_pt`` — EXACT, via a local
     connected-component fill (dilate-within-color to fixpoint) instead
     of the whole-board labeling; converges in group-diameter steps
-    (4 unrolled per trip). Used where a single post-move group must be
-    measured outside the algebraic rung path (the ladder_escape
-    opening move, whose extension may merge groups), and by tests as
-    an independent check of ``_escaper_response_fast``'s algebra."""
+    (4 unrolled per trip). No production call sites remain (the
+    ladder_escape opening now uses the incremental relabel +
+    loop-free recount); kept as the independent fill-based oracle
+    that ``tests/test_features.py`` checks the
+    ``_escaper_response_fast`` algebra against."""
     size = cfg.size
     color = board[prey_pt]
     own = (board == color).reshape(size, size)
@@ -114,7 +146,7 @@ def _local_prey_libs(cfg: GoConfig, board, prey_pt):
     return jnp.where(color == 0, 0, libs.sum().astype(jnp.int32))
 
 
-def _escaper_response_fast(cfg: GoConfig, b1, prey_pt, prey_color,
+def _escaper_response_full(cfg: GoConfig, b1, prey_pt, prey_color,
                            prey_mask, gd0, c_pt, cap0):
     """Best forced response of a prey left in atari by the chaser's
     move at ``c_pt``: extend at the last liberty, or counter-capture an
@@ -139,9 +171,14 @@ def _escaper_response_fast(cfg: GoConfig, b1, prey_pt, prey_color,
     * prey-colored groups surviving on ``b1`` are unchanged, so merges
       from an extension are unions of ``gd0`` label masks.
 
-    Returns ``(preyL1, libs_after_best, board_after_best)`` where
-    ``preyL1`` is the prey's liberty count on ``b1`` (callers gate on
-    it); libs_after_best is -1 when no legal response exists.
+    Returns ``(preyL1, libs_after_best, board_after_best, resp_pt,
+    resp_cap, resp_made)`` where ``preyL1`` is the prey's liberty
+    count on ``b1`` (callers gate on it); libs_after_best is -1 when
+    no legal response exists (then ``resp_made`` is False and the
+    board is returned unchanged). ``resp_pt``/``resp_cap`` are the
+    chosen response move and the chaser stones it captured — exactly
+    the inputs :func:`_relabel_place` needs to carry the incremental
+    labeling past the response.
     """
     n = cfg.num_points
     size = cfg.size
@@ -219,19 +256,43 @@ def _escaper_response_fast(cfg: GoConfig, b1, prey_pt, prey_color,
         legal = (empty2 & dil(cluster)).any()
         okm = enabled & empty1[pt] & legal
         b2 = jnp.where(esc_cap, jnp.int8(0), b1).at[pt].set(prey_color)
-        return jnp.where(okm, L2, -1), jnp.where(okm, b2, b1)
+        return (jnp.where(okm, L2, -1), jnp.where(okm, b2, b1),
+                esc_cap & okm)
 
-    L1, B1 = try_move(ext_pt, preyL1 >= 1)
-    L2, B2 = try_move(cap_pt, have_cap)
+    L1, B1, C1 = try_move(ext_pt, preyL1 >= 1)
+    L2, B2, C2 = try_move(cap_pt, have_cap)
     take1 = L1 >= L2
-    return preyL1, jnp.where(take1, L1, L2), jnp.where(take1, B1, B2)
+    respL = jnp.where(take1, L1, L2)
+    return (preyL1, respL, jnp.where(take1, B1, B2),
+            jnp.where(take1, ext_pt, cap_pt),
+            jnp.where(take1[None], C1, C2), respL >= 0)
 
 
-def _chase(cfg: GoConfig, board0, prey_pt, depth: int,
+def _escaper_response_fast(cfg: GoConfig, b1, prey_pt, prey_color,
+                           prey_mask, gd0, c_pt, cap0):
+    """3-tuple view of :func:`_escaper_response_full` —
+    ``(preyL1, libs_after_best, board_after_best)``."""
+    preyL1, respL, b2, _, _, _ = _escaper_response_full(
+        cfg, b1, prey_pt, prey_color, prey_mask, gd0, c_pt, cap0)
+    return preyL1, respL, b2
+
+
+def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
            enabled=True) -> jax.Array:
     """Chaser to move against a two-liberty prey; True if prey is
     ladder-captured. Each iteration = one full rung (chaser move +
     forced escaper response).
+
+    ZERO flood fills anywhere in the loop: the caller seeds the
+    group labeling (``labels0``, from the plane-level analysis plus
+    :func:`_relabel_place` for the opening moves) and each rung
+    carries it forward with the same incremental relabeling — sound
+    because a chase only adds single stones and removes whole captured
+    groups, neither of which can split a group. Liberty counts are
+    recomputed loop-free from the labels (:func:`_labels_lib_counts`).
+    Previous designs refilled the whole board once (originally seven
+    times) per rung; under vmap every lane/game stalls on the slowest
+    lane's fill, which made ladders ~99% of the 48-plane encode.
 
     ``enabled=False`` starts the loop already done — vital under
     ``vmap`` over candidate lanes, where the while_loop runs until
@@ -244,64 +305,101 @@ def _chase(cfg: GoConfig, board0, prey_pt, depth: int,
 
     class Carry(NamedTuple):
         board: jax.Array
+        labels: jax.Array
         done: jax.Array
         captured: jax.Array
         rung: jax.Array
 
     def option_outcome(board, gd, prey_mask, lib_pt, enabled):
-        """Chaser fills ``lib_pt``; returns (outcome, board after the
-        escaper's forced response). One flood fill per RUNG (the
-        caller's ``gd``) — the post-move analysis is pure mask algebra
-        (see ``_escaper_response_fast``)."""
+        """Chaser fills ``lib_pt``; returns (outcome, relabeling
+        inputs for both plies). Pure mask algebra — no fills."""
         b1, ok, cap0 = _place(cfg, board, gd, lib_pt, -prey_color)
-        preyL, respL, b2 = _escaper_response_fast(
-            cfg, b1, prey_pt, prey_color, prey_mask, gd, lib_pt, cap0)
+        preyL, respL, _, resp_pt, resp_cap, resp_made = \
+            _escaper_response_full(cfg, b1, prey_pt, prey_color,
+                                   prey_mask, gd, lib_pt, cap0)
         resp_logic = jnp.where(
             respL <= 1, _CAPTURED,
             jnp.where(respL >= 3, _ESCAPED, _CONTINUE))
         # an option only matters if it's a legal move that keeps atari
         outcome = jnp.where(enabled & ok & (preyL == 1),
                             resp_logic, _ESCAPED)
-        return outcome, b2
+        return outcome, (lib_pt, cap0, resp_pt, resp_cap, resp_made)
 
     def body(c: Carry) -> Carry:
-        board = c.board
-        L, gd = _prey_libs(cfg, board, prey_pt)
+        board, labels = c.board, c.labels
+        lib_counts = _labels_lib_counts(cfg, board, labels)
+        gd = GroupData(labels, None, lib_counts, None, None)
         lab_pad = jnp.concatenate(
-            [gd.labels, jnp.full((1,), n, jnp.int32)])
-        root = gd.labels[prey_pt]
-        prey_mask = gd.labels == root
+            [labels, jnp.full((1,), n, jnp.int32)])
+        root = labels[prey_pt]
+        prey_alive = board[prey_pt] == prey_color
+        L = jnp.where(prey_alive, lib_counts[root], 0)
+        prey_mask = labels == root
         empty = board == 0
         lib_pts = empty & (lab_pad[nbrs] == root).any(axis=1)
         l1 = jnp.argmax(lib_pts).astype(jnp.int32)
         l2 = jnp.argmax(lib_pts & (jnp.arange(n) != l1)).astype(jnp.int32)
 
-        o1, b1 = option_outcome(board, gd, prey_mask, l1, L == 2)
-        o2, b2 = option_outcome(board, gd, prey_mask, l2, L == 2)
+        o1, u1 = option_outcome(board, gd, prey_mask, l1, L == 2)
+        o2, u2 = option_outcome(board, gd, prey_mask, l2, L == 2)
         pick1 = o1 <= o2
         o = jnp.where(pick1, o1, o2)
-        nb = jnp.where(pick1, b1, b2)
+        c_pt, cap0, resp_pt, resp_cap, resp_made = jax.tree.map(
+            lambda a, b: jnp.where(pick1, a, b), u1, u2)
 
         # prey already captured / in atari / safe before we move
         pre = jnp.where(
-            board[prey_pt] == 0, _CAPTURED,
+            ~prey_alive, _CAPTURED,
             jnp.where(L >= 3, _ESCAPED,
                       jnp.where(L == 1, _CAPTURED, -1)))
         o = jnp.where(pre >= 0, pre, o)
         advance = (pre < 0) & (o == _CONTINUE)
 
+        board1, labels1 = _relabel_place(
+            cfg, board, labels, c_pt, -prey_color, cap0, advance)
+        board2, labels2 = _relabel_place(
+            cfg, board1, labels1, resp_pt, prey_color, resp_cap,
+            advance & resp_made)
+
         out_of_depth = c.rung + 1 >= depth
         return Carry(
-            board=jnp.where(advance, nb, board),
+            board=board2,
+            labels=labels2,
             done=c.done | (o != _CONTINUE) | out_of_depth,
             captured=jnp.where(c.done, c.captured, o == _CAPTURED),
             rung=c.rung + 1,
         )
 
-    init = Carry(board0, ~jnp.asarray(enabled, jnp.bool_),
+    init = Carry(board0, labels0, ~jnp.asarray(enabled, jnp.bool_),
                  jnp.bool_(False), jnp.int32(0))
     final = lax.while_loop(lambda c: ~c.done, body, init)
     return final.captured & jnp.asarray(enabled, jnp.bool_)
+
+
+def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
+                     need_chase, depth: int, slots: int):
+    """Run :func:`_chase` for the lanes flagged ``need_chase``, first
+    compacted into ``slots`` slots (bool [K] → results bool [K]).
+
+    After the opening filter, typically 0–2 of the K candidate lanes
+    actually need a chase; compacting them means the expensive rung
+    loop runs ``slots`` wide instead of ``K`` wide (the loop's
+    per-trip cost is proportional to its width, and under the
+    encoder's vmap every board pays every trip). Overflow beyond
+    ``slots`` truncates — the same bounded-capacity contract as
+    ``_candidate_lanes``; callers must map uncovered lanes to the
+    conservative plane value. Returns ``(captured [K], covered [K])``
+    where ``covered`` marks lanes whose chase actually ran."""
+    k = need_chase.shape[0]
+    (slot_idx,) = jnp.nonzero(need_chase, size=slots, fill_value=k)
+    valid = slot_idx < k
+    safe = jnp.where(valid, slot_idx, 0)
+    captured = jax.vmap(
+        lambda b, l, p, v: _chase(cfg, b, l, p, depth, enabled=v))(
+            boards[safe], labels[safe], prey_pts[safe], valid)
+    scatter = jnp.zeros((k,), jnp.bool_)
+    return (scatter.at[slot_idx].set(captured & valid, mode="drop"),
+            scatter.at[slot_idx].set(valid, mode="drop"))
 
 
 def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
@@ -327,8 +425,8 @@ def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
 
 
 def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
-                         legal, depth: int = 40,
-                         lanes: int = 16) -> jax.Array:
+                         legal, depth: int = 40, lanes: int = 16,
+                         chase_slots: int = 8) -> jax.Array:
     """bool [N]: legal moves that ladder-capture an adjacent two-liberty
     opponent group."""
     n = cfg.num_points
@@ -336,28 +434,36 @@ def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
     move_pt, prey_pt, valid = _candidate_lanes(
         cfg, state, gd, legal, prey_libs=2, prey_is_opp=True, lanes=lanes)
 
-    def lane(mv, pr, ok):
+    def opening(mv, pr, ok):
         board1, placed, cap0 = _place(cfg, state.board, gd, mv, me)
         # prey is now in atari; its forced response decides the
         # opening — derived from the plane-level gd, no refill
         prey_mask = gd.labels == gd.labels[pr]
-        _, respL, board2 = _escaper_response_fast(
-            cfg, board1, pr, -me, prey_mask, gd, mv, cap0)
+        _, respL, _, resp_pt, resp_cap, resp_made = \
+            _escaper_response_full(
+                cfg, board1, pr, -me, prey_mask, gd, mv, cap0)
         need_chase = ok & placed & (respL == 2)
-        captured = jnp.where(
-            respL <= 1, True,
-            jnp.where(respL >= 3, False,
-                      _chase(cfg, board2, pr, depth,
-                             enabled=need_chase)))
-        return jnp.where(ok & placed, captured, False)
+        # carry the incremental labeling through both opening plies so
+        # the chase starts with a valid analysis and never refills
+        b1r, lab1 = _relabel_place(
+            cfg, state.board, gd.labels, mv, me, cap0, ok & placed)
+        b2r, lab2 = _relabel_place(
+            cfg, b1r, lab1, resp_pt, -me, resp_cap,
+            need_chase & resp_made)
+        direct = ok & placed & (respL <= 1)   # captured with no chase
+        return b2r, lab2, need_chase, direct
 
-    captured = jax.vmap(lane)(move_pt, prey_pt, valid)
+    b2r, lab2, need_chase, direct = jax.vmap(opening)(
+        move_pt, prey_pt, valid)
+    chased, _ = _compacted_chase(cfg, b2r, lab2, prey_pt, need_chase,
+                                 depth, chase_slots)
+    captured = direct | (need_chase & chased)
     return jnp.zeros((n,), jnp.bool_).at[move_pt].max(captured & valid)
 
 
 def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
-                        legal, depth: int = 40,
-                        lanes: int = 16) -> jax.Array:
+                        legal, depth: int = 40, lanes: int = 16,
+                        chase_slots: int = 8) -> jax.Array:
     """bool [N]: legal moves that rescue an own group in atari from a
     ladder (extension at its last liberty that survives the read)."""
     n = cfg.num_points
@@ -365,17 +471,24 @@ def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
     move_pt, prey_pt, valid = _candidate_lanes(
         cfg, state, gd, legal, prey_libs=1, prey_is_opp=False, lanes=lanes)
 
-    def lane(mv, pr, ok):
-        board1, placed, _ = _place(cfg, state.board, gd, mv, me)
-        # own extension may merge groups — local fill stays exact
-        L = _local_prey_libs(cfg, board1, pr)
+    def opening(mv, pr, ok):
+        board1, placed, cap0 = _place(cfg, state.board, gd, mv, me)
+        # own extension may merge groups — the incremental relabel
+        # handles the merge exactly, and the loop-free liberty recount
+        # replaces the old per-lane local fill
+        b1r, lab1 = _relabel_place(
+            cfg, state.board, gd.labels, mv, me, cap0, ok & placed)
+        libs1 = _labels_lib_counts(cfg, b1r, lab1)
+        L = jnp.where(b1r[pr] == me, libs1[lab1[pr]], 0)
         need_chase = ok & placed & (L == 2)
-        captured = jnp.where(
-            L <= 1, True,
-            jnp.where(L >= 3, False,
-                      _chase(cfg, board1, pr, depth,
-                             enabled=need_chase)))
-        return jnp.where(ok & placed, ~captured, False)
+        direct = ok & placed & (L >= 3)       # escaped with no chase
+        return b1r, lab1, need_chase, direct
 
-    escaped = jax.vmap(lane)(move_pt, prey_pt, valid)
+    b1r, lab1, need_chase, direct = jax.vmap(opening)(
+        move_pt, prey_pt, valid)
+    chased, covered = _compacted_chase(cfg, b1r, lab1, prey_pt,
+                                       need_chase, depth, chase_slots)
+    # overflow lanes (chase needed but no slot) must stay conservative
+    # False — an unread escape is not asserted
+    escaped = direct | (need_chase & covered & ~chased)
     return jnp.zeros((n,), jnp.bool_).at[move_pt].max(escaped & valid)
