@@ -73,7 +73,7 @@ def _place(cfg: GoConfig, board, gd: GroupData, action, color):
     return jnp.where(ok, new_board, board), ok, captured & ok
 
 
-_labels_lib_counts = lib_counts_from_labels
+
 
 
 def _relabel_place(cfg: GoConfig, board, labels, pt, color, cap_mask,
@@ -289,7 +289,7 @@ def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
     carries it forward with the same incremental relabeling — sound
     because a chase only adds single stones and removes whole captured
     groups, neither of which can split a group. Liberty counts are
-    recomputed loop-free from the labels (:func:`_labels_lib_counts`).
+    recomputed loop-free from the labels (:func:`jaxgo.lib_counts_from_labels`).
     Previous designs refilled the whole board once (originally seven
     times) per rung; under vmap every lane/game stalls on the slowest
     lane's fill, which made ladders ~99% of the 48-plane encode.
@@ -327,7 +327,7 @@ def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
 
     def body(c: Carry) -> Carry:
         board, labels = c.board, c.labels
-        lib_counts = _labels_lib_counts(cfg, board, labels)
+        lib_counts = lib_counts_from_labels(cfg, board, labels)
         gd = GroupData(labels, None, lib_counts, None, None)
         lab_pad = jnp.concatenate(
             [labels, jnp.full((1,), n, jnp.int32)])
@@ -478,7 +478,7 @@ def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
         # replaces the old per-lane local fill
         b1r, lab1 = _relabel_place(
             cfg, state.board, gd.labels, mv, me, cap0, ok & placed)
-        libs1 = _labels_lib_counts(cfg, b1r, lab1)
+        libs1 = lib_counts_from_labels(cfg, b1r, lab1)
         L = jnp.where(b1r[pr] == me, libs1[lab1[pr]], 0)
         need_chase = ok & placed & (L == 2)
         direct = ok & placed & (L >= 3)       # escaped with no chase

@@ -85,6 +85,13 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-sgf", action="store_true",
                     help="summary only (skip SGF files)")
+    ap.add_argument("--chunk", type=int, default=0,
+                    help="plies per compiled segment (0 = one "
+                         "monolithic scan; use e.g. 60 on backends "
+                         "that kill long device programs)")
+    ap.add_argument("--shard", action="store_true",
+                    help="shard the game batch over all devices "
+                         "(env parallelism across the mesh data axis)")
     a = ap.parse_args(argv)
     if a.games % 2:
         raise SystemExit("--games must be even (color split)")
@@ -92,9 +99,21 @@ def main(argv=None):
     net = NeuralNetBase.load_model(a.policy)
     opp = NeuralNetBase.load_model(a.opponent) if a.opponent else net
     cfg = net.cfg
-    run = make_selfplay(cfg, net.feature_list, net.module.apply,
-                        opp.module.apply, batch=a.games,
-                        max_moves=a.max_moves, temperature=a.temperature)
+    if a.shard or a.chunk:
+        from rocalphago_tpu.parallel.mesh import make_mesh
+        from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+        run = make_selfplay_chunked(
+            cfg, net.feature_list, net.module.apply, opp.module.apply,
+            batch=a.games, max_moves=a.max_moves,
+            chunk=a.chunk or max(a.max_moves, 1),
+            temperature=a.temperature,
+            mesh=make_mesh() if a.shard else None)
+    else:
+        run = make_selfplay(cfg, net.feature_list, net.module.apply,
+                            opp.module.apply, batch=a.games,
+                            max_moves=a.max_moves,
+                            temperature=a.temperature)
     result = run(net.params, opp.params, jax.random.key(a.seed))
     jax.device_get(result.winners)
 

@@ -182,7 +182,8 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
                           apply_a: Callable, apply_b: Callable,
                           batch: int, max_moves: int = 500,
                           chunk: int = 100, temperature: float = 1.0,
-                          score_on_device: bool = True):
+                          score_on_device: bool = True,
+                          mesh=None):
     """Chunked variant of :func:`make_selfplay` for backends that kill
     long-running programs.
 
@@ -202,9 +203,29 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
     bit-identical move selection to :func:`play_games` given the same
     rng (the per-ply ``random.split`` chain is preserved across the
     segment boundary by threading the rng through the carry).
+
+    Pass ``mesh`` (a ``parallel.mesh.make_mesh`` mesh) to shard the
+    game batch over the mesh's ``data`` axis — environment parallelism
+    ACROSS devices, the multi-chip extension of the reference's
+    lockstep ``get_moves`` batching (SURVEY.md §2b): initial states
+    are placed batch-split, params replicated, and XLA propagates the
+    shardings through the whole scan segment (the odd-ply color-swap
+    ``roll`` becomes an ICI collective permute). Results are
+    bit-identical to the unsharded runner; ``batch`` must be a
+    multiple of 2× the data-axis width (even per-device shares keep
+    the color-split halves aligned to devices).
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    meshlib = None
+    if mesh is not None:
+        from rocalphago_tpu.parallel import mesh as meshlib
+
+        data_width = mesh.shape[meshlib.DATA_AXIS]
+        if batch % (2 * data_width):
+            raise ValueError(
+                f"batch {batch} must be a multiple of 2x the data-axis "
+                f"width ({data_width})")
     ply = _make_ply(cfg, features, apply_a, apply_b, batch, temperature)
 
     @functools.partial(jax.jit, static_argnames=("length",))
@@ -217,6 +238,10 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
 
     def run(params_a, params_b, rng) -> SelfplayResult:
         states = new_states(cfg, batch)
+        if mesh is not None:
+            states = meshlib.shard_batch(mesh, states)
+            params_a = meshlib.replicate(mesh, params_a)
+            params_b = meshlib.replicate(mesh, params_b)
         acts = [jnp.zeros((0, batch), jnp.int32)]   # max_moves=0 parity
         lives = [jnp.zeros((0, batch), bool)]
         for offset in range(0, max_moves, chunk):

@@ -111,6 +111,35 @@ def test_chunked_selfplay_bit_identical(policy):
                                   np.asarray(b.num_moves))
 
 
+def test_sharded_selfplay_bit_identical_and_distributed(policy):
+    """Game-batch sharding over the mesh's data axis (env parallelism
+    across devices, SURVEY.md §2b) must not change a single move, and
+    must actually distribute the state across the 8 virtual devices
+    the conftest provides."""
+    from rocalphago_tpu.parallel.mesh import make_mesh
+
+    cfg = GoConfig(size=SIZE)
+    mesh = make_mesh()       # all 8 virtual CPU devices
+    plain = make_selfplay_chunked(cfg, FEATURES, policy.module.apply,
+                                  policy.module.apply, batch=16,
+                                  max_moves=20, chunk=8)
+    sharded = make_selfplay_chunked(cfg, FEATURES, policy.module.apply,
+                                    policy.module.apply, batch=16,
+                                    max_moves=20, chunk=8, mesh=mesh)
+    a = plain(policy.params, policy.params, jax.random.key(11))
+    b = sharded(policy.params, policy.params, jax.random.key(11))
+    np.testing.assert_array_equal(np.asarray(a.actions),
+                                  np.asarray(b.actions))
+    np.testing.assert_array_equal(np.asarray(a.winners),
+                                  np.asarray(b.winners))
+    assert len(b.final.board.sharding.device_set) == 8
+
+    with pytest.raises(ValueError, match="data-axis"):
+        make_selfplay_chunked(cfg, FEATURES, policy.module.apply,
+                              policy.module.apply, batch=6,
+                              max_moves=20, mesh=mesh)
+
+
 def test_greedy_player_moves_are_sensible(policy):
     st = pygo.GameState(size=SIZE)
     player = GreedyPolicyPlayer(policy)
