@@ -1,0 +1,85 @@
+"""Ladder-chase throughput: XLA lockstep vmap vs the Pallas per-lane
+kernel (``ops/chase.py``).
+
+The chase loop is the 48-plane encoder's dominant cost; the XLA
+formulation pays max-over-batch trips in lockstep while the kernel
+gives each lane its own loop. Lanes are harvested from random games
+(every 2-liberty group is a valid chase entry, chaser to move).
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks._harness import report, std_parser, timed  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rocalphago_tpu.engine import pygo
+    from rocalphago_tpu.engine.jaxgo import GoConfig, compute_labels, \
+        lib_counts_from_labels
+    from rocalphago_tpu.features.ladders import _chase
+    from rocalphago_tpu.ops.chase import pallas_chase
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--depth", type=int, default=40)
+    args = ap.parse_args()
+    size = args.board
+    n = size * size
+    lanes = args.batch or 128
+    cfg = GoConfig(size=size)
+
+    rng = np.random.default_rng(0)
+    boards, labels, preys = [], [], []
+    while len(preys) < lanes:
+        st = pygo.GameState(size=size, komi=7.5)
+        for _ in range(int(rng.integers(20, 120))):
+            legal = st.get_legal_moves(include_eyes=False)
+            if not legal or st.is_end_of_game:
+                break
+            st.do_move(legal[rng.integers(len(legal))])
+        flat = np.asarray(st.board, np.int8).reshape(-1)
+        lab = np.asarray(compute_labels(cfg, jnp.asarray(flat)))
+        libs = np.asarray(lib_counts_from_labels(
+            cfg, jnp.asarray(flat), jnp.asarray(lab)))
+        for root in np.unique(lab[flat != 0]):
+            if libs[root] == 2 and len(preys) < lanes:
+                boards.append(flat)
+                labels.append(lab)
+                preys.append(int(root))
+    boards = jnp.asarray(np.stack(boards))
+    labels_a = jnp.asarray(np.stack(labels))
+    preys = np.asarray(preys, np.int32)
+    prey_oh = jnp.asarray(np.arange(n)[None, :] == preys[:, None])
+    preys = jnp.asarray(preys)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    xla = jax.jit(jax.vmap(functools.partial(
+        _chase, cfg, depth=args.depth, enabled=True)))
+    dt = timed(lambda: jax.device_get(xla(boards, labels_a, preys)),
+               reps=args.reps, profile_dir=args.profile)
+    report("chase_xla", round(lanes / dt, 1), "lanes/s",
+           batch=lanes, board=size, depth=args.depth)
+
+    try:
+        pal = lambda: jax.device_get(pallas_chase(  # noqa: E731
+            boards, labels_a, prey_oh, size, args.depth,
+            interpret=not on_tpu))
+        dt = timed(pal, reps=args.reps)
+        report("chase_pallas", round(lanes / dt, 1), "lanes/s",
+               batch=lanes, board=size, depth=args.depth,
+               interpret=not on_tpu)
+    except Exception as e:  # noqa: BLE001 — keep the XLA number
+        print(f"chase_pallas failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -376,9 +376,23 @@ def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
     return final.captured & jnp.asarray(enabled, jnp.bool_)
 
 
+def _chase_impl() -> str:
+    """Which chase implementation to trace: ``"xla"`` (default — the
+    batch-lockstep while_loop), ``"pallas"`` (the per-lane TPU kernel
+    ``ops.chase``), or ``"interpret"`` (the kernel in the Pallas
+    interpreter — CPU CI). Read from ``$ROCALPHAGO_PALLAS_CHASE`` at
+    trace time; the kernel is opt-in until real-chip measurements
+    favor it (same policy as ``ops.labels``)."""
+    import os
+
+    v = os.environ.get("ROCALPHAGO_PALLAS_CHASE", "")
+    return {"1": "pallas", "pallas": "pallas",
+            "interpret": "interpret"}.get(v, "xla")
+
+
 def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
                      need_chase, depth: int, slots: int):
-    """Run :func:`_chase` for the lanes flagged ``need_chase``, first
+    """Run the chase for the lanes flagged ``need_chase``, first
     compacted into ``slots`` slots (bool [K] → results bool [K]).
 
     After the opening filter, typically 0–2 of the K candidate lanes
@@ -394,9 +408,20 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
     (slot_idx,) = jnp.nonzero(need_chase, size=slots, fill_value=k)
     valid = slot_idx < k
     safe = jnp.where(valid, slot_idx, 0)
-    captured = jax.vmap(
-        lambda b, l, p, v: _chase(cfg, b, l, p, depth, enabled=v))(
-            boards[safe], labels[safe], prey_pts[safe], valid)
+    impl = _chase_impl()
+    if impl == "xla":
+        captured = jax.vmap(
+            lambda b, l, p, v: _chase(cfg, b, l, p, depth, enabled=v))(
+                boards[safe], labels[safe], prey_pts[safe], valid)
+    else:
+        from rocalphago_tpu.ops.chase import pallas_chase
+
+        n = cfg.num_points
+        prey_oh = ((jnp.arange(n)[None, :] == prey_pts[safe][:, None])
+                   & valid[:, None])
+        captured = pallas_chase(boards[safe], labels[safe], prey_oh,
+                                cfg.size, depth,
+                                interpret=impl == "interpret")
     scatter = jnp.zeros((k,), jnp.bool_)
     return (scatter.at[slot_idx].set(captured & valid, mode="drop"),
             scatter.at[slot_idx].set(valid, mode="drop"))

@@ -1,0 +1,352 @@
+"""Pallas TPU kernel: per-lane ladder-chase reading.
+
+The ladder chase (``features/ladders.py::_chase``) is the framework's
+hottest loop: a ``lax.while_loop`` whose trip count is the rung length
+of the read. Under the encoder's vmap the XLA formulation runs ONE
+lockstep loop over every (board × chase-slot) lane — one 40-rung
+ladder anywhere in the batch makes every lane pay 40 trips. This
+kernel gives each lane its OWN loop in its own grid cell: inactive
+lanes exit after one trip, boards in VMEM, zero HBM traffic between
+rungs.
+
+Mosaic-dictated design (lessons from ``ops/labels.py`` on real v5e:
+no in-kernel reshapes, no sub-word vector compares, no gathers or
+scatters):
+
+* every per-lane array is FLAT ``(1, 1, N)`` (``N = size²``) — block
+  shape equals the trailing array dims, so any ``N`` is accepted;
+  neighbor access is pad+slice shifts along the flat axis (±1 with a
+  column-boundary mask, ±size needs none);
+* per-GROUP quantities (the liberty-count table the response algebra
+  needs) use broadcast ``(1, N, N)`` root×point tables reduced along
+  one axis — the scatter-free formulation of ``group_data``'s
+  dedup-scatter (an empty point is a liberty of root ρ iff any of its
+  4 neighbors has label ρ; the OR over directions dedups for free);
+* scalars (points, roots, outcomes) live on the scalar core: value
+  extraction is ``(x * onehot).sum()``, first-set-index is a masked
+  min over iota.
+
+Semantics are IDENTICAL to the XLA ``_chase`` — same carried
+incremental min-root labeling, same 2-ply rung (chaser option scored
+by the forced escaper response), same tie-breaks (first liberty by
+flat index, option pick ``o1 <= o2``, response pick ``L1 >= L2``) —
+and ``tests/test_ops.py`` differential-checks the two lane-by-lane on
+random chase openings. Opt-in like the labels kernel: the XLA path
+stays the default until real-chip measurements favor this one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# per-option ladder outcomes, ordered so the chaser minimises
+# (mirror of features/ladders.py)
+_CAPTURED, _CONTINUE, _ESCAPED = 0, 1, 2
+
+
+def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref, *,
+                  size: int, depth: int):
+    n = size * size
+    SENT = jnp.int32(n)           # empty/off-board label sentinel
+    BIG = jnp.int32(4 * n)        # "no point" index sentinel
+
+    board0 = board_ref[...].astype(jnp.int32)    # (1,1,N)
+    labels0 = labels_ref[...].astype(jnp.int32)  # (1,1,N)
+    prey_oh = prey_ref[...].astype(jnp.int32)    # (1,1,N) one-hot / zeros
+
+    iota_e = jax.lax.broadcasted_iota(jnp.int32, (1, 1, n), 2)
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (1, n, 1), 1)
+    col = iota_e % size
+    DIRS = (1, -1, size, -size)
+
+    def nbr(x, d, fill):
+        """out[e] = x[e+d] (the value at e's neighbor), ``fill``
+        off-board. ±1 masks the column wrap; ±size pads off the end."""
+        f = jnp.asarray(fill, x.dtype)
+        if d == 1:
+            v = jnp.pad(x, ((0, 0), (0, 0), (0, 1)),
+                        constant_values=fill)[..., 1:]
+            return jnp.where(col == size - 1, f, v)
+        if d == -1:
+            v = jnp.pad(x, ((0, 0), (0, 0), (1, 0)),
+                        constant_values=fill)[..., :n]
+            return jnp.where(col == 0, f, v)
+        if d == size:
+            return jnp.pad(x, ((0, 0), (0, 0), (0, size)),
+                           constant_values=fill)[..., size:]
+        return jnp.pad(x, ((0, 0), (0, 0), (size, 0)),
+                       constant_values=fill)[..., :n]
+
+    def dilate(m):
+        return (m | nbr(m, 1, False) | nbr(m, -1, False)
+                | nbr(m, size, False) | nbr(m, -size, False))
+
+    def scal(x, oh):
+        """Scalar value of int32 field ``x`` at one-hot ``oh``."""
+        return (x * oh).sum()
+
+    def sbool(m, oh):
+        """Scalar: is bool field ``m`` set at one-hot ``oh``."""
+        return scal(m.astype(jnp.int32), oh) > 0
+
+    def min_idx(mask):
+        return jnp.where(mask, iota_e, BIG).min()
+
+    def onehot(pt):
+        return (iota_e == pt).astype(jnp.int32)
+
+    def isum(m):
+        return m.astype(jnp.int32).sum()
+
+    def valid_dir(pt, d):
+        """Is pt's neighbor in direction d on the board (pt itself may
+        be BIG = nowhere, which yields garbage safely gated off by the
+        caller's enables)."""
+        if d == 1:
+            return (pt % size) < size - 1
+        if d == -1:
+            return (pt % size) > 0
+        if d == size:
+            return pt < n - size
+        return pt >= size
+
+    def libs_table(board, labels):
+        """(1,N,1) distinct-liberty count per root."""
+        empty = board == 0
+        adj = jnp.zeros((1, n, n), jnp.bool_)
+        for d in DIRS:
+            adj = adj | (nbr(labels, d, SENT) == iota_r)
+        return (adj & empty).astype(jnp.int32).sum(axis=2, keepdims=True)
+
+    def table_at(table, root):
+        """Scalar table[root] (0 for root == SENT/garbage ≥ n is fine:
+        no iota_r row matches)."""
+        return (table * (iota_r == root).astype(jnp.int32)).sum()
+
+    prey_color = scal(board0, prey_oh)           # ±1, or 0 if disabled
+    chaser = -prey_color
+
+    def place(board, labels, libsT, pt, color):
+        """Chaser-move legality + captures at scalar ``pt`` — mirror
+        of ladders._place on the carried analysis."""
+        oh = onehot(pt)
+        cap = jnp.zeros((1, 1, n), jnp.bool_)
+        has_empty = jnp.bool_(False)
+        own_safe = jnp.bool_(False)
+        any_cap = jnp.bool_(False)
+        for d in DIRS:
+            vd = valid_dir(pt, d)
+            qc = scal(nbr(board, d, 0), oh)
+            qr = scal(nbr(labels, d, SENT), oh)
+            qlibs = table_at(libsT, qr)
+            cap_d = vd & (qc == -color) & (qlibs == 1)
+            cap = cap | jnp.where(cap_d, labels == qr, False)
+            has_empty = has_empty | (vd & (qc == 0) & (qr == SENT))
+            own_safe = own_safe | (vd & (qc == color) & (qlibs >= 2))
+            any_cap = any_cap | cap_d
+        ok = (scal(board, oh) == 0) & (has_empty | own_safe | any_cap)
+        return ok, cap & ok
+
+    def relabel(board, labels, pt, color, cap, enabled):
+        """Incremental min-root relabel after placing ``color`` at
+        ``pt`` and removing ``cap`` — mirror of ladders._relabel_place."""
+        oh = onehot(pt)
+        ohb = oh > 0
+        min_r = BIG
+        merged = jnp.zeros((1, 1, n), jnp.bool_)
+        for d in DIRS:
+            vd = valid_dir(pt, d)
+            qc = scal(nbr(board, d, 0), oh)
+            qr = scal(nbr(labels, d, SENT), oh)
+            same_d = vd & (qc == color)
+            min_r = jnp.minimum(min_r, jnp.where(same_d, qr, BIG))
+            merged = merged | (same_d & (labels == qr))
+        new_root = jnp.minimum(min_r, pt)
+        labels1 = jnp.where(merged | ohb, new_root, labels)
+        labels1 = jnp.where(cap, SENT, labels1)
+        board1 = jnp.where(cap, 0, jnp.where(ohb, color, board))
+        return (jnp.where(enabled, board1, board),
+                jnp.where(enabled, labels1, labels))
+
+    def escaper_response(b1, labels, libsT, prey_root, c_pt, cap0):
+        """Forced prey response — mirror of _escaper_response_full on
+        the pre-chaser-move analysis (labels/libsT) + post-move b1."""
+        empty1 = b1 == 0
+        prey_mask = labels == prey_root
+        dil_prey = dilate(prey_mask)
+        prey_libs1 = empty1 & dil_prey
+        preyL1 = isum(prey_libs1)
+        ext_pt = min_idx(prey_libs1)
+        c_oh = onehot(c_pt)
+
+        # the merged chaser group around c_pt
+        gc_mask = c_oh > 0
+        for d in DIRS:
+            vd = valid_dir(c_pt, d)
+            qc = scal(nbr(b1, d, 0), c_oh)
+            qr = scal(nbr(labels, d, SENT), c_oh)
+            gc_mask = gc_mask | jnp.where(vd & (qc == chaser),
+                                          labels == qr, False)
+        gc_nlibs = isum(empty1 & dilate(gc_mask))
+
+        # chaser groups that gained a liberty from the chaser-move
+        # capture can be neither counter-captured nor captured
+        M = labels == iota_r                                # (1,N,N)
+        gained_pt = (b1 == chaser) & dilate(cap0)
+        gainedT = (M & gained_pt).any(axis=2, keepdims=True)  # (1,N,1)
+        gained_field = (M & gainedT).any(axis=1, keepdims=True)
+
+        libs_field = (M.astype(jnp.int32) * libsT).sum(
+            axis=1, keepdims=True)                          # (1,1,N)
+
+        # counter-capture target: first chaser stone adjacent to the
+        # prey whose group is in atari on b1
+        adj_prey = (b1 == chaser) & dil_prey
+        atari_pts = adj_prey & jnp.where(
+            gc_mask, gc_nlibs == 1,
+            (libs_field == 1) & ~gained_field)
+        have_cap = atari_pts.any()
+        target = min_idx(atari_pts)
+        t_oh = onehot(target)
+        target_in_gc = sbool(gc_mask, t_oh)
+        target_root = scal(labels, t_oh)
+        target_mask = jnp.where(target_in_gc, gc_mask,
+                                labels == target_root)
+        cap_pt = min_idx(empty1 & dilate(target_mask))
+
+        def try_move(pt, enabled):
+            oh = onehot(pt)
+            ohb = oh > 0
+            esc_cap = jnp.zeros((1, 1, n), jnp.bool_)
+            gc_adj = jnp.bool_(False)
+            merge_mask = jnp.zeros((1, 1, n), jnp.bool_)
+            for d in DIRS:
+                vd = valid_dir(pt, d)
+                qc = scal(nbr(b1, d, 0), oh)
+                qr = scal(nbr(labels, d, SENT), oh)
+                in_gc_d = sbool(nbr(gc_mask, d, False), oh)
+                qlibs = table_at(libsT, qr)
+                qgained = sbool(nbr(gained_field, d, False), oh)
+                old_cap_d = (vd & (qc == chaser) & ~in_gc_d
+                             & (qlibs == 1) & ~qgained)
+                esc_cap = esc_cap | jnp.where(old_cap_d,
+                                              labels == qr, False)
+                gc_adj = gc_adj | (vd & (qc == chaser) & in_gc_d)
+                merge_mask = merge_mask | jnp.where(
+                    vd & (qc == prey_color), labels == qr, False)
+            esc_cap = esc_cap | ((gc_adj & (gc_nlibs == 1)) & gc_mask)
+            cluster = ohb | merge_mask
+            empty2 = (empty1 & ~ohb) | esc_cap
+            comp = jnp.where(sbool(dil_prey, oh),
+                             prey_mask | cluster, prey_mask)
+            L2 = isum(empty2 & dilate(comp))
+            legal = (empty2 & dilate(cluster)).any()
+            okm = enabled & sbool(empty1, oh) & legal
+            return jnp.where(okm, L2, -1), esc_cap & okm
+
+        L1v, C1 = try_move(ext_pt, preyL1 >= 1)
+        L2v, C2 = try_move(cap_pt, have_cap)
+        take1 = L1v >= L2v
+        respL = jnp.where(take1, L1v, L2v)
+        return (preyL1, respL,
+                jnp.where(take1, ext_pt, cap_pt),
+                jnp.where(take1, C1, C2), respL >= 0)
+
+    def rung(board, labels):
+        libsT = libs_table(board, labels)
+        prey_root = scal(labels, prey_oh)
+        prey_alive = scal(board, prey_oh) == prey_color
+        L = jnp.where(prey_alive, table_at(libsT, prey_root), 0)
+        prey_mask = labels == prey_root
+        prey_lib_mask = (board == 0) & dilate(prey_mask)
+        l1 = min_idx(prey_lib_mask)
+        l2 = min_idx(prey_lib_mask & (iota_e != l1))
+
+        def option(lib_pt):
+            ok, cap0 = place(board, labels, libsT, lib_pt, chaser)
+            oh = onehot(lib_pt)
+            b1 = jnp.where(cap0, 0, jnp.where(oh > 0, chaser, board))
+            preyL, respL, resp_pt, resp_cap, resp_made = \
+                escaper_response(b1, labels, libsT, prey_root,
+                                 lib_pt, cap0)
+            resp_logic = jnp.where(
+                respL <= 1, _CAPTURED,
+                jnp.where(respL >= 3, _ESCAPED, _CONTINUE))
+            outcome = jnp.where((L == 2) & ok & (preyL == 1),
+                                resp_logic, _ESCAPED)
+            return outcome, (lib_pt, cap0, resp_pt, resp_cap, resp_made)
+
+        o1, u1 = option(l1)
+        o2, u2 = option(l2)
+        pick1 = o1 <= o2
+        o = jnp.where(pick1, o1, o2)
+        c_pt, cap0, resp_pt, resp_cap, resp_made = jax.tree.map(
+            lambda a, b: jnp.where(pick1, a, b), u1, u2)
+
+        pre = jnp.where(
+            ~prey_alive, _CAPTURED,
+            jnp.where(L >= 3, _ESCAPED,
+                      jnp.where(L == 1, _CAPTURED, -1)))
+        o = jnp.where(pre >= 0, pre, o)
+        advance = (pre < 0) & (o == _CONTINUE)
+
+        board1, labels1 = relabel(board, labels, c_pt, chaser, cap0,
+                                  advance)
+        board2, labels2 = relabel(board1, labels1, resp_pt, prey_color,
+                                  resp_cap, advance & resp_made)
+        return board2, labels2, o
+
+    def cond(state):
+        _, _, done, _, r = state
+        return ~done & (r < depth)
+
+    def body(state):
+        board, labels, done, captured, r = state
+        board2, labels2, o = rung(board, labels)
+        return (board2, labels2,
+                done | (o != _CONTINUE),
+                jnp.where(done, captured, o == _CAPTURED),
+                r + 1)
+
+    enabled = prey_oh.sum() > 0
+    init = (board0, labels0, ~enabled, jnp.bool_(False), jnp.int32(0))
+    _, _, _, captured, _ = jax.lax.while_loop(cond, body, init)
+    out_ref[...] = jnp.broadcast_to(
+        (captured & enabled).astype(jnp.int32), (1, 1, n))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("size", "depth", "interpret"))
+def pallas_chase(boards: jax.Array, labels: jax.Array,
+                 prey_onehot: jax.Array, size: int, depth: int = 40,
+                 interpret: bool = False) -> jax.Array:
+    """Batched ladder chase: for each lane ``i``, is the group at
+    ``prey_onehot[i]`` (one-hot over the flat board; all-zero =
+    disabled lane) ladder-captured with the chaser to move?
+
+    ``boards``/``labels``: int ``[L, N]`` — a board and its carried
+    min-root labeling per lane (see ``ladders._relabel_place``).
+    Returns bool ``[L]``. Semantics identical to
+    ``vmap(ladders._chase)``; each lane runs its own grid cell, so
+    trip counts are per-lane, not batch-lockstep.
+    """
+    lanes, n = boards.shape
+    if n != size * size:
+        raise ValueError(f"boards have {n} points, size² is {size * size}")
+    kernel = functools.partial(_chase_kernel, size=size, depth=depth)
+    spec = pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(lanes,),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((lanes, 1, n), jnp.int32),
+        interpret=interpret,
+    )(boards.astype(jnp.int32)[:, None, :],
+      labels.astype(jnp.int32)[:, None, :],
+      prey_onehot.astype(jnp.int32)[:, None, :])
+    return out[:, 0, 0] > 0

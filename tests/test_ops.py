@@ -6,13 +6,16 @@ distance) are included so the static sweep bound is exercised, not
 just typical sparse boards.
 """
 
+import functools
+
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from rocalphago_tpu.engine import pygo
 from rocalphago_tpu.engine.jaxgo import GoConfig, compute_labels
-from rocalphago_tpu.ops import pallas_labels
+from rocalphago_tpu.ops import pallas_chase, pallas_labels
 
 SIZE = 9
 N = SIZE * SIZE
@@ -57,6 +60,99 @@ def test_pallas_labels_match_xla_on_random_boards(moves):
     got = np.asarray(pallas_labels(boards, SIZE, interpret=True))
     want = np.asarray(xla_labels(boards))
     np.testing.assert_array_equal(got, want)
+
+
+def chase_lanes(seed, positions=24, moves_lo=8, moves_hi=40):
+    """Valid chase entries harvested from random games: each lane is a
+    (board, exact labels, 2-liberty prey group root) triple — the
+    state the ladder planes hand to the chase after the opening."""
+    cfg = GoConfig(size=SIZE)
+    rng = np.random.default_rng(seed)
+    boards, labels, preys = [], [], []
+    for _ in range(positions):
+        st = pygo.GameState(size=SIZE, komi=5.5)
+        for _ in range(int(rng.integers(moves_lo, moves_hi))):
+            legal = st.get_legal_moves(include_eyes=False)
+            if not legal or st.is_end_of_game:
+                break
+            st.do_move(legal[rng.integers(len(legal))])
+        flat = np.asarray(st.board, np.int8).reshape(-1)
+        lab = np.asarray(compute_labels(cfg, jnp.asarray(flat)))
+        from rocalphago_tpu.engine.jaxgo import lib_counts_from_labels
+        libs = np.asarray(lib_counts_from_labels(
+            cfg, jnp.asarray(flat), jnp.asarray(lab)))
+        for root in np.unique(lab[flat != 0]):
+            if libs[root] == 2:
+                boards.append(flat)
+                labels.append(lab)
+                preys.append(int(root))
+    return (np.stack(boards), np.stack(labels),
+            np.asarray(preys, np.int32))
+
+
+def test_pallas_chase_matches_xla_on_random_entries():
+    from rocalphago_tpu.features.ladders import _chase
+
+    cfg = GoConfig(size=SIZE)
+    boards, labels, preys = chase_lanes(seed=3)
+    assert len(preys) >= 20
+    xla = jax.jit(jax.vmap(functools.partial(
+        _chase, cfg, depth=40, enabled=True)))
+    want = np.asarray(xla(jnp.asarray(boards), jnp.asarray(labels),
+                          jnp.asarray(preys)))
+    prey_oh = (np.arange(N)[None, :] == preys[:, None])
+    got = np.asarray(pallas_chase(
+        jnp.asarray(boards), jnp.asarray(labels),
+        jnp.asarray(prey_oh), SIZE, depth=40, interpret=True))
+    np.testing.assert_array_equal(got, want)
+    # the harvest must include both outcomes or the test proves little
+    assert want.any() and not want.all()
+
+
+def test_pallas_chase_disabled_lane_is_false():
+    boards, labels, preys = chase_lanes(seed=5, positions=4)
+    zeros = np.zeros((len(preys), N), bool)
+    got = np.asarray(pallas_chase(
+        jnp.asarray(boards), jnp.asarray(labels), jnp.asarray(zeros),
+        SIZE, interpret=True))
+    assert not got.any()
+
+
+def test_chase_impl_flag_produces_identical_planes(monkeypatch):
+    """The ROCALPHAGO_PALLAS_CHASE=interpret path must yield the exact
+    same ladder planes as the default XLA chase (plane-level wiring of
+    the kernel, not just the raw chase)."""
+    from rocalphago_tpu.engine.jaxgo import (
+        from_pygo,
+        group_data,
+        legal_mask,
+    )
+    from rocalphago_tpu.features import ladders
+
+    cfg = GoConfig(size=SIZE)
+    rng = np.random.default_rng(11)
+    st = pygo.GameState(size=SIZE, komi=5.5)
+    for _ in range(30):
+        legal = st.get_legal_moves(include_eyes=False)
+        if not legal or st.is_end_of_game:
+            break
+        st.do_move(legal[rng.integers(len(legal))])
+    jst = from_pygo(cfg, st)
+    gd = group_data(cfg, jst.board, with_zxor=False)
+    legal = legal_mask(cfg, jst, gd)[:-1]
+
+    def planes():
+        return (np.asarray(ladders.ladder_capture_plane(
+                    cfg, jst, gd, legal)),
+                np.asarray(ladders.ladder_escape_plane(
+                    cfg, jst, gd, legal)))
+
+    monkeypatch.delenv("ROCALPHAGO_PALLAS_CHASE", raising=False)
+    cap_xla, esc_xla = planes()
+    monkeypatch.setenv("ROCALPHAGO_PALLAS_CHASE", "interpret")
+    cap_pal, esc_pal = planes()
+    np.testing.assert_array_equal(cap_xla, cap_pal)
+    np.testing.assert_array_equal(esc_xla, esc_pal)
 
 
 @pytest.mark.parametrize("size", [SIZE, 19])
