@@ -52,6 +52,55 @@ def report(metric: str, value: float, unit: str,
     print(json.dumps(line))
 
 
+def harvest_chase_lanes(size: int, lanes: int | None, seed: int,
+                        moves_lo: int = 8, moves_hi: int = 120,
+                        positions: int | None = None):
+    """Valid ladder-chase entries from random games: every 2-liberty
+    group is a chase entry (chaser to move). Returns
+    ``(boards [L,N] int8, labels [L,N] int32, prey_roots [L] int32)``
+    numpy arrays. Shared by ``benchmarks/bench_chase.py`` and
+    ``tests/test_ops.py`` so both always exercise the exact entry
+    contract the ladder planes hand to the chase (board + carried
+    min-root labeling + prey root). Stop either at ``lanes`` total
+    lanes or after ``positions`` random positions."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rocalphago_tpu.engine import pygo
+    from rocalphago_tpu.engine.jaxgo import (
+        GoConfig,
+        compute_labels,
+        lib_counts_from_labels,
+    )
+
+    cfg = GoConfig(size=size)
+    rng = np.random.default_rng(seed)
+    boards, labels, preys = [], [], []
+    pos = 0
+    while (lanes is None or len(preys) < lanes) and (
+            positions is None or pos < positions):
+        pos += 1
+        st = pygo.GameState(size=size, komi=7.5)
+        for _ in range(int(rng.integers(moves_lo, moves_hi))):
+            legal = st.get_legal_moves(include_eyes=False)
+            if not legal or st.is_end_of_game:
+                break
+            st.do_move(legal[rng.integers(len(legal))])
+        flat = np.asarray(st.board, np.int8).reshape(-1)
+        lab = np.asarray(compute_labels(cfg, jnp.asarray(flat)))
+        libs = np.asarray(lib_counts_from_labels(
+            cfg, jnp.asarray(flat), jnp.asarray(lab)))
+        for root in np.unique(lab[flat != 0]):
+            if libs[root] == 2 and (lanes is None or len(preys) < lanes):
+                boards.append(flat)
+                labels.append(lab)
+                preys.append(int(root))
+        if positions is None and lanes is not None and pos > lanes * 20:
+            break   # safety: pathological seed with no 2-lib groups
+    return (np.stack(boards), np.stack(labels),
+            np.asarray(preys, np.int32))
+
+
 def random_game_states(cfg, batch: int, moves: int, rng_key):
     """Batched mid-game positions: ``moves`` uniform random legal
     plies under one jit (shared by the engine/encoder benchmarks)."""

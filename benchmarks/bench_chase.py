@@ -13,7 +13,12 @@ import functools
 import sys
 
 sys.path.insert(0, ".")
-from benchmarks._harness import report, std_parser, timed  # noqa: E402
+from benchmarks._harness import (  # noqa: E402
+    harvest_chase_lanes,
+    report,
+    std_parser,
+    timed,
+)
 
 
 def main() -> None:
@@ -21,9 +26,7 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from rocalphago_tpu.engine import pygo
-    from rocalphago_tpu.engine.jaxgo import GoConfig, compute_labels, \
-        lib_counts_from_labels
+    from rocalphago_tpu.engine.jaxgo import GoConfig
     from rocalphago_tpu.features.ladders import _chase
     from rocalphago_tpu.ops.chase import pallas_chase
 
@@ -35,27 +38,11 @@ def main() -> None:
     lanes = args.batch or 128
     cfg = GoConfig(size=size)
 
-    rng = np.random.default_rng(0)
-    boards, labels, preys = [], [], []
-    while len(preys) < lanes:
-        st = pygo.GameState(size=size, komi=7.5)
-        for _ in range(int(rng.integers(20, 120))):
-            legal = st.get_legal_moves(include_eyes=False)
-            if not legal or st.is_end_of_game:
-                break
-            st.do_move(legal[rng.integers(len(legal))])
-        flat = np.asarray(st.board, np.int8).reshape(-1)
-        lab = np.asarray(compute_labels(cfg, jnp.asarray(flat)))
-        libs = np.asarray(lib_counts_from_labels(
-            cfg, jnp.asarray(flat), jnp.asarray(lab)))
-        for root in np.unique(lab[flat != 0]):
-            if libs[root] == 2 and len(preys) < lanes:
-                boards.append(flat)
-                labels.append(lab)
-                preys.append(int(root))
-    boards = jnp.asarray(np.stack(boards))
-    labels_a = jnp.asarray(np.stack(labels))
-    preys = np.asarray(preys, np.int32)
+    boards, labels, preys = harvest_chase_lanes(size, lanes, seed=0,
+                                                moves_lo=20)
+    boards = jnp.asarray(boards)
+    labels_a = jnp.asarray(labels)
+    lanes = len(preys)
     prey_oh = jnp.asarray(np.arange(n)[None, :] == preys[:, None])
     preys = jnp.asarray(preys)
 

@@ -172,9 +172,12 @@ def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref, *,
         return (jnp.where(enabled, board1, board),
                 jnp.where(enabled, labels1, labels))
 
-    def escaper_response(b1, labels, libsT, prey_root, c_pt, cap0):
+    def escaper_response(b1, labels, M, libsT, libs_field, prey_root,
+                         c_pt, cap0):
         """Forced prey response — mirror of _escaper_response_full on
-        the pre-chaser-move analysis (labels/libsT) + post-move b1."""
+        the pre-chaser-move analysis (labels/M/libsT/libs_field) +
+        post-move b1. ``M``/``libs_field`` are rung-constant and
+        hoisted by the caller (two N² tensors per rung, not four)."""
         empty1 = b1 == 0
         prey_mask = labels == prey_root
         dil_prey = dilate(prey_mask)
@@ -195,13 +198,9 @@ def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref, *,
 
         # chaser groups that gained a liberty from the chaser-move
         # capture can be neither counter-captured nor captured
-        M = labels == iota_r                                # (1,N,N)
         gained_pt = (b1 == chaser) & dilate(cap0)
         gainedT = (M & gained_pt).any(axis=2, keepdims=True)  # (1,N,1)
         gained_field = (M & gainedT).any(axis=1, keepdims=True)
-
-        libs_field = (M.astype(jnp.int32) * libsT).sum(
-            axis=1, keepdims=True)                          # (1,1,N)
 
         # counter-capture target: first chaser stone adjacent to the
         # prey whose group is in atari on b1
@@ -258,6 +257,9 @@ def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref, *,
 
     def rung(board, labels):
         libsT = libs_table(board, labels)
+        M = labels == iota_r                                # (1,N,N)
+        libs_field = (M.astype(jnp.int32) * libsT).sum(
+            axis=1, keepdims=True)                          # (1,1,N)
         prey_root = scal(labels, prey_oh)
         prey_alive = scal(board, prey_oh) == prey_color
         L = jnp.where(prey_alive, table_at(libsT, prey_root), 0)
@@ -271,8 +273,8 @@ def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref, *,
             oh = onehot(lib_pt)
             b1 = jnp.where(cap0, 0, jnp.where(oh > 0, chaser, board))
             preyL, respL, resp_pt, resp_cap, resp_made = \
-                escaper_response(b1, labels, libsT, prey_root,
-                                 lib_pt, cap0)
+                escaper_response(b1, labels, M, libsT, libs_field,
+                                 prey_root, lib_pt, cap0)
             resp_logic = jnp.where(
                 respL <= 1, _CAPTURED,
                 jnp.where(respL >= 3, _ESCAPED, _CONTINUE))

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# End-to-end pipeline demo on CPU: SGF corpus → training shards →
+# SL training (data-parallel over 8 virtual devices) → held-out eval
+# → batched self-play → GTP move generation.
+#
+# The reference's workflow (SURVEY.md §3.1/§3.4/§3.5: game_converter →
+# supervised_policy_trainer → ai/gtp_wrapper), exercised as a product:
+# every stage is the installed CLI, artifacts land in $OUT.
+#
+#   bash scripts/pipeline_demo.sh [OUT_DIR]
+#
+# Finishes in a few minutes on one CPU host (tiny net, bundled SGFs).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-/tmp/rocalphago_demo}"
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+PY="python"
+rm -rf "$OUT"      # fresh demo dir — stale shards/splits would trip
+mkdir -p "$OUT"    # the trainer's corpus-changed resume guard
+
+echo "== 1/5 convert: bundled SGFs → npz shards"
+$PY -m rocalphago_tpu.data.convert \
+    --directory tests/test_data --outfile "$OUT/corpus" --size 9
+
+echo "== 2/5 spec + SL training (2 epochs, 8-device data parallel)"
+$PY -m rocalphago_tpu.models.specs policy --out "$OUT/policy.json" \
+    --board 9 --layers 2 --filters 16
+$PY -m rocalphago_tpu.training.sl "$OUT/policy.json" "$OUT/corpus" \
+    "$OUT/sl" --epochs 2 --minibatch 16
+echo "   metadata:"; tail -c 400 "$OUT/sl/metadata.json"; echo
+
+echo "== 3/5 held-out eval (top-1 / loss on the test split)"
+$PY -m rocalphago_tpu.training.evaluate "$OUT/sl/model.json" \
+    "$OUT/corpus" --split test --shuffle-npz "$OUT/sl/shuffle.npz"
+
+echo "== 4/5 batched self-play with the trained policy (sharded)"
+$PY -m rocalphago_tpu.interface.selfplay_cli \
+    --policy "$OUT/sl/model.json" --games 16 --max-moves 30 \
+    --chunk 15 --shard --out "$OUT/selfplay"
+
+echo "== 5/5 GTP smoke: genmove with the trained policy"
+printf 'boardsize 9\nclear_board\ngenmove b\nquit\n' | \
+    $PY -m rocalphago_tpu.interface.gtp --policy "$OUT/sl/model.json"
+
+echo "PIPELINE DEMO OK — artifacts in $OUT"

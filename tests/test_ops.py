@@ -63,31 +63,17 @@ def test_pallas_labels_match_xla_on_random_boards(moves):
 
 
 def chase_lanes(seed, positions=24, moves_lo=8, moves_hi=40):
-    """Valid chase entries harvested from random games: each lane is a
-    (board, exact labels, 2-liberty prey group root) triple — the
-    state the ladder planes hand to the chase after the opening."""
-    cfg = GoConfig(size=SIZE)
-    rng = np.random.default_rng(seed)
-    boards, labels, preys = [], [], []
-    for _ in range(positions):
-        st = pygo.GameState(size=SIZE, komi=5.5)
-        for _ in range(int(rng.integers(moves_lo, moves_hi))):
-            legal = st.get_legal_moves(include_eyes=False)
-            if not legal or st.is_end_of_game:
-                break
-            st.do_move(legal[rng.integers(len(legal))])
-        flat = np.asarray(st.board, np.int8).reshape(-1)
-        lab = np.asarray(compute_labels(cfg, jnp.asarray(flat)))
-        from rocalphago_tpu.engine.jaxgo import lib_counts_from_labels
-        libs = np.asarray(lib_counts_from_labels(
-            cfg, jnp.asarray(flat), jnp.asarray(lab)))
-        for root in np.unique(lab[flat != 0]):
-            if libs[root] == 2:
-                boards.append(flat)
-                labels.append(lab)
-                preys.append(int(root))
-    return (np.stack(boards), np.stack(labels),
-            np.asarray(preys, np.int32))
+    """Chase entries via the SAME harvest the chase benchmark uses
+    (``benchmarks/_harness.py``) so test and bench always exercise the
+    exact entry contract the ladder planes hand to the chase."""
+    import sys
+
+    sys.path.insert(0, ".")
+    from benchmarks._harness import harvest_chase_lanes
+
+    return harvest_chase_lanes(SIZE, lanes=None, seed=seed,
+                               moves_lo=moves_lo, moves_hi=moves_hi,
+                               positions=positions)
 
 
 def test_pallas_chase_matches_xla_on_random_entries():
@@ -107,6 +93,34 @@ def test_pallas_chase_matches_xla_on_random_entries():
     np.testing.assert_array_equal(got, want)
     # the harvest must include both outcomes or the test proves little
     assert want.any() and not want.all()
+
+
+def test_pallas_chase_under_vmap_matches_unbatched():
+    """Every production call site reaches the kernel through the
+    encoder's jax.vmap over games (the pallas_call batching rule
+    prepends a grid dim) — pin that path, not just the flat one."""
+    from rocalphago_tpu.features.ladders import _chase
+
+    cfg = GoConfig(size=SIZE)
+    boards, labels, preys = chase_lanes(seed=9, positions=30)
+    g = 3                                 # games × lanes
+    lanes = (len(preys) // g) * g
+    assert lanes >= 2 * g
+    shape_b = (g, lanes // g, N)
+    vb = jnp.asarray(boards[:lanes]).reshape(shape_b)
+    vl = jnp.asarray(labels[:lanes]).reshape(shape_b)
+    oh = (np.arange(N)[None, :] == preys[:lanes, None]).reshape(shape_b)
+
+    batched = jax.vmap(lambda b, l, p: pallas_chase(
+        b, l, p, SIZE, depth=40, interpret=True))(vb, vl,
+                                                  jnp.asarray(oh))
+    xla = jax.jit(jax.vmap(functools.partial(
+        _chase, cfg, depth=40, enabled=True)))
+    want = np.asarray(xla(jnp.asarray(boards[:lanes]),
+                          jnp.asarray(labels[:lanes]),
+                          jnp.asarray(preys[:lanes])))
+    np.testing.assert_array_equal(
+        np.asarray(batched).reshape(-1), want)
 
 
 def test_pallas_chase_disabled_lane_is_false():
