@@ -78,6 +78,13 @@ class GoState(NamedTuple):
     hash_history: jax.Array  # uint32 [H, 2] ring buffer of position hashes
     stone_ages: jax.Array   # int32 [N]  step at which stone placed, -1 empty
     prisoners: jax.Array    # int32 [2]  stones captured from [black, white]
+    labels: jax.Array       # int32 [N]  carried group labeling: min flat
+    #   index per group, sentinel N for empty — ALWAYS equal to
+    #   compute_labels(board). step() maintains it incrementally
+    #   (a move only adds one stone and removes whole captured groups,
+    #   neither of which can split a group), so the per-move flood
+    #   fill disappears from the hot loop; analysis consumers derive
+    #   GroupData loop-free via group_data(..., labels=state.labels).
 
 
 class GroupData(NamedTuple):
@@ -166,6 +173,7 @@ def new_state(cfg: GoConfig) -> GoState:
         hash_history=jnp.zeros((cfg.max_history, 2), jnp.uint32),
         stone_ages=jnp.full((n,), -1, jnp.int32),
         prisoners=jnp.zeros((2,), jnp.int32),
+        labels=jnp.full((n,), n, jnp.int32),
     )
 
 
@@ -216,6 +224,22 @@ def from_pygo(cfg: GoConfig, st, *, with_history: bool = True) -> GoState:
     passes = 0
     if st.history and st.history[-1] is None:
         passes = 2 if (len(st.history) > 1 and st.history[-2] is None) else 1
+
+    # host-side min-root labeling (ascending scan ⇒ the BFS seed is the
+    # group's min flat index), seeding the engine's carried labels
+    n = cfg.num_points
+    nbrs_np = _tables(cfg.size)[0]
+    lab = np.full(n, n, np.int32)
+    for p in range(n):
+        if board[p] != 0 and lab[p] == n:
+            lab[p] = p
+            stack = [p]
+            while stack:
+                q = stack.pop()
+                for r in nbrs_np[q]:
+                    if r < n and board[r] == board[p] and lab[r] == n:
+                        lab[r] = p
+                        stack.append(r)
     return GoState(
         board=jnp.asarray(board),
         turn=jnp.int8(st.current_player),
@@ -230,6 +254,7 @@ def from_pygo(cfg: GoConfig, st, *, with_history: bool = True) -> GoState:
         prisoners=jnp.asarray(
             np.array([st.num_black_prisoners, st.num_white_prisoners],
                      np.int32)),
+        labels=jnp.asarray(lab),
     )
 
 
@@ -316,6 +341,30 @@ def neighbor_analysis(cfg: GoConfig, board: jax.Array, labels: jax.Array):
             jax.vmap(_dedup_mask)(lab_pad[nbrs]), nbrs < n)
 
 
+def relabel_after_place(cfg: GoConfig, board: jax.Array,
+                        labels: jax.Array, pt, color,
+                        cap_mask: jax.Array) -> jax.Array:
+    """Labels after placing ``color`` at ``pt`` (legality pre-checked)
+    and removing the captured stones ``cap_mask`` — exact with zero
+    flood fills, because a placement can only MERGE groups (min of
+    min-rooted groups ∪ {pt} is the union's min flat index) and a
+    capture removes whole groups (reset to the empty sentinel ``N``).
+    The board itself is updated by the caller. Shared by the engine
+    step and the ladder reader's carried chase analysis."""
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
+    lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
+    my = nbrs[pt]
+    same = (my < n) & (board_pad[my] == color)
+    roots = jnp.where(same, lab_pad[my], n)
+    new_root = jnp.minimum(roots.min(), pt).astype(jnp.int32)
+    merged = (labels[:, None] == jnp.where(
+        same, roots, -2)[None, :]).any(axis=1)
+    labels1 = jnp.where(merged, new_root, labels).at[pt].set(new_root)
+    return jnp.where(cap_mask, n, labels1)
+
+
 def lib_counts_from_labels(cfg: GoConfig, board: jax.Array,
                            labels: jax.Array) -> jax.Array:
     """Loop-free liberty recount given ``labels``: int32 ``[N+1]``
@@ -335,7 +384,8 @@ def lib_counts_from_labels(cfg: GoConfig, board: jax.Array,
 
 def group_data(cfg: GoConfig, board: jax.Array, *,
                with_member: bool = False,
-               with_zxor: bool = False) -> GroupData:
+               with_zxor: bool = False,
+               labels: jax.Array | None = None) -> GroupData:
     """Group analysis of a board (one flood fill + small scatters).
 
     Liberty counts are *distinct* empty points per group, computed with
@@ -343,9 +393,15 @@ def group_data(cfg: GoConfig, board: jax.Array, *,
     distinct neighboring group) — no dense [G,N] intermediate in the
     hot path. Request ``with_member`` (feature encoder) or
     ``with_zxor`` (superko legality) explicitly.
+
+    Pass ``labels`` (normally ``state.labels``, the engine's carried
+    incremental labeling) to skip the flood fill entirely — the whole
+    analysis is then loop-free scatters, which is how the self-play /
+    training hot paths run.
     """
     n = cfg.num_points
-    labels = compute_labels(cfg, board)
+    if labels is None:
+        labels = compute_labels(cfg, board)
     empty = board == 0
 
     sizes = jnp.zeros((n + 1,), jnp.int32).at[labels].add(
@@ -421,7 +477,8 @@ def legal_mask(cfg: GoConfig, state: GoState,
     """
     n = cfg.num_points
     if gd is None:
-        gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko)
+        gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko,
+                        labels=state.labels)
     board, me = state.board, state.turn
     empty = board == 0
     nbr_color, nbr_root, uniq, valid_nbr = neighbor_analysis(
@@ -501,7 +558,7 @@ def _step_place(cfg: GoConfig, state: GoState, action,
     zob = zobrist_for(cfg.size)
     board, me = state.board, state.turn
     if gd is None:
-        gd = group_data(cfg, board)
+        gd = group_data(cfg, board, labels=state.labels)
 
     my_nbrs = nbrs[action]                               # [4]
     nbr_color = jnp.concatenate(
@@ -543,6 +600,8 @@ def _step_place(cfg: GoConfig, state: GoState, action,
         stone_ages=jnp.where(captured, -1, state.stone_ages).at[action].set(
             state.step_count),
         prisoners=prisoners,
+        labels=relabel_after_place(cfg, board, gd.labels, action, me,
+                                   captured),
     )
 
 

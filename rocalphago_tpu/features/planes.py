@@ -147,7 +147,8 @@ def encode(cfg: GoConfig, state: GoState,
     need_member = needs_member(features)
     if gd is None:
         gd = group_data(cfg, board, with_member=need_member,
-                        with_zxor=cfg.enforce_superko)
+                        with_zxor=cfg.enforce_superko,
+                        labels=state.labels)
     ci = None
     if need_member:
         ci = candidate_info(cfg, state, gd)

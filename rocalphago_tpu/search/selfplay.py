@@ -50,7 +50,8 @@ def sensible_mask(cfg: GoConfig, state: GoState,
     (the reference's ``get_legal_moves(include_eyes=False)``).
     Pass a precomputed ``gd`` to share the flood fill."""
     if gd is None:
-        gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko)
+        gd = group_data(cfg, state.board, with_zxor=cfg.enforce_superko,
+                        labels=state.labels)
     legal = legal_mask(cfg, state, gd)[:-1]
     return legal & ~true_eyes(cfg, state, state.turn)
 
@@ -81,9 +82,11 @@ def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
         raise ValueError(
             f"batch must be even (half-and-half color split), got {batch}")
     n = cfg.num_points
-    vgd = jax.vmap(lambda board: group_data(
-        cfg, board, with_member=needs_member(features),
-        with_zxor=cfg.enforce_superko))
+    # loop-free group analysis from the engine's carried labels — no
+    # flood fill anywhere in the per-ply path
+    vgd = jax.vmap(lambda s: group_data(
+        cfg, s.board, with_member=needs_member(features),
+        with_zxor=cfg.enforce_superko, labels=s.labels))
     enc = jax.vmap(
         lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
@@ -91,9 +94,9 @@ def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
 
     def ply(params_a, params_b, states, rng, t):
         rng, sub = jax.random.split(rng)
-        # one flood fill per ply, shared by the encoder and the
-        # sensibleness mask
-        gd = vgd(states.board)
+        # one loop-free analysis per ply, shared by the encoder, the
+        # sensibleness mask and the rules step
+        gd = vgd(states)
         planes = enc(states, gd)
         # which half faces net A this ply (see module docstring)
         swap = (t % 2) == 1
@@ -112,7 +115,7 @@ def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
         action = jnp.where(must_pass, n, board_action).astype(jnp.int32)
 
         live = ~states.done
-        new = vstep(states, action)
+        new = vstep(states, action, gd)
         return new, rng, action, live
 
     return ply
@@ -300,9 +303,11 @@ def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
     full-batch forward.
     """
     n = cfg.num_points
-    vgd = jax.vmap(lambda board: group_data(
-        cfg, board, with_member=needs_member(features),
-        with_zxor=cfg.enforce_superko))
+    # loop-free group analysis from the engine's carried labels — no
+    # flood fill anywhere in the per-ply path
+    vgd = jax.vmap(lambda s: group_data(
+        cfg, s.board, with_member=needs_member(features),
+        with_zxor=cfg.enforce_superko, labels=s.labels))
     enc = jax.vmap(lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
@@ -312,7 +317,7 @@ def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
         def ply(carry, _):
             states, rng = carry
             rng, sub = jax.random.split(rng)
-            gd = vgd(states.board)
+            gd = vgd(states)
             planes = enc(states, gd)
             logits = apply_fn(params, planes)
             sens = vsens(states, gd)
@@ -321,7 +326,7 @@ def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
             action = jax.random.categorical(sub, masked, axis=-1)
             must_pass = ~sens.any(axis=-1)
             action = jnp.where(must_pass, n, action).astype(jnp.int32)
-            return (vstep(states, action), rng), None
+            return (vstep(states, action, gd), rng), None
 
         (final, _), _ = lax.scan(ply, (states, rng), None,
                                  length=rollout_limit)

@@ -73,9 +73,9 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
     n = cfg.num_points
     u_cap = min(u_max if u_max is not None else max_moves - 2,
                 max_moves - 2)
-    vgd = jax.vmap(lambda board: jaxgo.group_data(
-        cfg, board, with_member=needs_member(features),
-        with_zxor=cfg.enforce_superko))
+    vgd = jax.vmap(lambda s: jaxgo.group_data(
+        cfg, s.board, with_member=needs_member(features),
+        with_zxor=cfg.enforce_superko, labels=s.labels))
     enc = jax.vmap(
         lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
@@ -98,7 +98,7 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
         rec = _snapshot(hit, states, rec)
         recorded = recorded | hit
 
-        gd = vgd(states.board)
+        gd = vgd(states)
         planes = enc(states, gd)
         sens = vsens(states, gd)
         neg = jnp.finfo(jnp.float32).min
@@ -115,7 +115,7 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
                                  jnp.where(t == U, a_rand, a_rl))
         must_pass = ~sens.any(axis=-1)
         action = jnp.where(must_pass, n, board_action).astype(jnp.int32)
-        return (vstep(states, action), rec, recorded, rng), None
+        return (vstep(states, action, gd), rec, recorded, rng), None
 
     (final, rec, recorded, _), _ = lax.scan(
         ply, (states0, rec0, recorded0, rng), jnp.arange(max_moves))

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from rocalphago_tpu.engine import jaxgo, pygo
-from rocalphago_tpu.engine.jaxgo import GoConfig, GoEngine
+from rocalphago_tpu.engine.jaxgo import GoConfig, GoEngine, compute_labels
 
 
 def py_board_flat(st: pygo.GameState) -> np.ndarray:
@@ -58,6 +58,13 @@ def test_random_game_differential(size, superko):
 
             assert py_board_flat(pst).tolist() == np.asarray(
                 jst.board).tolist(), f"board diverged at move {move_i}"
+            # carried incremental labels must ALWAYS equal a fresh fill
+            # (sampled every 8th move — a divergence persists until the
+            # next capture of the affected group, so sampling catches it)
+            if move_i % 8 == 0 or pst.is_end_of_game:
+                assert np.asarray(jst.labels).tolist() == np.asarray(
+                    compute_labels(cfg, jst.board)).tolist(), (
+                    f"carried labels diverged by move {move_i}")
             pko = -1 if pst.ko is None else pst.ko[0] * size + pst.ko[1]
             assert int(jst.ko) == pko, f"ko diverged at move {move_i}"
             assert bool(jst.done) == pst.is_end_of_game
