@@ -40,14 +40,19 @@ def main() -> None:
 
     cfg = GoConfig(size=args.board)
     run = make_device_rollout(cfg, net.feature_list, net.module.apply,
-                              rollout_limit=args.rollout_limit)
+                              rollout_limit=args.rollout_limit,
+                              with_steps=True)
     states = new_states(cfg, batch)
     per_rollout = timed(
         lambda: jax.device_get(run(net.params, states, jax.random.key(1))),
         reps=args.reps, profile_dir=args.profile)
-    report("device_rollout_steps", batch * args.rollout_limit / per_rollout,
+    # the loop exits when every game ends — count the plies actually
+    # executed rather than assuming the full rollout_limit ran
+    _, executed = jax.device_get(
+        run(net.params, states, jax.random.key(1)))
+    report("device_rollout_steps", batch * int(executed) / per_rollout,
            "board-steps/s", batch=batch, board=args.board,
-           rollout_limit=args.rollout_limit)
+           rollout_limit=args.rollout_limit, executed_plies=int(executed))
 
 
 if __name__ == "__main__":

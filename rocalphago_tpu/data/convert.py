@@ -17,7 +17,6 @@ datasets) is kept for interchange.
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import sys
@@ -27,33 +26,21 @@ import numpy as np
 
 from rocalphago_tpu.data import native, sgf as sgflib
 from rocalphago_tpu.engine import pygo
-from rocalphago_tpu.engine.jaxgo import (
-    GoConfig,
-    GoState,
-    compute_labels as jaxgo_labels,
-)
+from rocalphago_tpu.engine.jaxgo import GoConfig, GoState, seed_labels
 from rocalphago_tpu.features import DEFAULT_FEATURES, Preprocess
 
 _ENCODE_BATCH = 128  # static batch for the jitted encoder (padded)
 
 
-@functools.lru_cache(maxsize=None)
-def _label_seeder(cfg: GoConfig):
-    """Jitted batched label fill, cached per config — a fresh lambda
-    per batch would re-trace and run the fill eagerly (~1000× slower
-    than the compiled program)."""
-    import jax
-
-    return jax.jit(jax.vmap(lambda bd: jaxgo_labels(cfg, bd)))
-
-
 def pack_states(cfg: GoConfig, boards, turns, kos, steps, ages) -> GoState:
     """Assemble a batched GoState from raw numpy fields (hash/history
     zeroed — converters run with superko off, so legality inside the
-    encoder never consults them)."""
+    encoder never consults them). The carried labels are seeded with
+    one compiled batched fill (:func:`jaxgo.seed_labels`)."""
     import jax.numpy as jnp
     b = len(boards)
-    return GoState(
+    n = cfg.num_points
+    state = GoState(
         board=jnp.asarray(np.asarray(boards, np.int8)),
         turn=jnp.asarray(np.asarray(turns, np.int8)),
         ko=jnp.asarray(np.asarray(kos, np.int32)),
@@ -64,11 +51,9 @@ def pack_states(cfg: GoConfig, boards, turns, kos, steps, ages) -> GoState:
         hash_history=jnp.zeros((b, cfg.max_history, 2), jnp.uint32),
         stone_ages=jnp.asarray(np.asarray(ages, np.int32)),
         prisoners=jnp.zeros((b, 2), jnp.int32),
-        # converters assemble states in bulk (no stepping), so seed the
-        # carried labeling with one batched fill here
-        labels=_label_seeder(cfg)(
-            jnp.asarray(np.asarray(boards, np.int8))),
+        labels=jnp.full((b, n), n, jnp.int32),
     )
+    return seed_labels(cfg, state)
 
 
 class GameConverter:

@@ -183,7 +183,8 @@ def new_states(cfg: GoConfig, batch: int) -> GoState:
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (batch,) + x.shape), one)
 
 
-def from_pygo(cfg: GoConfig, st, *, with_history: bool = True) -> GoState:
+def from_pygo(cfg: GoConfig, st, *, with_history: bool = True,
+              with_labels: bool = True) -> GoState:
     """Bridge a host-side :class:`pygo.GameState` into engine state.
 
     Used at the GTP/SGF boundary where positions are built move-by-move
@@ -226,20 +227,24 @@ def from_pygo(cfg: GoConfig, st, *, with_history: bool = True) -> GoState:
         passes = 2 if (len(st.history) > 1 and st.history[-2] is None) else 1
 
     # host-side min-root labeling (ascending scan ⇒ the BFS seed is the
-    # group's min flat index), seeding the engine's carried labels
+    # group's min flat index), seeding the engine's carried labels.
+    # ``with_labels=False`` skips it and leaves the field all-sentinel
+    # (INVALID — callers batching many states must reseed with one
+    # compiled fill via :func:`seed_labels` before any engine use).
     n = cfg.num_points
-    nbrs_np = _tables(cfg.size)[0]
     lab = np.full(n, n, np.int32)
-    for p in range(n):
-        if board[p] != 0 and lab[p] == n:
-            lab[p] = p
-            stack = [p]
-            while stack:
-                q = stack.pop()
-                for r in nbrs_np[q]:
-                    if r < n and board[r] == board[p] and lab[r] == n:
-                        lab[r] = p
-                        stack.append(r)
+    if with_labels:
+        nbrs_np = _tables(cfg.size)[0]
+        for p in range(n):
+            if board[p] != 0 and lab[p] == n:
+                lab[p] = p
+                stack = [p]
+                while stack:
+                    q = stack.pop()
+                    for r in nbrs_np[q]:
+                        if r < n and board[r] == board[p] and lab[r] == n:
+                            lab[r] = p
+                            stack.append(r)
     return GoState(
         board=jnp.asarray(board),
         turn=jnp.int8(st.current_player),
@@ -363,6 +368,29 @@ def relabel_after_place(cfg: GoConfig, board: jax.Array,
         same, roots, -2)[None, :]).any(axis=1)
     labels1 = jnp.where(merged, new_root, labels).at[pt].set(new_root)
     return jnp.where(cap_mask, n, labels1)
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_fill(cfg: GoConfig):
+    return jax.jit(jax.vmap(lambda bd: compute_labels(cfg, bd)))
+
+
+def seed_labels(cfg: GoConfig, states: GoState) -> GoState:
+    """Recompute the carried labels of a BATCHED state in one compiled
+    device fill. Use at host→device wave boundaries (MCTS leaf
+    conversion) together with ``from_pygo(..., with_labels=False)``:
+    one vmapped fill beats a per-state interpreted host BFS."""
+    return states._replace(labels=_batched_fill(cfg)(states.board))
+
+
+def vgroup_data(cfg: GoConfig, *, with_member: bool = False,
+                with_zxor: bool = False):
+    """vmapped ``GoState → GroupData`` using the engine's carried
+    labels — the loop-free per-ply analysis every batched game loop
+    shares (self-play, rollouts, the value-corpus generator)."""
+    return jax.vmap(lambda s: group_data(
+        cfg, s.board, with_member=with_member, with_zxor=with_zxor,
+        labels=s.labels))
 
 
 def lib_counts_from_labels(cfg: GoConfig, board: jax.Array,

@@ -39,6 +39,7 @@ from rocalphago_tpu.engine.jaxgo import (
     lib_counts_from_labels,
     neighbor_analysis,
     neighbors_for,
+    relabel_after_place,
 )
 
 # per-option ladder outcomes, ordered so the chaser minimises
@@ -93,18 +94,8 @@ def _relabel_place(cfg: GoConfig, board, labels, pt, color, cap_mask,
     ``enabled=False`` returns the inputs unchanged (vital under vmap:
     disabled lanes must not corrupt their carried analysis).
     """
-    n = cfg.num_points
-    nbrs = neighbors_for(cfg.size)
-    board_pad = jnp.concatenate([board, jnp.zeros((1,), board.dtype)])
-    lab_pad = jnp.concatenate([labels, jnp.full((1,), n, jnp.int32)])
-    my = nbrs[pt]
-    same = (my < n) & (board_pad[my] == color)
-    roots = jnp.where(same, lab_pad[my], n)
-    new_root = jnp.minimum(roots.min(), pt).astype(jnp.int32)
-    merged = (labels[:, None] == jnp.where(
-        same, roots, -2)[None, :]).any(axis=1)
-    labels1 = jnp.where(merged, new_root, labels).at[pt].set(new_root)
-    labels1 = jnp.where(cap_mask, n, labels1)
+    labels1 = relabel_after_place(cfg, board, labels, pt, color,
+                                  cap_mask)
     board1 = jnp.where(cap_mask, jnp.int8(0), board).at[pt].set(color)
     return (jnp.where(enabled, board1, board),
             jnp.where(enabled, labels1, labels))

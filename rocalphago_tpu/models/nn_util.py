@@ -150,9 +150,15 @@ class NeuralNetBase:
             return self.preprocess.state_to_tensor(states)
         if isinstance(states, pygo.GameState):
             states = [states]
+        # host BFS labeling skipped per state; one compiled batched
+        # fill reseeds the whole wave (hot path: MCTS leaf evaluation)
+        any_pygo = any(not isinstance(s, jaxgo.GoState) for s in states)
         dev = [s if isinstance(s, jaxgo.GoState)
-               else jaxgo.from_pygo(self.cfg, s) for s in states]
+               else jaxgo.from_pygo(self.cfg, s, with_labels=False)
+               for s in states]
         batched = jax.tree.map(lambda *xs: jnp.stack(xs), *dev)
+        if any_pygo:
+            batched = jaxgo.seed_labels(self.cfg, batched)
         return self.preprocess.states_to_tensor(batched)
 
     @staticmethod

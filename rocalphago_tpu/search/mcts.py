@@ -368,11 +368,13 @@ def device_rollout_fn(rollout_net, rollout_limit: int = 500,
     def batch_rollout(states):
         cfg, run = for_komi(float(states[0].komi))
         entry = [s.current_player for s in states]
-        dev = [jaxgo.from_pygo(cfg, s, with_history=False)
+        dev = [jaxgo.from_pygo(cfg, s, with_history=False,
+                               with_labels=False)
                for s in states]
         pad = max(min_batch - len(dev), 0)
         dev.extend([dev[0]] * pad)
-        batched = jax.tree.map(lambda *xs: jnp.stack(xs), *dev)
+        batched = jaxgo.seed_labels(
+            cfg, jax.tree.map(lambda *xs: jnp.stack(xs), *dev))
         key_box[0], sub = jax.random.split(key_box[0])
         winners = np.asarray(jax.device_get(
             run(rollout_net.params, batched, sub)))

@@ -39,6 +39,7 @@ from rocalphago_tpu.engine.jaxgo import (
     legal_mask,
     new_states,
     step,
+    vgroup_data,
     winner,
 )
 from rocalphago_tpu.features.planes import encode, needs_member, true_eyes
@@ -82,11 +83,8 @@ def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
         raise ValueError(
             f"batch must be even (half-and-half color split), got {batch}")
     n = cfg.num_points
-    # loop-free group analysis from the engine's carried labels — no
-    # flood fill anywhere in the per-ply path
-    vgd = jax.vmap(lambda s: group_data(
-        cfg, s.board, with_member=needs_member(features),
-        with_zxor=cfg.enforce_superko, labels=s.labels))
+    vgd = vgroup_data(cfg, with_member=needs_member(features),
+                      with_zxor=cfg.enforce_superko)
     enc = jax.vmap(
         lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
@@ -286,7 +284,8 @@ def host_winners(cfg: GoConfig, boards: np.ndarray) -> np.ndarray:
 
 def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
                         rollout_limit: int = 500,
-                        temperature: float = 1.0):
+                        temperature: float = 1.0,
+                        with_steps: bool = False):
     """Jitted ``(params, states, rng) -> winners`` rollout-to-terminal.
 
     The MCTS λ-mix's rollout leg, fully on device (SURVEY.md §3.3
@@ -298,24 +297,25 @@ def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
     winners (+1 black / -1 white / 0 draw); callers translate to the
     entry player's perspective.
 
-    Same scan skeleton as :func:`play_games`, minus the two-net color
+    Same ply body as :func:`play_games`, minus the two-net color
     split: rollouts use a single policy, so every ply is exactly one
-    full-batch forward.
+    full-batch forward. The loop is a ``while_loop`` that EXITS as
+    soon as every game in the wave has ended (two passes) — typical
+    games finish far before ``rollout_limit``, and a fixed-length
+    scan would make every rollout pay the worst case (measured 10×
+    on 9×9 with the default limit of 500).
     """
     n = cfg.num_points
-    # loop-free group analysis from the engine's carried labels — no
-    # flood fill anywhere in the per-ply path
-    vgd = jax.vmap(lambda s: group_data(
-        cfg, s.board, with_member=needs_member(features),
-        with_zxor=cfg.enforce_superko, labels=s.labels))
+    vgd = vgroup_data(cfg, with_member=needs_member(features),
+                      with_zxor=cfg.enforce_superko)
     enc = jax.vmap(lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
 
     @jax.jit
     def run(params, states: GoState, rng: jax.Array) -> jax.Array:
-        def ply(carry, _):
-            states, rng = carry
+        def ply(carry):
+            states, rng, t = carry
             rng, sub = jax.random.split(rng)
             gd = vgd(states)
             planes = enc(states, gd)
@@ -326,10 +326,17 @@ def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
             action = jax.random.categorical(sub, masked, axis=-1)
             must_pass = ~sens.any(axis=-1)
             action = jnp.where(must_pass, n, action).astype(jnp.int32)
-            return (vstep(states, action, gd), rng), None
+            return vstep(states, action, gd), rng, t + 1
 
-        (final, _), _ = lax.scan(ply, (states, rng), None,
-                                 length=rollout_limit)
-        return jax.vmap(functools.partial(winner, cfg))(final)
+        def cond(carry):
+            states, _, t = carry
+            return ~states.done.all() & (t < rollout_limit)
+
+        final, _, t = lax.while_loop(cond, ply,
+                                     (states, rng, jnp.int32(0)))
+        winners = jax.vmap(functools.partial(winner, cfg))(final)
+        # with_steps: also report the executed ply count (benchmarks
+        # must not assume the loop ran to rollout_limit)
+        return (winners, t) if with_steps else winners
 
     return run

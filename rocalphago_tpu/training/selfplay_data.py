@@ -73,9 +73,8 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
     n = cfg.num_points
     u_cap = min(u_max if u_max is not None else max_moves - 2,
                 max_moves - 2)
-    vgd = jax.vmap(lambda s: jaxgo.group_data(
-        cfg, s.board, with_member=needs_member(features),
-        with_zxor=cfg.enforce_superko, labels=s.labels))
+    vgd = jaxgo.vgroup_data(cfg, with_member=needs_member(features),
+                            with_zxor=cfg.enforce_superko)
     enc = jax.vmap(
         lambda s, g: encode(cfg, s, features=features, gd=g))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
