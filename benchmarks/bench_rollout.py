@@ -43,13 +43,18 @@ def main() -> None:
                               rollout_limit=args.rollout_limit,
                               with_steps=True)
     states = new_states(cfg, batch)
-    per_rollout = timed(
-        lambda: jax.device_get(run(net.params, states, jax.random.key(1))),
-        reps=args.reps, profile_dir=args.profile)
-    # the loop exits when every game ends — count the plies actually
-    # executed rather than assuming the full rollout_limit ran
-    _, executed = jax.device_get(
-        run(net.params, states, jax.random.key(1)))
+    # the loop exits when every game ends — record the plies actually
+    # executed (deterministic across reps) instead of assuming the
+    # full rollout_limit ran
+    box = []
+
+    def once():
+        out = jax.device_get(run(net.params, states, jax.random.key(1)))
+        box.append(out[1])
+        return out
+
+    per_rollout = timed(once, reps=args.reps, profile_dir=args.profile)
+    executed = box[-1]
     report("device_rollout_steps", batch * int(executed) / per_rollout,
            "board-steps/s", batch=batch, board=args.board,
            rollout_limit=args.rollout_limit, executed_plies=int(executed))

@@ -442,7 +442,7 @@ def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
 
 def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
                          legal, depth: int = 40, lanes: int = 16,
-                         chase_slots: int = 8) -> jax.Array:
+                         chase_slots: int = 4) -> jax.Array:
     """bool [N]: legal moves that ladder-capture an adjacent two-liberty
     opponent group."""
     n = cfg.num_points
@@ -479,7 +479,7 @@ def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
 
 def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
                         legal, depth: int = 40, lanes: int = 16,
-                        chase_slots: int = 8) -> jax.Array:
+                        chase_slots: int = 4) -> jax.Array:
     """bool [N]: legal moves that rescue an own group in atari from a
     ladder (extension at its last liberty that survives the read)."""
     n = cfg.num_points

@@ -286,7 +286,9 @@ def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
                         rollout_limit: int = 500,
                         temperature: float = 1.0,
                         with_steps: bool = False):
-    """Jitted ``(params, states, rng) -> winners`` rollout-to-terminal.
+    """Jitted ``(params, states, rng) -> winners`` rollout-to-terminal
+    (``with_steps=True``: ``-> (winners, executed_plies)`` — benchmarks
+    must not assume the early-exit loop ran to ``rollout_limit``).
 
     The MCTS λ-mix's rollout leg, fully on device (SURVEY.md §3.3
     rebuild note): play a *batched* :class:`GoState` — e.g. a wave of
