@@ -61,30 +61,70 @@ def _measure() -> None:
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    # TPU sizing (both measured on the attached v5e chip):
-    # - chunked segments, because the tunnel's worker crashes past
-    #   ~40s of device execution — 60 plies at batch 16 ≈ 13s/segment;
-    # - batch 16, because per-ply cost scales SUPERLINEARLY with batch
-    #   (the vmap'd fixpoint while_loops stall on the slowest board:
-    #   0.22 s/ply at batch 16 vs 1.6 s/ply at batch 64), so games/min
-    #   peaks at small batch on one chip.
-    # CPU numbers are a liveness fallback, not the perf story — keep
-    # the program small enough that compile + one rep fits the attempt
-    # timeout comfortably.
-    batch = 16 if on_tpu else 8
-    max_moves = 300 if on_tpu else 40
-    chunk = 60 if on_tpu else 40
+    max_moves = int(os.environ.get(
+        "_GRAFT_BENCH_MAX_MOVES", "300" if on_tpu else "40"))
 
     cfg = GoConfig(size=19)
     net = CNNPolicy(board=19, layers=12, filters_per_layer=128)
 
-    # terminal scoring happens on host: it shaves the whole-board
-    # region labeling off the compiled program (smaller graph for the
-    # experimental backend to chew), and costs microseconds per game
-    run = make_selfplay_chunked(
-        cfg, net.feature_list, net.module.apply, net.module.apply,
-        batch, max_moves, chunk=chunk, temperature=1.0,
-        score_on_device=False)
+    def make(batch, chunk, mm=None):
+        # terminal scoring happens on host: it shaves the whole-board
+        # region labeling off the compiled program (smaller graph for
+        # the experimental backend), and costs microseconds per game
+        return make_selfplay_chunked(
+            cfg, net.feature_list, net.module.apply, net.module.apply,
+            batch, mm or max_moves, chunk=chunk, temperature=1.0,
+            score_on_device=False)
+
+    if on_tpu or os.environ.get("_GRAFT_BENCH_FORCE_ADAPTIVE") == "1":
+        # ADAPTIVE sizing: the tunnel's worker crashes past ~40s of
+        # device execution, and per-ply cost per batch size moves with
+        # every engine/encoder optimization — so probe instead of
+        # hard-coding. Crucially the probe runs from MID-GAME states —
+        # opening boards are near-uniform and hide the vmap'd fixpoint
+        # stalls that historically made small batches win.
+        # Seed 64 DIVERSE mid-game games at watchdog-safe chunk 10
+        # (≈16s/segment at the worst historical per-ply cost); each
+        # candidate probe then runs the REAL two-net program (a fixed
+        # 10-ply segment — no early exit, so t/10 is exact) from a
+        # slice of those seeds. Slicing (not tiling) keeps the
+        # slowest-board tail realistic: the vmap'd fixpoint loops
+        # stall on the slowest board, and duplicated boards would
+        # fake away exactly that cost.
+        seed_plies = int(os.environ.get("_GRAFT_BENCH_SEED_PLIES",
+                                        "80"))
+        seed = make(64, 10, mm=seed_plies)
+        mid64 = seed(net.params, net.params, jax.random.key(0)).final
+        jax.device_get(mid64.board)
+        best = None
+        for cand in (64, 16):
+            states_c = jax.tree.map(lambda x: x[:cand], mid64)
+            probe = make(cand, 10, mm=10)   # the real program, 1 segment
+            jax.device_get(probe(
+                net.params, net.params, jax.random.key(0),
+                initial_states=states_c).final.board)  # compile+warm
+            t0 = time.time()
+            jax.device_get(probe(
+                net.params, net.params, jax.random.key(1),
+                initial_states=states_c).final.board)
+            t10 = time.time() - t0          # one compiled 10-ply run
+            rate = cand / max(t10, 1e-6)    # board-plies per second
+            print(f"bench probe: batch {cand} mid-game: "
+                  f"{t10:.1f}s / 10 plies", file=sys.stderr)
+            if best is None or rate > best[1]:
+                best = (cand, rate, t10)
+        batch, _, t10 = best
+        per_ply = t10 / 10.0
+        # target ≤20s per segment — a 2× margin under the ~40s
+        # watchdog for late-game plies costing more than the probe's
+        chunk = max(5, min(100, int(20.0 / max(per_ply, 1e-3))))
+    else:
+        # CPU numbers are a liveness fallback, not the perf story —
+        # keep the program small enough that compile + one rep fits
+        # the attempt timeout comfortably
+        batch, chunk = 8, 40
+
+    run = make(batch, chunk)
 
     def one(r):
         res = run(net.params, net.params, jax.random.key(r))

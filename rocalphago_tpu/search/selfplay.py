@@ -237,8 +237,13 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
     finish = jax.jit(functools.partial(
         _finish, cfg, score_on_device=score_on_device, batch=batch))
 
-    def run(params_a, params_b, rng) -> SelfplayResult:
-        states = new_states(cfg, batch)
+    def run(params_a, params_b, rng,
+            initial_states: GoState | None = None) -> SelfplayResult:
+        """``initial_states`` (batched, defaults to fresh games) lets
+        callers continue play from arbitrary positions — e.g. the
+        benchmark's mid-game probe segments."""
+        states = (new_states(cfg, batch) if initial_states is None
+                  else initial_states)
         if mesh is not None:
             states = meshlib.shard_batch(mesh, states)
             params_a = meshlib.replicate(mesh, params_a)

@@ -1,0 +1,37 @@
+"""The headline benchmark's adaptive TPU sizing path, exercised on CPU.
+
+Round-1 postmortem: bench.py failures are invisible until the driver's
+round-end run on real hardware, so the risky code path — the mid-game
+probe that picks batch/chunk — must be covered off-chip. The
+``_GRAFT_BENCH_FORCE_ADAPTIVE`` hook runs it on the CPU backend with
+shrunken workloads.
+"""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
+    monkeypatch.setenv("_GRAFT_BENCH_FORCE_ADAPTIVE", "1")
+    monkeypatch.setenv("_GRAFT_BENCH_MAX_MOVES", "12")
+    monkeypatch.setenv("_GRAFT_BENCH_SEED_PLIES", "12")
+    monkeypatch.syspath_prepend(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench._measure()
+    lines = [ln for ln in out.getvalue().splitlines() if ln.strip()]
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == bench.METRIC
+    assert rec["unit"] == "games/min"
+    assert rec["value"] > 0
+    assert rec["batch"] in (16, 64)       # a probed candidate won
+    assert 5 <= rec["chunk"] <= 100       # sized within the clamp
+    assert rec["max_moves"] == 12
