@@ -73,6 +73,9 @@ def harvest_chase_lanes(size: int, lanes: int | None, seed: int,
         lib_counts_from_labels,
     )
 
+    if lanes is None and positions is None:
+        raise ValueError("pass lanes and/or positions — with neither "
+                         "bound the harvest would loop forever")
     cfg = GoConfig(size=size)
     rng = np.random.default_rng(seed)
     boards, labels, preys = [], [], []

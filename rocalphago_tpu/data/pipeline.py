@@ -15,8 +15,10 @@ reads + per-sample numpy transforms on host; SURVEY.md §3.1 HOT) with:
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
+import zipfile
 
 import numpy as np
 
@@ -93,7 +95,12 @@ def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
         try:
             z = np.load(path)
             tr, va, te = z["train"], z["val"], z["test"]
-        except (OSError, KeyError):
+        except (OSError, KeyError, ValueError, zipfile.BadZipFile):
+            # BadZipFile/ValueError: a torn read of a file another
+            # process is mid-writing (the writer renames atomically,
+            # but NFS-style filesystems can still surface partial
+            # views) — fall through and recompute; the permutation is
+            # a pure function of the seed, so every process agrees
             tr = None
         if tr is not None:
             total = len(tr) + len(va) + len(te)
@@ -112,7 +119,12 @@ def split_indices(n: int, fractions=(0.93, 0.05, 0.02), seed: int = 0,
     val = perm[n_train:n_train + n_val]
     test = perm[n_train + n_val:]
     if path is not None and write:
-        np.savez(path, train=train, val=val, test=test)
+        # atomic write: non-coordinator processes read this file
+        # concurrently in multi-host runs (.npz suffix on the temp
+        # name stops np.savez appending another one)
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, train=train, val=val, test=test)
+        os.replace(tmp, path)
     return train, val, test
 
 

@@ -219,8 +219,13 @@ class NeuralNetBase:
         parent = os.path.dirname(weights_file)
         if parent:
             os.makedirs(parent, exist_ok=True)
-        with open(weights_file, "wb") as f:
+        # atomic tmp+rename: concurrent readers (multi-host opponent
+        # pools waiting on snapshot visibility) must never see a
+        # half-written msgpack
+        tmp = weights_file + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(serialization.to_bytes(self.params))
+        os.replace(tmp, weights_file)
 
     def load_weights(self, weights_file: str):
         with open(weights_file, "rb") as f:

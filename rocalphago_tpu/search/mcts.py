@@ -372,7 +372,10 @@ def device_rollout_fn(rollout_net, rollout_limit: int = 500,
                                with_labels=False)
                for s in states]
         pad = max(min_batch - len(dev), 0)
-        dev.extend([dev[0]] * pad)
+        # pad with DONE copies: the rollout while_loop exits when every
+        # lane ends, so live padding would cost full wasted rollouts
+        done_pad = dev[0]._replace(done=jnp.bool_(True))
+        dev.extend([done_pad] * pad)
         batched = jaxgo.seed_labels(
             cfg, jax.tree.map(lambda *xs: jnp.stack(xs), *dev))
         key_box[0], sub = jax.random.split(key_box[0])

@@ -218,9 +218,13 @@ class OpponentPool:
             while not os.path.exists(path):
                 if time.time() >= deadline:
                     raise FileNotFoundError(
-                        f"opponent snapshot {path} not visible "
-                        "(multi-host: the coordinator writes them; a "
-                        "shared filesystem is required)")
+                        f"opponent snapshot {path} not visible. "
+                        "Multi-host: the coordinator writes snapshots; "
+                        "a shared filesystem is required. Resumed run: "
+                        "--save-every must match the value the out_dir "
+                        "was populated with (the candidate set is "
+                        "reconstructed from the save schedule, not the "
+                        "directory listing, so every host agrees)")
                 time.sleep(0.5)
         else:
             paths = self.snapshots()
