@@ -100,6 +100,10 @@ def harvest_chase_lanes(size: int, lanes: int | None, seed: int,
                 preys.append(int(root))
         if positions is None and lanes is not None and pos > lanes * 20:
             break   # safety: pathological seed with no 2-lib groups
+    if not boards:
+        raise ValueError(
+            f"no chase entries found in {pos} random position(s) — "
+            "increase positions/moves or change the seed")
     return (np.stack(boards), np.stack(labels),
             np.asarray(preys, np.int32))
 

@@ -60,13 +60,15 @@ class GameConverter:
     """Replay SGF games and emit (encoded state, expert action) pairs."""
 
     def __init__(self, feature_list=DEFAULT_FEATURES, board_size: int = 19,
-                 ladder_depth: int = 40, ladder_lanes: int = 16):
+                 ladder_depth: int = 40, ladder_lanes: int = 16,
+                 ladder_chase_slots: int = 4):
         self.board_size = board_size
         self.cfg = GoConfig(size=board_size, enforce_superko=False,
                             max_history=8)
         self.pre = Preprocess(feature_list, cfg=self.cfg,
                               ladder_depth=ladder_depth,
-                              ladder_lanes=ladder_lanes)
+                              ladder_lanes=ladder_lanes,
+                              ladder_chase_slots=ladder_chase_slots)
         self.feature_list = tuple(feature_list)
 
     # ------------------------------------------------------------ encoding

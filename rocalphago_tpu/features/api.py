@@ -34,7 +34,8 @@ class Preprocess:
 
     def __init__(self, feature_list=DEFAULT_FEATURES,
                  cfg: GoConfig = GoConfig(),
-                 ladder_depth: int = 40, ladder_lanes: int = 16):
+                 ladder_depth: int = 40, ladder_lanes: int = 16,
+                 ladder_chase_slots: int = 4):
         unknown = [f for f in feature_list if f not in FEATURE_PLANES]
         if unknown:
             raise KeyError(f"unknown features: {unknown}")
@@ -45,7 +46,8 @@ class Preprocess:
         self.output_dim = output_planes(self.feature_list)
         fn = functools.partial(
             encode, cfg, features=self.feature_list,
-            ladder_depth=ladder_depth, ladder_lanes=ladder_lanes)
+            ladder_depth=ladder_depth, ladder_lanes=ladder_lanes,
+            ladder_chase_slots=ladder_chase_slots)
         self._one = jax.jit(fn)
         self._batch = jax.jit(jax.vmap(fn))
 

@@ -122,6 +122,7 @@ def encode(cfg: GoConfig, state: GoState,
            features: tuple = None,
            ladder_depth: int = 40,
            ladder_lanes: int = 16,
+           ladder_chase_slots: int = 4,
            gd: "GroupData | None" = None) -> jax.Array:
     """Encode one game state → float32 ``[size, size, F]`` (NHWC).
 
@@ -178,12 +179,12 @@ def encode(cfg: GoConfig, state: GoState,
         elif name == "ladder_capture":
             cap = _ladders.ladder_capture_plane(
                 cfg, state, gd, legal, depth=ladder_depth,
-                lanes=ladder_lanes)
+                lanes=ladder_lanes, chase_slots=ladder_chase_slots)
             f = cap.astype(jnp.float32)[:, None]
         elif name == "ladder_escape":
             esc = _ladders.ladder_escape_plane(
                 cfg, state, gd, legal, depth=ladder_depth,
-                lanes=ladder_lanes)
+                lanes=ladder_lanes, chase_slots=ladder_chase_slots)
             f = esc.astype(jnp.float32)[:, None]
         elif name == "sensibleness":
             f = (legal & ~true_eyes(cfg, state, me)).astype(
