@@ -12,12 +12,79 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import jax
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compile cache (same knob bench.py uses): cost-
+    analysis AOT compiles and the jit dispatch path then share one
+    compile per program instead of paying the 20-40s TPU compile
+    twice. Called from :func:`std_parser` (i.e. benchmark entry
+    points only) — NOT at import time, because the test suite imports
+    this module for :func:`harvest_chase_lanes` and must keep its own
+    cache configuration."""
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/jax_comp_cache"))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:  # noqa: BLE001 — older jax without the knobs
+        pass
+
+
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets);
+# used for MFU = achieved flops/s ÷ peak. The attached tunnel is v5e.
+_TPU_BF16_PEAK = {"v5e": 197e12, "v5litepod": 197e12,
+                  "v4": 275e12, "v5p": 459e12, "v6e": 918e12}
+
+
+def bf16_peak_flops() -> float | None:
+    """Peak bf16 FLOP/s of the attached chip, or None off-TPU (an MFU
+    against a host CPU "peak" would be meaningless)."""
+    if jax.devices()[0].platform != "tpu":
+        return None
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, peak in _TPU_BF16_PEAK.items():
+        if key in (gen or jax.devices()[0].device_kind.lower()):
+            return peak
+    return _TPU_BF16_PEAK["v5e"]   # attached tunnel default
+
+
+def program_flops(jitted_fn, *args, **kwargs) -> float | None:
+    """FLOPs XLA's cost analysis attributes to one call of the jitted
+    program (``lower().compile().cost_analysis()["flops"]``) — the
+    numerator of every MFU line in BENCH_RESULTS.md. None when the
+    backend doesn't report it.
+
+    SPMD note: for a program sharded over n devices this is the
+    PER-DEVICE module's flops. ``mfu(flops / dt)`` is therefore the
+    per-chip utilization as-is, but per-item normalizations must use
+    the per-device item count (global batch ÷ n devices)."""
+    try:
+        analysis = jitted_fn.lower(*args, **kwargs).compile() \
+            .cost_analysis()
+        if isinstance(analysis, (list, tuple)):   # older jax returns
+            analysis = analysis[0]                # one dict per device
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return None
+
+
+def mfu(flops_per_sec: float | None) -> float | None:
+    """Model FLOPs utilization vs the chip's bf16 peak (None off-TPU
+    or when flops are unknown)."""
+    peak = bf16_peak_flops()
+    if peak is None or not flops_per_sec:
+        return None
+    return flops_per_sec / peak
+
 
 def std_parser(description: str) -> argparse.ArgumentParser:
+    enable_compile_cache()
     ap = argparse.ArgumentParser(description=description)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--board", type=int, default=19)
@@ -45,11 +112,30 @@ def timed(fn, reps: int = 3, profile_dir: str | None = None) -> float:
 
 def report(metric: str, value: float, unit: str,
            baseline: float | None = None, **extra) -> None:
+    """Print the one-line JSON result AND append it (with platform +
+    timestamp) to the machine-readable log ``benchmarks/results.jsonl``
+    (override with ``$ROCALPHAGO_BENCH_LOG``; empty disables) so perf
+    history is greppable instead of living only in BENCH_RESULTS.md
+    prose (VERDICT r2 weak #3)."""
     line = {"metric": metric, "value": round(value, 2), "unit": unit}
     if baseline is not None:
         line["vs_baseline"] = round(value / max(baseline, 1e-12), 3)
     line.update(extra)
     print(json.dumps(line))
+
+    log = os.environ.get(
+        "ROCALPHAGO_BENCH_LOG",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "benchmarks", "results.jsonl"))
+    if not log:
+        return
+    try:
+        rec = dict(line, platform=jax.devices()[0].platform,
+                   date=time.strftime("%Y-%m-%dT%H:%M:%S"))
+        with open(log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception:  # noqa: BLE001 — logging must never fail a bench
+        pass
 
 
 def harvest_chase_lanes(size: int, lanes: int | None, seed: int,

@@ -26,17 +26,31 @@ def main() -> None:
 
     ap = std_parser(__doc__)
     ap.add_argument("--moves", type=int, default=None)
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="plies per compiled segment (0 = monolithic "
+                         "program; default 10 on TPU — the monolithic "
+                         "iteration is the one program that crashed "
+                         "the tunnel's ~40s watchdog in round 2)")
     args = ap.parse_args()
     on_tpu = jax.devices()[0].platform == "tpu"
     batch = args.batch or (64 if on_tpu else 8)
     moves = args.moves or (400 if on_tpu else 40)
+    chunk = args.chunk if args.chunk is not None else (
+        10 if on_tpu else 0)
 
     net = CNNPolicy(board=args.board, layers=12, filters_per_layer=128)
     mesh = meshlib.make_mesh()
     tx = optax.sgd(0.001)
-    iteration = jax.jit(make_rl_iteration(
-        net.cfg, net.feature_list, net.module.apply, tx, batch, moves,
-        temperature=0.67, mesh=mesh))
+    if chunk:
+        from rocalphago_tpu.training.rl import make_rl_iteration_chunked
+
+        iteration = make_rl_iteration_chunked(
+            net.cfg, net.feature_list, net.module.apply, tx, batch,
+            moves, temperature=0.67, chunk=chunk, mesh=mesh)
+    else:
+        iteration = jax.jit(make_rl_iteration(
+            net.cfg, net.feature_list, net.module.apply, tx, batch,
+            moves, temperature=0.67, mesh=mesh))
     state = meshlib.replicate(mesh, RLState(
         params=net.params, opt_state=tx.init(net.params),
         iteration=jnp.int32(0), rng=pack_rng(jax.random.key(0))))
@@ -49,7 +63,7 @@ def main() -> None:
 
     dt = timed(once, reps=args.reps, profile_dir=args.profile)
     report("rl_iteration", batch / dt * 60.0, "games/min",
-           batch=batch, moves=moves, board=args.board,
+           batch=batch, moves=moves, board=args.board, chunk=chunk,
            devices=mesh.shape[meshlib.DATA_AXIS])
 
 

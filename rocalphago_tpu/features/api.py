@@ -30,6 +30,23 @@ class Preprocess:
     ``feature_list`` entries name plane groups (see
     ``pyfeatures.FEATURE_PLANES``); the full default set is the 48-plane
     AlphaGo encoding.
+
+    Ladder-plane capacity knobs (all static under jit):
+
+    - ``ladder_depth``: max chase rungs read per ladder (default 40 —
+      enough to cross a 19×19 board twice).
+    - ``ladder_lanes``: max candidate (move, prey) pairs examined per
+      plane (default 16).
+    - ``ladder_chase_slots``: max ladder chases actually *run* per
+      plane (default 4). Chases beyond capacity are SILENTLY dropped
+      in board row-major candidate order and their cells read the
+      conservative ``False`` (a truncated read never asserts a
+      capture or an escape). Real positions essentially never hold
+      >4 simultaneous live chases per color (randomized differential
+      bound: <0.3% of cells; ``tests/test_features.py``), but dense
+      whole-board ladder problems can — raise this (e.g. to 16) when
+      encoding such positions; cost is roughly linear in the chase
+      loop's width.
     """
 
     def __init__(self, feature_list=DEFAULT_FEATURES,

@@ -54,7 +54,11 @@ from rocalphago_tpu.io.checkpoint import (
 from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
 from rocalphago_tpu.parallel import mesh as meshlib
-from rocalphago_tpu.search.selfplay import play_games, sensible_mask
+from rocalphago_tpu.search.selfplay import (
+    make_selfplay_chunked,
+    play_games,
+    sensible_mask,
+)
 from rocalphago_tpu.features.planes import encode
 
 
@@ -72,6 +76,8 @@ class RLConfig:
     move_limit: int = 500
     seed: int = 0
     num_devices: int | None = None
+    chunk: int = 0    # >0: plies per compiled segment (watchdog-safe
+    #                   chunked iteration; 0 = one monolithic program)
 
 
 class RLState(NamedTuple):
@@ -79,6 +85,74 @@ class RLState(NamedTuple):
     opt_state: tuple
     iteration: jax.Array  # int32 []
     rng: jax.Array        # uint32 key data
+
+
+def _make_replay_ply(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
+                     batch: int, temperature: float):
+    """Shared REINFORCE replay body: one ply of re-stepping the
+    recorded game while accumulating the z-weighted policy gradient
+    into a params-shaped carry. Used by both the monolithic iteration
+    (one scan) and the chunked iteration (host-driven segments)."""
+    n = cfg.num_points
+    half = batch // 2
+    enc = jax.vmap(functools.partial(encode, cfg, features=features))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
+
+    def ply(params, z, carry, xs):
+        states, grads = carry
+        t, actions_t, live_t = xs
+        # the learner moves games [0:half] on even plies and games
+        # [half:batch] on odd plies (selfplay color split)
+        start = jnp.where((t % 2) == 0, 0, half)
+        take = lambda a: lax.dynamic_slice_in_dim(a, start, half)  # noqa: E731
+        half_states = jax.tree.map(take, states)
+        planes = enc(half_states)
+        sens = vsens(half_states)
+        acts = take(actions_t)
+        w = (take(z) * take(live_t)
+             * (acts < n).astype(jnp.float32))
+
+        def loss_fn(p):
+            logits = apply_fn(p, planes)
+            neg = jnp.finfo(logits.dtype).min
+            masked = jnp.where(sens, logits / temperature, neg)
+            logp = jax.nn.log_softmax(masked, axis=-1)
+            lp = jnp.take_along_axis(
+                logp, jnp.minimum(acts, n - 1)[:, None], axis=1)[:, 0]
+            return -(w * lp).sum() / batch
+
+        grads = jax.tree.map(jnp.add, grads, jax.grad(loss_fn)(params))
+        return (vstep(states, actions_t), grads)
+
+    return ply
+
+
+def _learner_z(winners: jax.Array, half: int) -> jax.Array:
+    """Outcome from the LEARNER's perspective: the learner (net A) is
+    Black in games [0:half], White in the rest."""
+    w = winners.astype(jnp.float32)
+    return jnp.concatenate([w[:half], -w[half:]])
+
+
+def _update_and_metrics(tx, state: RLState, grads, z, num_moves, key):
+    """Shared SGD apply + metrics assembly for both iterations."""
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    # win rate over DECIDED games (draws excluded, reported
+    # separately) — counting draws as losses biases the learner
+    # win-rate low on integer-komi configs
+    wins = (z > 0).sum()
+    decided = (z != 0).sum()
+    metrics = {
+        "win_rate": jnp.where(decided > 0,
+                              wins / jnp.maximum(decided, 1), 0.5),
+        "draw_rate": (z == 0).mean(),
+        "mean_moves": num_moves.astype(jnp.float32).mean(),
+    }
+    new = RLState(params, opt_state, state.iteration + 1,
+                  pack_rng(key))
+    return new, metrics
 
 
 def make_rl_iteration(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
@@ -89,11 +163,9 @@ def make_rl_iteration(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
     policy gradient by replay, apply one SGD update."""
     if batch % 2:
         raise ValueError(f"game_batch must be even, got {batch}")
-    n = cfg.num_points
     half = batch // 2
-    enc = jax.vmap(functools.partial(encode, cfg, features=features))
-    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
-    vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
+    replay_ply = _make_replay_ply(cfg, features, apply_fn, batch,
+                                  temperature)
 
     def iteration(state: RLState, opp_params):
         key = unpack_rng(state.rng)
@@ -103,35 +175,7 @@ def make_rl_iteration(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
         result = play_games(cfg, features, apply_fn, params, apply_fn,
                             opp_params, game_key, batch, move_limit,
                             temperature)
-        winners = result.winners.astype(jnp.float32)
-        # learner (net A) is Black in games [0:half], White in the rest
-        z = jnp.concatenate([winners[:half], -winners[half:]])
-
-        def ply(carry, xs):
-            states, grads = carry
-            t, actions_t, live_t = xs
-            # the learner moves games [0:half] on even plies and games
-            # [half:batch] on odd plies (selfplay color split)
-            start = jnp.where((t % 2) == 0, 0, half)
-            take = lambda a: lax.dynamic_slice_in_dim(a, start, half)  # noqa: E731
-            half_states = jax.tree.map(take, states)
-            planes = enc(half_states)
-            sens = vsens(half_states)
-            acts = take(actions_t)
-            w = (take(z) * take(live_t)
-                 * (acts < n).astype(jnp.float32))
-
-            def loss_fn(p):
-                logits = apply_fn(p, planes)
-                neg = jnp.finfo(logits.dtype).min
-                masked = jnp.where(sens, logits / temperature, neg)
-                logp = jax.nn.log_softmax(masked, axis=-1)
-                lp = jnp.take_along_axis(
-                    logp, jnp.minimum(acts, n - 1)[:, None], axis=1)[:, 0]
-                return -(w * lp).sum() / batch
-
-            grads = jax.tree.map(jnp.add, grads, jax.grad(loss_fn)(params))
-            return (vstep(states, actions_t), grads), None
+        z = _learner_z(result.winners, half)
 
         states0 = jaxgo.new_states(cfg, batch)
         if mesh is not None:
@@ -139,26 +183,82 @@ def make_rl_iteration(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
                 states0, meshlib.data_sharding(mesh))
         zero = jax.tree.map(jnp.zeros_like, params)
         (_, grads), _ = lax.scan(
-            ply, (states0, zero),
+            lambda c, xs: (replay_ply(params, z, c, xs), None),
+            (states0, zero),
             (jnp.arange(result.actions.shape[0]), result.actions,
              result.live.astype(jnp.float32)))
 
-        updates, opt_state = tx.update(grads, state.opt_state, params)
-        params = optax.apply_updates(params, updates)
-        # win rate over DECIDED games (draws excluded, reported
-        # separately) — counting draws as losses biases the learner
-        # win-rate low on integer-komi configs
-        wins = (z > 0).sum()
-        decided = (z != 0).sum()
-        metrics = {
-            "win_rate": jnp.where(decided > 0,
-                                  wins / jnp.maximum(decided, 1), 0.5),
-            "draw_rate": (z == 0).mean(),
-            "mean_moves": result.num_moves.astype(jnp.float32).mean(),
-        }
-        new = RLState(params, opt_state, state.iteration + 1,
-                      pack_rng(key))
-        return new, metrics
+        return _update_and_metrics(tx, state, grads, z,
+                                   result.num_moves, key)
+
+    return iteration
+
+
+def make_rl_iteration_chunked(cfg: jaxgo.GoConfig, features: tuple,
+                              apply_fn, tx, batch: int, move_limit: int,
+                              temperature: float, chunk: int,
+                              mesh=None):
+    """Chunked ``(RLState, opp_params) -> (RLState, metrics)`` — the
+    same REINFORCE iteration as :func:`make_rl_iteration`, but no
+    single device program runs longer than one ``chunk``-ply segment.
+
+    Why: the attached TPU tunnel's worker kills device programs past
+    ~40s of execution, and the monolithic iteration (a full
+    ``move_limit``-ply game scan PLUS an equally long replay scan with
+    backward passes, in ONE program) is far past that for real
+    configs — it was the one component benchmark that crashed the
+    worker in round 2 (BENCH_RESULTS.md "worker-crash status"). Here
+    the game phase reuses :func:`make_selfplay_chunked` (host-driven
+    segments, device-resident states) and the replay+gradient phase is
+    its own segmented scan with the (states, grads) carry device-
+    resident between segments. The math is IDENTICAL to the monolithic
+    iteration — same per-ply op order, same gradient accumulation
+    order, same rng split chain — verified bit-identical in
+    ``tests/test_rl_trainer.py``.
+    """
+    if batch % 2:
+        raise ValueError(f"game_batch must be even, got {batch}")
+    half = batch // 2
+    runner = make_selfplay_chunked(
+        cfg, features, apply_fn, apply_fn, batch, move_limit,
+        chunk=chunk, temperature=temperature, mesh=mesh)
+    replay_ply = _make_replay_ply(cfg, features, apply_fn, batch,
+                                  temperature)
+
+    @functools.partial(jax.jit, static_argnames=("length",))
+    def replay_segment(params, z, states, grads, actions, live,
+                       offset, length):
+        (states, grads), _ = lax.scan(
+            lambda c, xs: (replay_ply(params, z, c, xs), None),
+            (states, grads),
+            (offset + jnp.arange(length), actions, live))
+        return states, grads
+
+    update = jax.jit(functools.partial(_update_and_metrics, tx))
+
+    def iteration(state: RLState, opp_params):
+        key = unpack_rng(state.rng)
+        key, game_key = jax.random.split(key)
+        params = state.params
+
+        result = runner(params, opp_params, game_key)
+        z = _learner_z(result.winners, half)
+
+        states = jaxgo.new_states(cfg, batch)
+        if mesh is not None:
+            states = meshlib.shard_batch(mesh, states)
+        grads = jax.tree.map(jnp.zeros_like, params)
+        live = result.live.astype(jnp.float32)
+        plies = result.actions.shape[0]
+        for offset in range(0, plies, chunk):
+            length = min(chunk, plies - offset)
+            states, grads = replay_segment(
+                params, z, states, grads,
+                result.actions[offset:offset + length],
+                live[offset:offset + length],
+                jnp.int32(offset), length)
+
+        return update(state, grads, z, result.num_moves, key)
 
     return iteration
 
@@ -248,12 +348,21 @@ class RLTrainer:
 
         tx = optax.sgd(cfg.learning_rate)
         rep = meshlib.replicated(self.mesh)
-        iteration = make_rl_iteration(
-            self.net.cfg, self.net.feature_list, self.net.module.apply,
-            tx, cfg.game_batch, cfg.move_limit, cfg.policy_temp,
-            mesh=self.mesh)
-        self._iteration = jax.jit(iteration, donate_argnums=(0,),
-                                  out_shardings=(rep, rep))
+        if cfg.chunk:
+            # host-driven segmented iteration (not itself jittable —
+            # its internal segment programs are the jit units)
+            self._iteration = make_rl_iteration_chunked(
+                self.net.cfg, self.net.feature_list,
+                self.net.module.apply, tx, cfg.game_batch,
+                cfg.move_limit, cfg.policy_temp, chunk=cfg.chunk,
+                mesh=self.mesh)
+        else:
+            iteration = make_rl_iteration(
+                self.net.cfg, self.net.feature_list,
+                self.net.module.apply, tx, cfg.game_batch,
+                cfg.move_limit, cfg.policy_temp, mesh=self.mesh)
+            self._iteration = jax.jit(iteration, donate_argnums=(0,),
+                                      out_shardings=(rep, rep))
 
         self.state = meshlib.replicate(self.mesh, RLState(
             params=self.net.params,
@@ -341,13 +450,17 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--move-limit", type=int, default=500)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--num-devices", type=int, default=None)
+    ap.add_argument("--chunk", type=int, default=0,
+                    help="plies per compiled segment (0 = monolithic; "
+                         "use e.g. 10-60 on backends that kill long "
+                         "device programs)")
     a = ap.parse_args(argv)
     cfg = RLConfig(
         model_json=a.model_json, out_dir=a.out_dir,
         learning_rate=a.learning_rate, game_batch=a.game_batch,
         iterations=a.iterations, save_every=a.save_every,
         policy_temp=a.policy_temp, move_limit=a.move_limit,
-        seed=a.seed, num_devices=a.num_devices)
+        seed=a.seed, num_devices=a.num_devices, chunk=a.chunk)
     return RLTrainer(cfg).run()
 
 

@@ -1,0 +1,61 @@
+"""Kill-safe TPU tunnel health probe.
+
+Prints one status line per phase so a supervising process can tell
+exactly where the tunnel stands without ever needing to kill a client
+mid-device-program (the round-2 wedge trigger):
+
+- ``phase=import`` / ``phase=devices`` — backend startup progress;
+- if startup exceeds ``--startup-limit`` the probe EXITS rc=3 without
+  dispatching anything (kill-safe: nothing in flight);
+- ``phase=dispatch`` — a 256×256 matmul is about to run (µs on a
+  healthy chip; if the probe hangs *after* this line the tunnel is
+  wedged, and killing this client cannot make it worse);
+- final JSON: ``{"tpu": "ok", "startup_s": ..., "matmul_s": ...}``.
+
+Exit codes: 0 healthy, 3 startup too slow (retry later), 4 matmul
+dispatched but wrong platform (CPU fallback attached).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--startup-limit", type=float, default=60.0)
+    args = ap.parse_args()
+
+    t0 = time.time()
+    print("phase=import", flush=True)
+    import jax
+    import jax.numpy as jnp
+
+    print(f"phase=devices t={time.time() - t0:.1f}", flush=True)
+    devices = jax.devices()
+    startup = time.time() - t0
+    if startup > args.startup_limit:
+        print(json.dumps({"tpu": "startup_hung",
+                          "startup_s": round(startup, 1)}))
+        return 3
+
+    print(f"phase=dispatch t={startup:.1f}", flush=True)
+    t1 = time.time()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    jax.device_get(x @ x)
+    matmul = time.time() - t1
+    platform = devices[0].platform
+    print(json.dumps({
+        "tpu": "ok" if platform == "tpu" else "wrong_platform",
+        "platform": platform,
+        "startup_s": round(startup, 1),
+        "matmul_s": round(matmul, 2),
+    }))
+    return 0 if platform == "tpu" else 4
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,3 +23,14 @@ os.environ.setdefault("JAX_ENABLE_CHECKS", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent XLA compile cache (same knob bench.py uses): repeat suite
+# runs skip recompiling the expensive trainer/self-play programs, which
+# dominate suite wall-time (VERDICT r2 weak #4)
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.expanduser("~/.cache/jax_comp_cache_tests"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+except Exception:  # noqa: BLE001 — older jax without the knobs
+    pass

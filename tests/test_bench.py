@@ -20,6 +20,7 @@ def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
     monkeypatch.setenv("_GRAFT_BENCH_FORCE_ADAPTIVE", "1")
     monkeypatch.setenv("_GRAFT_BENCH_MAX_MOVES", "12")
     monkeypatch.setenv("_GRAFT_BENCH_SEED_PLIES", "12")
+    monkeypatch.setenv("_GRAFT_BENCH_BATCHES", "16,8")
     monkeypatch.syspath_prepend(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import bench
@@ -32,6 +33,10 @@ def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
     assert rec["metric"] == bench.METRIC
     assert rec["unit"] == "games/min"
     assert rec["value"] > 0
-    assert rec["batch"] in (16, 64)       # a probed candidate won
+    assert rec["batch"] in (16, 8)        # a probed candidate won
     assert 5 <= rec["chunk"] <= 100       # sized within the clamp
     assert rec["max_moves"] == 12
+    # 12-ply games are truncated: the metric must say so and must not
+    # claim a ratio against the full-game north star (VERDICT r2)
+    assert rec["truncated"] is True
+    assert rec["vs_baseline"] is None

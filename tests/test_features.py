@@ -172,6 +172,7 @@ class TestLadderDifferential:
             n_captures = int(ora[:, :, 0].sum())
             assert n_captures == (0 if breaker else 1)
 
+    @pytest.mark.slow
     def test_escaper_response_algebra_self_consistent(self):
         """Property check of the loop-free rung algebra: for random
         chase openings, the reported response liberty count must equal
@@ -234,6 +235,7 @@ class TestLadderDifferential:
                 checked += 1
         assert checked >= 10
 
+    @pytest.mark.slow
     def test_random_position_disagreement_rate_bounded(self):
         rng_master = np.random.default_rng(20260729)
         cells = disagreements = 0
@@ -285,6 +287,57 @@ class TestLadderDifferential:
         rate = disagreements / cells
         assert rate < 0.01, (
             f"dense-board ladder disagreement {rate:.2%} (bound 1%)")
+
+
+class TestLadderOverflow:
+    """Adversarial ``chase_slots`` overflow (VERDICT r2 weak #6): a
+    crafted board with MORE simultaneous live ladder chases than the
+    default 4 slots must degrade gracefully — truncation drops chases
+    in board row-major candidate order and every dropped cell reads
+    the conservative False (never a spurious capture/escape) — and
+    raising ``ladder_chase_slots`` must restore exactness."""
+
+    # six independent standard ladder seeds along the anti-diagonal:
+    # each W stone is flanked by B on three sides (two liberties, B to
+    # move) and its chase path runs toward the lower-right, parallel
+    # to and clear of every other seed's path
+    SEEDS = [(1, 16), (4, 13), (7, 10), (10, 7), (13, 4), (16, 1)]
+    FEATURES = ("ladder_capture", "ladder_escape")
+
+    def _board(self):
+        st = pygo.GameState(size=19, komi=7.5)
+        for r, c in self.SEEDS:
+            st.do_move((r - 1, c), pygo.BLACK)
+            st.do_move((r, c), pygo.WHITE)
+            st.do_move((r, c - 1), pygo.BLACK)
+            st.do_move((r + 1, c - 1), pygo.BLACK)
+        st.current_player = pygo.BLACK
+        return st
+
+    def _encode(self, st, slots):
+        cfg = GoConfig(size=19, komi=7.5)
+        pre = Preprocess(self.FEATURES, cfg=cfg,
+                         ladder_chase_slots=slots)
+        return np.asarray(
+            pre.state_to_tensor(jaxgo.from_pygo(cfg, st)))[0]
+
+    def test_overflow_degrades_conservatively_and_slots_restore(self):
+        st = self._board()
+        ora = pyfeatures.state_to_planes(st, self.FEATURES)
+        # the construction really overflows: one working ladder
+        # capture per seed, all simultaneously live
+        assert int(ora[:, :, 0].sum()) == len(self.SEEDS)
+
+        dev4 = self._encode(st, slots=4)
+        # graceful: every asserted cell is oracle-true (truncation
+        # only ever under-reports) ...
+        assert not ((dev4 == 1) & (ora == 0)).any()
+        # ... and exactly the 4 covered chases (row-major candidate
+        # order) are reported — the 2 dropped seeds read False
+        assert int(dev4[:, :, 0].sum()) == 4
+
+        dev16 = self._encode(st, slots=16)
+        np.testing.assert_array_equal(dev16, ora)
 
 
 class TestAPI:

@@ -26,8 +26,13 @@ def py_legal_points(st: pygo.GameState) -> np.ndarray:
     return mask
 
 
-@pytest.mark.parametrize("size,superko", [(5, False), (5, True),
-                                          (9, False), (9, True)])
+@pytest.mark.parametrize(
+    "size,superko",
+    [(5, False), (5, True),
+     # 9×9 runs cover the same code paths over longer games — kept in
+     # CI's full run, deselected from the fast tier (suite wall-time)
+     pytest.param(9, False, marks=pytest.mark.slow),
+     pytest.param(9, True, marks=pytest.mark.slow)])
 def test_random_game_differential(size, superko):
     cfg = GoConfig(size=size, komi=5.5, enforce_superko=superko,
                    max_history=256)

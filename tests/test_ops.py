@@ -66,9 +66,13 @@ def chase_lanes(seed, positions=24, moves_lo=8, moves_hi=40):
     """Chase entries via the SAME harvest the chase benchmark uses
     (``benchmarks/_harness.py``) so test and bench always exercise the
     exact entry contract the ladder planes hand to the chase."""
+    import os
     import sys
 
-    sys.path.insert(0, ".")
+    # repo root derived from this file, not cwd, so the import works
+    # from any pytest invocation directory
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     from benchmarks._harness import harvest_chase_lanes
 
     return harvest_chase_lanes(SIZE, lanes=None, seed=seed,
@@ -76,6 +80,7 @@ def chase_lanes(seed, positions=24, moves_lo=8, moves_hi=40):
                                positions=positions)
 
 
+@pytest.mark.slow
 def test_pallas_chase_matches_xla_on_random_entries():
     from rocalphago_tpu.features.ladders import _chase
 
@@ -95,6 +100,7 @@ def test_pallas_chase_matches_xla_on_random_entries():
     assert want.any() and not want.all()
 
 
+@pytest.mark.slow
 def test_pallas_chase_under_vmap_matches_unbatched():
     """Every production call site reaches the kernel through the
     encoder's jax.vmap over games (the pallas_call batching rule
