@@ -327,6 +327,13 @@ def _run_child(extra_env: dict, budget: float):
         except (json.JSONDecodeError, ValueError):
             continue
         if isinstance(parsed, dict) and parsed.get("metric") == METRIC:
+            if "error" in parsed:
+                # the child's honest self-report of a failed
+                # measurement — treat as attempt failure so the
+                # retry / CPU-fallback chain still runs (the parent's
+                # final catch-all prints an error record if every
+                # attempt fails)
+                return None, f"child error: {parsed['error']}"
             return parsed, ""
     tail = (proc.stderr or proc.stdout or "").strip()[-800:]
     return None, f"rc={proc.returncode}: {tail}"

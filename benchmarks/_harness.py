@@ -43,12 +43,24 @@ _TPU_BF16_PEAK = {"v5e": 197e12, "v5litepod": 197e12,
 
 def bf16_peak_flops() -> float | None:
     """Peak bf16 FLOP/s of the attached chip, or None off-TPU (an MFU
-    against a host CPU "peak" would be meaningless)."""
+    against a host CPU "peak" would be meaningless).
+
+    Generation detection: ``$PALLAS_AXON_TPU_GEN`` if set, else the
+    device_kind string with spaces/dashes stripped so JAX's spellings
+    ("TPU v5 lite", "TPU v5p", "TPU v6 lite") match the generation
+    keys. Order matters: the more specific "v5p"/"v5lite" patterns are
+    tested before bare "v5"."""
     if jax.devices()[0].platform != "tpu":
         return None
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for key, peak in _TPU_BF16_PEAK.items():
-        if key in (gen or jax.devices()[0].device_kind.lower()):
+    compact = (gen or jax.devices()[0].device_kind.lower()) \
+        .replace(" ", "").replace("-", "")
+    for keys, peak in (
+            (("v5e", "v5lite"), _TPU_BF16_PEAK["v5e"]),
+            (("v6e", "v6lite"), _TPU_BF16_PEAK["v6e"]),
+            (("v5p", "v5"), _TPU_BF16_PEAK["v5p"]),
+            (("v4",), _TPU_BF16_PEAK["v4"])):
+        if any(k in compact for k in keys):
             return peak
     return _TPU_BF16_PEAK["v5e"]   # attached tunnel default
 

@@ -55,6 +55,13 @@ def main(argv=None) -> dict:
                     help="self-play plies per compiled segment")
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--filters", type=int, default=32)
+    ap.add_argument("--learning-rate", type=float, default=0.003)
+    ap.add_argument("--epoch-length", type=int, default=None,
+                    help="steps per epoch (default: one full pass). "
+                    "A toy teacher's predictability saturates within "
+                    "~1 full pass; shorter epochs keep the held-out "
+                    "curve inside its improving regime so the "
+                    "per-epoch measurement is demonstrable")
     a = ap.parse_args(argv)
 
     os.makedirs(a.out, exist_ok=True)
@@ -71,28 +78,37 @@ def main(argv=None) -> dict:
             "--board", str(a.board), "--layers", str(a.layers),
             "--filters", str(a.filters), "--seed", str(seed))
 
-    # 2. self-play corpus (chunked — watchdog-safe on the TPU tunnel);
-    # actual game count is n_batches × game_batch (recorded below —
-    # never the possibly-unround --games request)
+    # 2+3. self-play corpus → sharded arrays (chunked — watchdog-safe
+    # on the TPU tunnel); actual game count is n_batches × game_batch
+    # (recorded below — never the possibly-unround --games request).
+    # Resumable: an existing converted corpus is reused as-is, so a
+    # training-stage rerun does not replay hours of self-play.
     n_batches = max(1, round(a.games / a.game_batch))
     actual_games = n_batches * a.game_batch
-    for b in range(n_batches):
-        run("rocalphago_tpu.interface.selfplay_cli",
-            "--policy", teacher, "--games", str(a.game_batch),
-            "--out", os.path.join(sgf_dir, f"b{b:03d}"),
-            "--max-moves", str(3 * a.board * a.board),
-            "--temperature", str(a.temperature),
-            "--chunk", str(a.chunk), "--seed", str(b))
-
-    # 3. SGF → sharded arrays
-    run("rocalphago_tpu.data.convert",
-        "--directory", sgf_dir, "--recurse", "--outfile", corpus,
-        "--size", str(a.board))
+    # the manifest is the converter's completion marker (written after
+    # every shard) — shard files alone may be a half-finished run
+    if os.path.exists(corpus + "-manifest.json"):
+        print(f"+ reusing existing corpus {corpus}*", file=sys.stderr)
+    else:
+        for b in range(n_batches):
+            run("rocalphago_tpu.interface.selfplay_cli",
+                "--policy", teacher, "--games", str(a.game_batch),
+                "--out", os.path.join(sgf_dir, f"b{b:03d}"),
+                "--max-moves", str(3 * a.board * a.board),
+                "--temperature", str(a.temperature),
+                "--chunk", str(a.chunk), "--seed", str(b))
+        run("rocalphago_tpu.data.convert",
+            "--directory", sgf_dir, "--recurse", "--outfile", corpus,
+            "--size", str(a.board))
 
     # 4. SL training; per-epoch held-out (val) accuracy + final test
-    run("rocalphago_tpu.training.sl", student, corpus, train_dir,
-        "--epochs", str(a.epochs), "--minibatch", str(a.minibatch),
-        "--learning-rate", "0.01")
+    train_args = [student, corpus, train_dir,
+                  "--epochs", str(a.epochs),
+                  "--minibatch", str(a.minibatch),
+                  "--learning-rate", str(a.learning_rate)]
+    if a.epoch_length:
+        train_args += ["--epoch-length", str(a.epoch_length)]
+    run("rocalphago_tpu.training.sl", *train_args)
 
     with open(os.path.join(train_dir, "metadata.json")) as f:
         meta = json.load(f)

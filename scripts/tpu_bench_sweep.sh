@@ -1,0 +1,44 @@
+#!/bin/bash
+# TPU component-bench sweep (VERDICT r3 item 1): run ONLY after
+# `python scripts/tpu_probe.py` reports {"tpu": "ok"}.
+#
+# Ordering is risk-ascending: cheap compiled programs first, the
+# self-play/RL programs (chunked, watchdog-safe) last, so a mid-sweep
+# worker crash costs the least data. NO step is wrapped in a killing
+# timeout — every program here is already sized/chunked to finish
+# under the ~40s worker watchdog, and killing a TPU client mid-run
+# wedges the tunnel (round-2 postmortem). Each result line also lands
+# in benchmarks/results.jsonl with platform+date.
+#
+# Usage: bash scripts/tpu_bench_sweep.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-benchmarks/tpu_sweep_$(date +%H%M)}
+mkdir -p "$LOG"
+
+run() {
+    name=$1; shift
+    echo "=== $name: $*" | tee -a "$LOG/sweep.log"
+    # no timeout wrapper by design — see header
+    "$@" >>"$LOG/sweep.log" 2>&1
+    echo "    rc=$?" | tee -a "$LOG/sweep.log"
+    # give a crashed worker its ~15s self-recovery before the next step
+    sleep 15
+}
+
+run probe      python scripts/tpu_probe.py
+run labels     python benchmarks/bench_labels.py --reps 3
+run engine     python benchmarks/bench_engine.py --reps 2
+run engine1k   python benchmarks/bench_engine.py --batch 1024 --moves 64 --reps 2
+run train      python benchmarks/bench_train.py --batch-sweep 64,256,1024 --reps 3
+run rollout    python benchmarks/bench_rollout.py --reps 3
+run preprocess python benchmarks/bench_preprocess.py --reps 2
+run chase_xla  python benchmarks/bench_chase.py --reps 2
+run chase_pls  env ROCALPHAGO_PALLAS_CHASE=1 python benchmarks/bench_chase.py --reps 2
+run selfplay   python benchmarks/bench_selfplay.py --batch-sweep 16,64,256 --reps 2
+run mcts9      python benchmarks/bench_mcts.py --board 9 --playouts 64 --reps 2
+run mcts19     python benchmarks/bench_mcts.py --board 19 --playouts 48 --reps 2
+run mcts19r    python benchmarks/bench_mcts.py --board 19 --playouts 48 --lmbda 0.5 --device-rollout --reps 2
+run rl         python benchmarks/bench_rl.py --batch 16 --moves 100 --chunk 10 --reps 1
+
+echo "sweep done; results in $LOG/sweep.log + benchmarks/results.jsonl"
