@@ -1,9 +1,16 @@
 """Serving interface (reference layer L6): the GTP engine
-(SURVEY.md §1 L6, §3.5)."""
+(SURVEY.md §1 L6, §3.5).
 
-from rocalphago_tpu.interface.gtp import (  # noqa: F401
-    GTPEngine,
-    move_to_vertex,
-    run_gtp,
-    vertex_to_move,
-)
+Re-exports are lazy — see :mod:`rocalphago_tpu.utils.lazy`.
+"""
+
+from rocalphago_tpu.utils.lazy import make_lazy
+
+_EXPORTS = {
+    "GTPEngine": "rocalphago_tpu.interface.gtp",
+    "move_to_vertex": "rocalphago_tpu.interface.gtp",
+    "run_gtp": "rocalphago_tpu.interface.gtp",
+    "vertex_to_move": "rocalphago_tpu.interface.gtp",
+}
+
+__getattr__, __dir__, __all__ = make_lazy(__name__, _EXPORTS)

@@ -1,14 +1,21 @@
 """Trainers: supervised policy, REINFORCE self-play policy, value
 regression, and the self-play value-dataset generator the reference
-lacks (SURVEY.md §1 L4, §2 "Value trainer" gap)."""
+lacks (SURVEY.md §1 L4, §2 "Value trainer" gap).
 
-from rocalphago_tpu.training.rl import RLConfig, RLTrainer  # noqa: F401
-from rocalphago_tpu.training.selfplay_data import (  # noqa: F401
-    ValueDataGenerator,
-    play_value_games,
-)
-from rocalphago_tpu.training.sl import SLConfig, SLTrainer  # noqa: F401
-from rocalphago_tpu.training.value import (  # noqa: F401
-    ValueConfig,
-    ValueTrainer,
-)
+Re-exports are lazy — see :mod:`rocalphago_tpu.utils.lazy`.
+"""
+
+from rocalphago_tpu.utils.lazy import make_lazy
+
+_EXPORTS = {
+    "RLConfig": "rocalphago_tpu.training.rl",
+    "RLTrainer": "rocalphago_tpu.training.rl",
+    "ValueDataGenerator": "rocalphago_tpu.training.selfplay_data",
+    "play_value_games": "rocalphago_tpu.training.selfplay_data",
+    "SLConfig": "rocalphago_tpu.training.sl",
+    "SLTrainer": "rocalphago_tpu.training.sl",
+    "ValueConfig": "rocalphago_tpu.training.value",
+    "ValueTrainer": "rocalphago_tpu.training.value",
+}
+
+__getattr__, __dir__, __all__ = make_lazy(__name__, _EXPORTS)

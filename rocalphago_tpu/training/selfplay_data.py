@@ -57,7 +57,7 @@ def _snapshot(mask: jax.Array, new, old):
 
 def _make_value_ply(cfg: jaxgo.GoConfig, features: tuple,
                     apply_sl: Callable, apply_rl: Callable,
-                    batch: int, temperature: float):
+                    temperature: float):
     """Shared one-ply body of the mixed-policy value game (snapshot
     recording + SL/random/RL action switch), parameterized over params
     and the per-game random plies ``U`` so both the monolithic scan
@@ -130,7 +130,7 @@ def play_value_games(cfg: jaxgo.GoConfig, features: tuple,
     preprocess. ``u_max`` caps the random ply U (default
     ``max_moves - 2`` so the recorded position can exist).
     """
-    ply = _make_value_ply(cfg, features, apply_sl, apply_rl, batch,
+    ply = _make_value_ply(cfg, features, apply_sl, apply_rl,
                           temperature)
     rng, u_key = jax.random.split(rng)
     U = jax.random.randint(u_key, (batch,), 0,
@@ -160,7 +160,7 @@ def make_value_games_chunked(cfg: jaxgo.GoConfig, features: tuple,
     has ended (the remaining plies are no-ops for the snapshot and the
     outcome). Results are bit-identical to the monolithic scan —
     ``tests/test_value_path.py``."""
-    ply = _make_value_ply(cfg, features, apply_sl, apply_rl, batch,
+    ply = _make_value_ply(cfg, features, apply_sl, apply_rl,
                           temperature)
     u_cap = _value_u_cap(max_moves, u_max)
 

@@ -1,0 +1,31 @@
+"""Shared PEP 562 lazy re-export helper for package ``__init__``s.
+
+Eager submodule imports in a package ``__init__`` make
+``python -m package.submodule`` warn (the module is already in
+``sys.modules`` before runpy executes it) and pull every submodule's
+dependencies into any one CLI's start. Usage::
+
+    _EXPORTS = {"Thing": "package.submodule", ...}
+    __getattr__, __dir__, __all__ = make_lazy(__name__, _EXPORTS)
+"""
+
+from __future__ import annotations
+
+import importlib
+
+
+def make_lazy(package: str, exports: dict):
+    """Return ``(__getattr__, __dir__, __all__)`` resolving each name
+    in ``exports`` from its submodule on first attribute access."""
+
+    def __getattr__(name: str):
+        module = exports.get(name)
+        if module is None:
+            raise AttributeError(
+                f"module {package!r} has no attribute {name!r}")
+        return getattr(importlib.import_module(module), name)
+
+    def __dir__():
+        return sorted(exports)
+
+    return __getattr__, __dir__, list(exports)
