@@ -1,0 +1,67 @@
+"""Fully on-device MCTS throughput (sims/s across the game batch).
+
+The host-tree search (``bench_mcts.py``) pays a host↔device round
+trip per leaf wave; ``search.device_mcts`` runs the entire search —
+tree, select, expand, evaluate, backup — as one jitted program, with
+every simulation evaluating the whole game batch in lockstep. This
+measures batched search throughput: total simulations (batch × n_sim)
+per second, the number that matters for self-play generation where
+many games search simultaneously.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._harness import report, std_parser, timed  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig, new_states
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import make_device_mcts
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--sims", type=int, default=64)
+    ap.add_argument("--max-nodes", type=int, default=None,
+                    help="tree slab capacity (default: 2x sims)")
+    args = ap.parse_args()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch = args.batch or (16 if on_tpu else 4)
+    max_nodes = args.max_nodes or 2 * args.sims
+
+    policy = CNNPolicy(board=args.board, layers=12,
+                       filters_per_layer=128)
+    value = CNNValue(board=args.board, layers=12, filters_per_layer=128)
+    search = make_device_mcts(
+        GoConfig(size=args.board), policy.feature_list,
+        value.feature_list, policy.module.apply, value.module.apply,
+        n_sim=args.sims, max_nodes=max_nodes)
+    roots = new_states(GoConfig(size=args.board), batch)
+
+    # chunked driving on TPU: one compiled program per 8 simulations,
+    # tree device-resident between calls — the ~40s worker watchdog
+    # must never see the whole search as one program
+    chunk = 8 if on_tpu else args.sims
+
+    def once():
+        tree = search.init(policy.params, value.params, roots)
+        for done in range(0, args.sims, chunk):
+            tree = search.run_sims(policy.params, value.params, tree,
+                                   k=min(chunk, args.sims - done))
+        visits, _ = search.root_stats(tree)
+        return jax.device_get(visits)
+
+    dt = timed(once, reps=args.reps, profile_dir=args.profile)
+    report("device_mcts_sims", batch * args.sims / dt, "sims/s",
+           batch=batch, sims=args.sims, max_nodes=max_nodes,
+           board=args.board)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,22 +1,29 @@
 """Agents & search (reference layer L5): policy players, on-device
-batched self-play, and APV-MCTS (SURVEY.md §1 L5, §3.3)."""
+batched self-play, and APV-MCTS (SURVEY.md §1 L5, §3.3) — plus the
+fully on-device tree search (``device_mcts``), the TPU-first design
+the reference's host tree cannot express.
 
-from rocalphago_tpu.search.mcts import (  # noqa: F401
-    MCTS,
-    MCTSPlayer,
-    ParallelMCTS,
-    TreeNode,
-    net_backends,
-)
-from rocalphago_tpu.search.players import (  # noqa: F401
-    GreedyPolicyPlayer,
-    ProbabilisticPolicyPlayer,
-    ValuePlayer,
-)
-from rocalphago_tpu.search.selfplay import (  # noqa: F401
-    SelfplayResult,
-    make_selfplay,
-    make_selfplay_chunked,
-    play_games,
-    sensible_mask,
-)
+Re-exports are lazy — see :mod:`rocalphago_tpu.utils.lazy`.
+"""
+
+from rocalphago_tpu.utils.lazy import make_lazy
+
+_EXPORTS = {
+    "DeviceTree": "rocalphago_tpu.search.device_mcts",
+    "make_device_mcts": "rocalphago_tpu.search.device_mcts",
+    "MCTS": "rocalphago_tpu.search.mcts",
+    "MCTSPlayer": "rocalphago_tpu.search.mcts",
+    "ParallelMCTS": "rocalphago_tpu.search.mcts",
+    "TreeNode": "rocalphago_tpu.search.mcts",
+    "net_backends": "rocalphago_tpu.search.mcts",
+    "GreedyPolicyPlayer": "rocalphago_tpu.search.players",
+    "ProbabilisticPolicyPlayer": "rocalphago_tpu.search.players",
+    "ValuePlayer": "rocalphago_tpu.search.players",
+    "SelfplayResult": "rocalphago_tpu.search.selfplay",
+    "make_selfplay": "rocalphago_tpu.search.selfplay",
+    "make_selfplay_chunked": "rocalphago_tpu.search.selfplay",
+    "play_games": "rocalphago_tpu.search.selfplay",
+    "sensible_mask": "rocalphago_tpu.search.selfplay",
+}
+
+__getattr__, __dir__, __all__ = make_lazy(__name__, _EXPORTS)

@@ -1,0 +1,315 @@
+"""Fully on-device batched MCTS: the whole search is ONE jitted program.
+
+The reference's search (``AlphaGo/mcts.py`` — host tree, batch-1 NN
+evals) and its rebuild :class:`~rocalphago_tpu.search.mcts.ParallelMCTS`
+(host tree, batched leaf waves) both pay a host↔device round trip per
+evaluation wave. This module removes the host from the loop entirely,
+mctx-style: the tree itself lives in fixed-shape device arrays (a
+``max_nodes`` slab per game), and select → expand → evaluate → backup
+is a ``lax.fori_loop`` over simulations, with each simulation stepping
+ALL games in lockstep — so every policy/value forward runs at the full
+game batch, and the only host↔device traffic for an entire search is
+the root states in and the visit counts out.
+
+Search semantics match the host tree (λ=0 APV — PUCT select, policy
+priors over sensible moves, value-net leaf evaluation, sign-alternating
+backup; same ``c_puct`` formula), with two deliberate differences:
+simulations are strictly sequential per game (no virtual loss — the
+batch axis provides the parallelism), and the tree is capacity-bounded
+(``max_nodes``; a full slab keeps evaluating leaves but stops
+allocating, so extra simulations still improve Q estimates).
+
+Layout notes (TPU): per game the slab holds the node states (a stacked
+:class:`GoState` pytree), edge stats ``P/N/W [M, A]`` and the child
+index table ``[M, A]`` — all static shapes; descend and backup are
+``while_loop``s over int32 scalars with array gathers, and the
+per-simulation NN evaluation uses the same nested-feature fusion as
+the host waves (value planes encoded once; the policy forward reads
+the prefix slice when ``value_features == policy_features + color``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rocalphago_tpu.engine.jaxgo import (
+    GoConfig,
+    GoState,
+    group_data,
+    new_states,
+    step,
+    winner,
+)
+from rocalphago_tpu.features.planes import encode, needs_member
+from rocalphago_tpu.features.pyfeatures import output_planes
+from rocalphago_tpu.search.selfplay import sensible_mask
+
+
+class DeviceTree(NamedTuple):
+    """Per-game search slab (leading axis = game batch B).
+
+    ``A = N + 1`` actions (last = pass); ``M = max_nodes``.
+    """
+
+    states: GoState      # node states, arrays shaped [B, M, ...]
+    prior: jax.Array     # f32 [B, M, A]
+    visits: jax.Array    # i32 [B, M, A]
+    value_sum: jax.Array  # f32 [B, M, A] — from the node player's view
+    child: jax.Array     # i32 [B, M, A]  node index, -1 = unexpanded
+    parent: jax.Array    # i32 [B, M]     -1 at the root
+    paction: jax.Array   # i32 [B, M]
+    n_nodes: jax.Array   # i32 [B]
+
+
+def _state_at(states: GoState, idx) -> GoState:
+    """Node ``idx``'s state out of a [M, ...]-stacked GoState."""
+    return jax.tree.map(lambda x: x[idx], states)
+
+
+def _set_state(states: GoState, idx, st: GoState) -> GoState:
+    return jax.tree.map(lambda buf, v: buf.at[idx].set(v), states, st)
+
+
+def _terminal_value(cfg: GoConfig, st: GoState) -> jax.Array:
+    """Outcome in {-1, 0, 1} from the player to move's perspective."""
+    w = winner(cfg, st)
+    return (w * st.turn).astype(jnp.float32)
+
+
+def make_device_mcts(cfg: GoConfig, policy_features: tuple,
+                     value_features: tuple,
+                     policy_apply: Callable, value_apply: Callable,
+                     n_sim: int, max_nodes: int,
+                     c_puct: float = 5.0):
+    """Build the jitted searcher.
+
+    Returns ``search(params_p, params_v, root_states) ->
+    (root_visits i32 [B, A], root_q f32 [B, A])`` where ``root_states``
+    is a batched :class:`GoState` (leading axis B) and ``root_q`` is
+    the mean backed-up value per root action from the root player's
+    perspective (0 where unvisited). ``value_features`` must be
+    ``policy_features + ("color",)`` (the canonical nested 48/49
+    layout) so one encode serves both nets.
+    """
+    if tuple(value_features[:-1]) != tuple(policy_features) or \
+            value_features[-1] != "color":
+        raise ValueError(
+            "device MCTS requires the nested feature layout: "
+            "value_features == policy_features + ('color',); got "
+            f"{policy_features} / {value_features}")
+    n = cfg.num_points
+    num_actions = n + 1
+    m = max_nodes
+    n_policy_planes = output_planes(policy_features)
+
+    vgd = jax.vmap(lambda s: group_data(
+        cfg, s.board, with_member=needs_member(value_features),
+        with_zxor=cfg.enforce_superko, labels=s.labels))
+    venc = jax.vmap(lambda s, g: encode(cfg, s, features=value_features,
+                                        gd=g))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(step, cfg))
+    vterm = jax.vmap(functools.partial(_terminal_value, cfg))
+
+    def eval_batch(params_p, params_v, states: GoState):
+        """One fused NN evaluation of a [B]-batched GoState:
+        ``(priors f32 [B, A], values f32 [B])``. Priors are a masked
+        softmax over sensible moves; the pass action gets probability
+        1 exactly when no sensible move exists. Values are the value
+        net's output where live, the terminal outcome where done."""
+        gd = vgd(states)
+        planes = venc(states, gd)                      # [B, s, s, Fv]
+        sens = vsens(states, gd)                       # [B, N]
+        logits = policy_apply(params_p,
+                              planes[..., :n_policy_planes])
+        neg = jnp.finfo(logits.dtype).min
+        masked = jnp.where(sens, logits, neg)
+        board_p = jax.nn.softmax(masked, axis=-1)
+        any_sens = sens.any(axis=-1, keepdims=True)
+        board_p = jnp.where(any_sens, board_p, 0.0)
+        pass_p = jnp.where(any_sens[:, 0], 0.0, 1.0)
+        priors = jnp.concatenate(
+            [board_p, pass_p[:, None]], axis=-1).astype(jnp.float32)
+        values = value_apply(params_v, planes).astype(jnp.float32)
+        values = jnp.where(states.done, vterm(states), values)
+        return priors, values
+
+    def init_tree(params_p, params_v, roots: GoState) -> DeviceTree:
+        batch = roots.board.shape[0]
+        # node-state slab: every slot starts as a fresh state (cheap,
+        # valid shapes), root state written into slot 0
+        slab = jax.vmap(lambda _: new_states(cfg, m))(
+            jnp.arange(batch))
+        slab = jax.vmap(_set_state, in_axes=(0, None, 0))(
+            slab, 0, roots)
+        root_priors, _ = eval_batch(params_p, params_v, roots)
+        prior = jnp.zeros((batch, m, num_actions), jnp.float32) \
+            .at[:, 0, :].set(root_priors)
+        return DeviceTree(
+            states=slab,
+            prior=prior,
+            visits=jnp.zeros((batch, m, num_actions), jnp.int32),
+            value_sum=jnp.zeros((batch, m, num_actions), jnp.float32),
+            child=jnp.full((batch, m, num_actions), -1, jnp.int32),
+            parent=jnp.full((batch, m), -1, jnp.int32),
+            paction=jnp.zeros((batch, m), jnp.int32),
+            n_nodes=jnp.ones((batch,), jnp.int32),
+        )
+
+    def _select_action(prior_n, visits_n, value_n):
+        """PUCT argmax over one node's edges ([A] arrays)."""
+        nv = visits_n.astype(jnp.float32)
+        q = jnp.where(visits_n > 0, value_n / jnp.maximum(nv, 1.0), 0.0)
+        u = (c_puct * prior_n * jnp.sqrt(nv.sum() + 1.0) / (1.0 + nv))
+        score = jnp.where(prior_n > 0, q + u, -jnp.inf)
+        return jnp.argmax(score).astype(jnp.int32)
+
+    def _descend_one(prior, visits, value_sum, child, done_m):
+        """Single-game descend ([M, ...] arrays): walk existing child
+        pointers from the root until an unexpanded edge or a terminal
+        node. Returns ``(node, action)``; ``action`` = -1 when the
+        walk ended ON a terminal node (evaluate that node itself)."""
+        def cond(carry):
+            node, action, stop = carry
+            return ~stop
+
+        def body(carry):
+            node, _, _ = carry
+            at_term = done_m[node]
+            action = jnp.where(
+                at_term, -1,
+                _select_action(prior[node], visits[node],
+                               value_sum[node]))
+            nxt = jnp.where(action >= 0, child[node, action], -1)
+            stop = at_term | (nxt < 0)
+            return (jnp.where(stop, node, nxt), action, stop)
+
+        node, action, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.int32(-1), jnp.bool_(False)))
+        return node, action
+
+    def _backup_one(visits, value_sum, parent, paction, start_node,
+                    start_action, v_child):
+        """Single-game backup: add the evaluation along the path back
+        to the root, alternating sign each level. ``v_child`` is from
+        the evaluated state's player-to-move perspective, so the edge
+        into it scores ``-v_child`` for its chooser."""
+        def cond(carry):
+            node, *_ = carry
+            return node >= 0
+
+        def body(carry):
+            node, action, v, visits, value_sum = carry
+            visits = visits.at[node, action].add(1)
+            value_sum = value_sum.at[node, action].add(v)
+            return (parent[node], paction[node], -v, visits, value_sum)
+
+        _, _, _, visits, value_sum = lax.while_loop(
+            cond, body,
+            (start_node, start_action, -v_child, visits, value_sum))
+        return visits, value_sum
+
+    def simulate(params_p, params_v, tree: DeviceTree) -> DeviceTree:
+        """One lockstep simulation across the whole game batch."""
+        node, action = jax.vmap(_descend_one)(
+            tree.prior, tree.visits, tree.value_sum, tree.child,
+            tree.states.done)
+
+        # candidate child states: step the selected edge (terminal
+        # descends step a no-op pass on an already-done state — the
+        # result is discarded for those games)
+        parent_states = jax.vmap(_state_at)(tree.states, node)
+        safe_action = jnp.where(action >= 0, action, n)
+        new_states_b = vstep(parent_states, safe_action)
+
+        expanding = action >= 0                       # bool [B]
+        full = tree.n_nodes >= m
+        idx = jnp.where(expanding & ~full,
+                        jnp.minimum(tree.n_nodes, m - 1), 0)
+
+        # evaluate: expanded games evaluate the new child state;
+        # terminal descends evaluate the terminal node's own state
+        eval_states = jax.tree.map(
+            lambda a, b: jnp.where(
+                expanding.reshape((-1,) + (1,) * (a.ndim - 1)), a, b),
+            new_states_b, parent_states)
+        priors, values = eval_batch(params_p, params_v, eval_states)
+
+        # write the new node (only where expanding & not full)
+        write = expanding & ~full
+
+        def write_state(slab, i, st, w):
+            return jax.tree.map(
+                lambda buf, v: jnp.where(w, buf.at[i].set(v), buf),
+                slab, st)
+
+        states = jax.vmap(write_state)(tree.states, idx, new_states_b,
+                                       write)
+        prior = jax.vmap(
+            lambda p, i, row, w: jnp.where(w, p.at[i].set(row), p))(
+                tree.prior, idx, priors, write)
+        child = jax.vmap(
+            lambda c, nd, a, i, w: jnp.where(
+                w, c.at[nd, a].set(i), c))(
+                tree.child, node, safe_action, idx, write)
+        parent = jax.vmap(
+            lambda p, i, nd, w: jnp.where(w, p.at[i].set(nd), p))(
+                tree.parent, idx, node, write)
+        paction = jax.vmap(
+            lambda p, i, a, w: jnp.where(w, p.at[i].set(a), p))(
+                tree.paction, idx, safe_action, write)
+        n_nodes = tree.n_nodes + write.astype(jnp.int32)
+
+        # backup start: the edge INTO the evaluated state — (node,
+        # action) for expansions (stored or capacity-skipped alike),
+        # the terminal node's own parent edge otherwise. A terminal
+        # ROOT (parent -1) skips the backup loop entirely.
+        start_node = jnp.where(expanding, node,
+                               jax.vmap(lambda p, nd: p[nd])(
+                                   tree.parent, node))
+        start_action = jnp.where(
+            expanding, safe_action,
+            jax.vmap(lambda p, nd: p[nd])(tree.paction, node))
+        visits, value_sum = jax.vmap(_backup_one)(
+            tree.visits, tree.value_sum, parent, paction,
+            start_node, start_action, values)
+
+        return DeviceTree(states, prior, visits, value_sum, child,
+                          parent, paction, n_nodes)
+
+    def _root_stats(tree: DeviceTree):
+        root_visits = tree.visits[:, 0, :]
+        root_q = jnp.where(
+            root_visits > 0,
+            tree.value_sum[:, 0, :]
+            / jnp.maximum(root_visits.astype(jnp.float32), 1.0),
+            0.0)
+        return root_visits, root_q
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def run_sims(params_p, params_v, tree: DeviceTree, k: int):
+        """``k`` simulations as one compiled program (tree in/out) —
+        the chunking unit for watchdog-limited backends: drive
+        ``init`` + repeated ``run_sims`` from a host loop, with the
+        tree device-resident between calls, then ``root_stats``."""
+        return lax.fori_loop(
+            0, k, lambda _, t: simulate(params_p, params_v, t), tree)
+
+    @jax.jit
+    def search(params_p, params_v, roots: GoState):
+        tree = init_tree(params_p, params_v, roots)
+        tree = run_sims(params_p, params_v, tree, n_sim)
+        return _root_stats(tree)
+
+    # chunk-driving surface (same convention as the chunked runners):
+    # search.init → DeviceTree, search.run_sims(…, k=) → DeviceTree,
+    # search.root_stats(tree) → (visits, q)
+    search.init = jax.jit(init_tree)
+    search.run_sims = run_sims
+    search.root_stats = jax.jit(_root_stats)
+    return search

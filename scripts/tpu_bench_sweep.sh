@@ -36,6 +36,8 @@ run preprocess python benchmarks/bench_preprocess.py --reps 2
 run chase_xla  python benchmarks/bench_chase.py --reps 2
 run chase_pls  env ROCALPHAGO_PALLAS_CHASE=1 python benchmarks/bench_chase.py --reps 2
 run selfplay   python benchmarks/bench_selfplay.py --batch-sweep 16,64,256 --reps 2
+run devmcts9   python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2
+run devmcts19  python benchmarks/bench_device_mcts.py --board 19 --sims 32 --reps 2
 run mcts9      python benchmarks/bench_mcts.py --board 9 --playouts 64 --reps 2
 run mcts19     python benchmarks/bench_mcts.py --board 19 --playouts 48 --reps 2
 run mcts19r    python benchmarks/bench_mcts.py --board 19 --playouts 48 --lmbda 0.5 --device-rollout --reps 2
