@@ -50,11 +50,8 @@ def main() -> None:
     chunk = 8 if on_tpu else args.sims
 
     def once():
-        tree = search.init(policy.params, value.params, roots)
-        for done in range(0, args.sims, chunk):
-            tree = search.run_sims(policy.params, value.params, tree,
-                                   k=min(chunk, args.sims - done))
-        visits, _ = search.root_stats(tree)
+        visits, _ = search.run_chunked(policy.params, value.params,
+                                       roots, chunk)
         return jax.device_get(visits)
 
     dt = timed(once, reps=args.reps, profile_dir=args.profile)

@@ -11,6 +11,7 @@ from rocalphago_tpu.utils.lazy import make_lazy
 _EXPORTS = {
     "DeviceTree": "rocalphago_tpu.search.device_mcts",
     "make_device_mcts": "rocalphago_tpu.search.device_mcts",
+    "make_mcts_selfplay": "rocalphago_tpu.search.device_mcts",
     "MCTS": "rocalphago_tpu.search.mcts",
     "MCTSPlayer": "rocalphago_tpu.search.mcts",
     "ParallelMCTS": "rocalphago_tpu.search.mcts",

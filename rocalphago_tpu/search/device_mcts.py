@@ -143,8 +143,9 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         batch = roots.board.shape[0]
         # node-state slab: every slot starts as a fresh state (cheap,
         # valid shapes), root state written into slot 0
-        slab = jax.vmap(lambda _: new_states(cfg, m))(
-            jnp.arange(batch))
+        slab = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (batch,) + x.shape),
+            new_states(cfg, m))
         slab = jax.vmap(_set_state, in_axes=(0, None, 0))(
             slab, 0, roots)
         root_priors, _ = eval_batch(params_p, params_v, roots)
@@ -162,7 +163,14 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         )
 
     def _select_action(prior_n, visits_n, value_n):
-        """PUCT argmax over one node's edges ([A] arrays)."""
+        """PUCT argmax over one node's edges ([A] arrays).
+
+        ``sqrt(sum(edge visits) + 1)`` IS the host tree's
+        ``sqrt(parent node visits)``: in the host ``TreeNode`` the
+        parent's visit count equals the sum of its edge visits plus
+        the one evaluation that ended at the parent itself when it was
+        expanded — so the two formulas agree at every node, not just
+        asymptotically."""
         nv = visits_n.astype(jnp.float32)
         q = jnp.where(visits_n > 0, value_n / jnp.maximum(nv, 1.0), 0.0)
         u = (c_puct * prior_n * jnp.sqrt(nv.sum() + 1.0) / (1.0 + nv))
@@ -306,10 +314,91 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         tree = run_sims(params_p, params_v, tree, n_sim)
         return _root_stats(tree)
 
+    def run_chunked(params_p, params_v, roots: GoState, chunk: int):
+        """Full search as ``chunk``-simulation compiled programs with
+        the tree device-resident in between — THE way to drive this
+        on watchdog-limited backends (the ~40s TPU worker limit);
+        identical results to :func:`search` (deterministic, the tree
+        carry is the entire state)."""
+        tree = search.init(params_p, params_v, roots)
+        for done in range(0, n_sim, chunk):
+            tree = run_sims(params_p, params_v, tree,
+                            k=min(chunk, n_sim - done))
+        return search.root_stats(tree)
+
     # chunk-driving surface (same convention as the chunked runners):
     # search.init → DeviceTree, search.run_sims(…, k=) → DeviceTree,
-    # search.root_stats(tree) → (visits, q)
+    # search.root_stats(tree) → (visits, q); search.run_chunked =
+    # all three composed
     search.init = jax.jit(init_tree)
     search.run_sims = run_sims
     search.root_stats = jax.jit(_root_stats)
+    search.run_chunked = run_chunked
     return search
+
+
+def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
+                       value_features: tuple, policy_apply: Callable,
+                       value_apply: Callable, batch: int,
+                       max_moves: int, n_sim: int, max_nodes: int,
+                       c_puct: float = 5.0, temperature: float = 1.0,
+                       sim_chunk: int = 8):
+    """Search-driven self-play: every move of every game comes from a
+    fresh :func:`make_device_mcts` search over the batch.
+
+    This is the AlphaZero-shaped generation loop the reference never
+    had (its RL self-play samples the raw policy; SURVEY.md §3.2) —
+    here each ply runs ``n_sim`` lockstep simulations for ALL games in
+    one set of compiled programs and then samples the move from root
+    visit counts (``∝ visits^(1/temperature)``; argmax at
+    ``temperature=0``; forced pass when only pass was visited). Games
+    that end are frozen by the engine; the host loop carries only the
+    batched :class:`GoState` and per-ply actions.
+
+    ``sim_chunk`` bounds the simulations per compiled program (the
+    ~40s TPU worker watchdog). Trees are rebuilt per ply (no subtree
+    reuse — the standard trade of slab-array search; priors/values are
+    recomputed where a host tree would reuse ~1/A of the subtree).
+
+    Returns ``run(params_p, params_v, rng) -> (final GoState,
+    actions i32 [T, B], live bool [T, B])``.
+    """
+    search = make_device_mcts(cfg, policy_features, value_features,
+                              policy_apply, value_apply, n_sim,
+                              max_nodes, c_puct)
+    n = cfg.num_points
+    vstep = jax.vmap(functools.partial(step, cfg))
+
+    @jax.jit
+    def pick_and_step(states: GoState, visits, rng):
+        rng, sub = jax.random.split(rng)
+        counts = visits.astype(jnp.float32)
+        if temperature > 0:
+            logits = jnp.where(
+                counts > 0, jnp.log(jnp.maximum(counts, 1e-9))
+                / temperature, -jnp.inf)
+            action = jax.random.categorical(sub, logits, axis=-1)
+        else:
+            action = jnp.argmax(counts, axis=-1)
+        action = action.astype(jnp.int32)
+        live = ~states.done
+        return vstep(states, action), rng, action, live
+
+    def run(params_p, params_v, rng):
+        states = new_states(cfg, batch)
+        actions, lives = [], []
+        for _ in range(max_moves):
+            visits, _ = search.run_chunked(params_p, params_v, states,
+                                           sim_chunk)
+            states, rng, action, live = pick_and_step(
+                states, visits, rng)
+            actions.append(action)
+            lives.append(live)
+            if bool(jax.device_get(states.done.all())):
+                break
+        return (states, jnp.stack(actions) if actions
+                else jnp.zeros((0, batch), jnp.int32),
+                jnp.stack(lives) if lives
+                else jnp.zeros((0, batch), bool))
+
+    return run

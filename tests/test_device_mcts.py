@@ -106,6 +106,27 @@ def test_capacity_bound_keeps_searching():
     np.testing.assert_array_equal(visits.sum(axis=1), 24)
 
 
+def test_mcts_selfplay_plays_full_games():
+    """Search-driven self-play on 5×5: games end by two passes within
+    the move budget, recorded actions are within range, and the live
+    mask is monotonically non-increasing per game."""
+    from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
+
+    run = make_mcts_selfplay(CFG, FEATS, VFEATS, fake_policy,
+                             fake_value, batch=2, max_moves=150,
+                             n_sim=12, max_nodes=24, sim_chunk=5)
+    final, actions, live = run(None, None, jax.random.key(0))
+    assert bool(np.asarray(final.done).all()), "games did not finish"
+    acts = np.asarray(actions)
+    assert ((acts >= 0) & (acts <= N)).all()
+    lv = np.asarray(live).astype(int)
+    assert (np.diff(lv, axis=0) <= 0).all(), "live mask regressed"
+    # scoring works on the finals
+    winners = np.asarray(jax.device_get(
+        jax.vmap(lambda s: jaxgo.winner(CFG, s))(final)))
+    assert set(winners) <= {-1, 0, 1}
+
+
 def test_terminal_root_backs_up_nothing():
     """A game already ended by two passes: the search must not crash
     and the root (its parent edge is -1) accumulates no edge visits."""
