@@ -347,10 +347,12 @@ def main(argv=None):
                     "framework's players")
     ap.add_argument("--policy", required=True,
                     help="policy model JSON spec")
-    ap.add_argument("--value", help="value model JSON spec (for mcts)")
+    ap.add_argument("--value", help="value model JSON spec "
+                                    "(for mcts / device-mcts)")
     ap.add_argument("--rollout", help="rollout model JSON spec")
     ap.add_argument("--player", default="greedy",
-                    choices=("greedy", "probabilistic", "mcts"))
+                    choices=("greedy", "probabilistic", "mcts",
+                             "device-mcts"))
     ap.add_argument("--temperature", type=float, default=0.1)
     ap.add_argument("--lmbda", type=float, default=0.5)
     ap.add_argument("--playouts", type=int, default=100)

@@ -9,6 +9,7 @@ Re-exports are lazy — see :mod:`rocalphago_tpu.utils.lazy`.
 from rocalphago_tpu.utils.lazy import make_lazy
 
 _EXPORTS = {
+    "DeviceMCTSPlayer": "rocalphago_tpu.search.device_mcts",
     "DeviceTree": "rocalphago_tpu.search.device_mcts",
     "make_device_mcts": "rocalphago_tpu.search.device_mcts",
     "make_mcts_selfplay": "rocalphago_tpu.search.device_mcts",

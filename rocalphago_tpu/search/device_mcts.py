@@ -337,6 +337,64 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     return search
 
 
+class DeviceMCTSPlayer:
+    """GTP/tournament-facing agent over the on-device search.
+
+    ``get_move(pygo.GameState) -> move | None`` (None = pass): the
+    host state is bridged once (:func:`jaxgo.from_pygo`), the whole
+    search runs on device (chunk-driven under the worker watchdog),
+    and the argmax-visits move comes back — two host↔device transfers
+    per move, total. No subtree reuse across moves (slab searches
+    rebuild; see :func:`make_device_mcts`).
+    """
+
+    def __init__(self, value_net, policy_net, n_sim: int = 100,
+                 max_nodes: int | None = None, c_puct: float = 5.0,
+                 sim_chunk: int = 8):
+        self.policy = policy_net
+        self.value = value_net
+        self.board = policy_net.board
+        self._cfg = policy_net.cfg
+        self._chunk = sim_chunk
+        self._n_sim = n_sim
+        self._max_nodes = max_nodes or 2 * n_sim
+        self._c_puct = c_puct
+        # searchers are cached PER KOMI: the search's terminal-node
+        # evaluations score with its GoConfig's komi, and GTP can set
+        # any komi per game — same handling as the host MCTSPlayer's
+        # per-komi rollout programs (search/mcts.py)
+        self._searchers: dict = {}
+
+    def _searcher_for(self, komi: float):
+        if komi not in self._searchers:
+            import dataclasses
+
+            cfg = dataclasses.replace(self._cfg, komi=komi)
+            self._searchers[komi] = (cfg, make_device_mcts(
+                cfg, self.policy.feature_list, self.value.feature_list,
+                self.policy.module.apply, self.value.module.apply,
+                n_sim=self._n_sim, max_nodes=self._max_nodes,
+                c_puct=self._c_puct))
+        return self._searchers[komi]
+
+    def get_move(self, state):
+        import numpy as np
+
+        from rocalphago_tpu.engine import jaxgo as _jaxgo
+
+        cfg, search = self._searcher_for(float(state.komi))
+        root = _jaxgo.from_pygo(cfg, state)
+        roots = jax.tree.map(lambda x: x[None], root)
+        visits, _ = search.run_chunked(
+            self.policy.params, self.value.params, roots, self._chunk)
+        counts = np.asarray(jax.device_get(visits))[0]
+        action = int(counts.argmax())
+        n = cfg.num_points
+        if action >= n or counts[action] == 0:
+            return None                              # pass
+        return divmod(action, cfg.size)
+
+
 def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        value_features: tuple, policy_apply: Callable,
                        value_apply: Callable, batch: int,

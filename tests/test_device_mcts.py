@@ -127,6 +127,28 @@ def test_mcts_selfplay_plays_full_games():
     assert set(winners) <= {-1, 0, 1}
 
 
+def test_device_mcts_player_plays_gtp_game():
+    """The serving wrapper: DeviceMCTSPlayer drives a GTP genmove on a
+    real (tiny) policy/value pair — host state bridged in, device
+    search, vertex back out."""
+    from rocalphago_tpu.interface.gtp import GTPEngine
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol = CNNPolicy(FEATS, board=SIZE, layers=1, filters_per_layer=4)
+    val = CNNValue(VFEATS, board=SIZE, layers=1, filters_per_layer=4)
+    player = DeviceMCTSPlayer(val, pol, n_sim=8, max_nodes=16,
+                              sim_chunk=4)
+    engine = GTPEngine(player)
+    for cmd, ok_prefix in ((f"boardsize {SIZE}", "="),
+                           ("clear_board", "="),
+                           ("genmove b", "= ")):
+        reply, _ = engine.handle(cmd + "\n")
+        assert reply.startswith(ok_prefix), (cmd, reply)
+    vertex = reply.split()[-1]
+    assert vertex.upper() != "RESIGN"
+
+
 def test_terminal_root_backs_up_nothing():
     """A game already ended by two passes: the search must not crash
     and the root (its parent edge is -1) accumulates no edge visits."""
