@@ -17,6 +17,7 @@ import os
 import sys
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from rocalphago_tpu.data import sgf
@@ -92,6 +93,14 @@ def main(argv=None):
     ap.add_argument("--shard", action="store_true",
                     help="shard the game batch over all devices "
                          "(env parallelism across the mesh data axis)")
+    ap.add_argument("--search-sims", type=int, default=0,
+                    help="play every move from an on-device MCTS of "
+                         "this many simulations instead of sampling "
+                         "the raw policy (AlphaZero-style generation; "
+                         "requires --value; incompatible with "
+                         "--opponent/--shard)")
+    ap.add_argument("--value", default=None,
+                    help="value model JSON (with --search-sims)")
     a = ap.parse_args(argv)
     if a.games % 2:
         raise SystemExit("--games must be even (color split)")
@@ -99,7 +108,34 @@ def main(argv=None):
     net = NeuralNetBase.load_model(a.policy)
     opp = NeuralNetBase.load_model(a.opponent) if a.opponent else net
     cfg = net.cfg
-    if a.shard or a.chunk:
+    if a.search_sims:
+        if not a.value:
+            raise SystemExit("--search-sims requires --value")
+        if a.opponent or a.shard:
+            raise SystemExit("--search-sims is self-play with one "
+                             "net (no --opponent/--shard)")
+        from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
+        from rocalphago_tpu.search.selfplay import SelfplayResult
+
+        value = NeuralNetBase.load_model(a.value)
+        # in search mode --chunk bounds SIMULATIONS per compiled
+        # program (the per-ply unit of this path), keeping the flag's
+        # watchdog contract meaningful
+        mcts_run = make_mcts_selfplay(
+            cfg, net.feature_list, value.feature_list,
+            net.module.apply, value.module.apply, batch=a.games,
+            max_moves=a.max_moves, n_sim=a.search_sims,
+            max_nodes=2 * a.search_sims, temperature=a.temperature,
+            sim_chunk=a.chunk or 8)
+
+        def run(params_a, params_b, rng):
+            final, actions, live = mcts_run(params_a, value.params,
+                                            rng)
+            winners = jax.vmap(
+                functools.partial(jaxgo.winner, cfg))(final)
+            return SelfplayResult(final, actions, live, winners,
+                                  live.sum(axis=0, dtype=jnp.int32))
+    elif a.shard or a.chunk:
         from rocalphago_tpu.parallel.mesh import make_mesh
         from rocalphago_tpu.search.selfplay import make_selfplay_chunked
 

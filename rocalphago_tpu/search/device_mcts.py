@@ -364,6 +364,10 @@ class DeviceMCTSPlayer:
         # any komi per game — same handling as the host MCTSPlayer's
         # per-komi rollout programs (search/mcts.py)
         self._searchers: dict = {}
+        # build the default-komi searcher NOW: feature-layout
+        # validation must fail at construction (like build_player's
+        # missing-value guard), not on the first genmove
+        self._searcher_for(self._cfg.komi)
 
     def _searcher_for(self, komi: float):
         if komi not in self._searchers:
@@ -381,6 +385,7 @@ class DeviceMCTSPlayer:
         import numpy as np
 
         from rocalphago_tpu.engine import jaxgo as _jaxgo
+        from rocalphago_tpu.utils.coords import unflatten_idx
 
         cfg, search = self._searcher_for(float(state.komi))
         root = _jaxgo.from_pygo(cfg, state)
@@ -389,10 +394,9 @@ class DeviceMCTSPlayer:
             self.policy.params, self.value.params, roots, self._chunk)
         counts = np.asarray(jax.device_get(visits))[0]
         action = int(counts.argmax())
-        n = cfg.num_points
-        if action >= n or counts[action] == 0:
+        if action >= cfg.num_points or counts[action] == 0:
             return None                              # pass
-        return divmod(action, cfg.size)
+        return unflatten_idx(action, cfg.size)
 
 
 def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
