@@ -17,7 +17,6 @@ import os
 import sys
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from rocalphago_tpu.data import sgf
@@ -87,9 +86,11 @@ def main(argv=None):
     ap.add_argument("--no-sgf", action="store_true",
                     help="summary only (skip SGF files)")
     ap.add_argument("--chunk", type=int, default=0,
-                    help="plies per compiled segment (0 = one "
-                         "monolithic scan; use e.g. 60 on backends "
-                         "that kill long device programs)")
+                    help="compiled-program size bound for backends "
+                         "that kill long device programs: plies per "
+                         "segment (policy mode; 0 = one monolithic "
+                         "scan), or simulations per program with "
+                         "--search-sims (0 = 8)")
     ap.add_argument("--shard", action="store_true",
                     help="shard the game batch over all devices "
                          "(env parallelism across the mesh data axis)")
@@ -102,7 +103,9 @@ def main(argv=None):
     ap.add_argument("--value", default=None,
                     help="value model JSON (with --search-sims)")
     a = ap.parse_args(argv)
-    if a.games % 2:
+    if a.games % 2 and not a.search_sims:
+        # search self-play uses ONE net for both colors — no color
+        # split, so odd batches are fine there
         raise SystemExit("--games must be even (color split)")
 
     net = NeuralNetBase.load_model(a.policy)
@@ -115,7 +118,7 @@ def main(argv=None):
             raise SystemExit("--search-sims is self-play with one "
                              "net (no --opponent/--shard)")
         from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
-        from rocalphago_tpu.search.selfplay import SelfplayResult
+        from rocalphago_tpu.search.selfplay import _finish
 
         value = NeuralNetBase.load_model(a.value)
         # in search mode --chunk bounds SIMULATIONS per compiled
@@ -131,10 +134,9 @@ def main(argv=None):
         def run(params_a, params_b, rng):
             final, actions, live = mcts_run(params_a, value.params,
                                             rng)
-            winners = jax.vmap(
-                functools.partial(jaxgo.winner, cfg))(final)
-            return SelfplayResult(final, actions, live, winners,
-                                  live.sum(axis=0, dtype=jnp.int32))
+            # same result assembly as the policy-mode runners
+            return _finish(cfg, final, actions, live,
+                           score_on_device=True, batch=a.games)
     elif a.shard or a.chunk:
         from rocalphago_tpu.parallel.mesh import make_mesh
         from rocalphago_tpu.search.selfplay import make_selfplay_chunked

@@ -26,6 +26,12 @@ index table ``[M, A]`` — all static shapes; descend and backup are
 per-simulation NN evaluation uses the same nested-feature fusion as
 the host waves (value planes encoded once; the policy forward reads
 the prefix slice when ``value_features == policy_features + color``).
+
+Multi-chip: the search shards over a device mesh BY PLACEMENT ALONE —
+every per-game slab is independent, so passing root states sharded
+over the ``data`` axis (``parallel.mesh.shard_batch``) with replicated
+params shards the whole search, bit-identically
+(``tests/test_device_mcts.py``); no search-code mesh plumbing needed.
 """
 
 from __future__ import annotations
