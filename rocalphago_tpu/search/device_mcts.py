@@ -410,7 +410,8 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        value_apply: Callable, batch: int,
                        max_moves: int, n_sim: int, max_nodes: int,
                        c_puct: float = 5.0, temperature: float = 1.0,
-                       sim_chunk: int = 8):
+                       sim_chunk: int = 8,
+                       record_visits: bool = False):
     """Search-driven self-play: every move of every game comes from a
     fresh :func:`make_device_mcts` search over the batch.
 
@@ -429,7 +430,10 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
     recomputed where a host tree would reuse ~1/A of the subtree).
 
     Returns ``run(params_p, params_v, rng) -> (final GoState,
-    actions i32 [T, B], live bool [T, B])``.
+    actions i32 [T, B], live bool [T, B])`` — with
+    ``record_visits=True``, ``(..., visits i32 [T, B, A])``: the raw
+    root visit counts per ply, the search-policy targets an
+    AlphaZero-style trainer (``training.zero``) learns from.
     """
     search = make_device_mcts(cfg, policy_features, value_features,
                               policy_apply, value_apply, n_sim,
@@ -454,7 +458,7 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
 
     def run(params_p, params_v, rng):
         states = new_states(cfg, batch)
-        actions, lives = [], []
+        actions, lives, visit_seq = [], [], []
         for _ in range(max_moves):
             visits, _ = search.run_chunked(params_p, params_v, states,
                                            sim_chunk)
@@ -462,11 +466,19 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                 states, visits, rng)
             actions.append(action)
             lives.append(live)
+            if record_visits:
+                visit_seq.append(visits)
             if bool(jax.device_get(states.done.all())):
                 break
-        return (states, jnp.stack(actions) if actions
-                else jnp.zeros((0, batch), jnp.int32),
-                jnp.stack(lives) if lives
-                else jnp.zeros((0, batch), bool))
+        n_act = cfg.num_points + 1
+        out = (states,
+               jnp.stack(actions) if actions
+               else jnp.zeros((0, batch), jnp.int32),
+               jnp.stack(lives) if lives
+               else jnp.zeros((0, batch), bool))
+        if record_visits:
+            out += (jnp.stack(visit_seq) if visit_seq
+                    else jnp.zeros((0, batch, n_act), jnp.int32),)
+        return out
 
     return run

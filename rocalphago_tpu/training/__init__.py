@@ -16,6 +16,9 @@ _EXPORTS = {
     "SLTrainer": "rocalphago_tpu.training.sl",
     "ValueConfig": "rocalphago_tpu.training.value",
     "ValueTrainer": "rocalphago_tpu.training.value",
+    "ZeroState": "rocalphago_tpu.training.zero",
+    "init_zero_state": "rocalphago_tpu.training.zero",
+    "make_zero_iteration": "rocalphago_tpu.training.zero",
 }
 
 __getattr__, __dir__, __all__ = make_lazy(__name__, _EXPORTS)

@@ -1,0 +1,196 @@
+"""AlphaZero-style training iteration over the on-device search.
+
+Beyond the reference's scope (its RL trainer REINFORCEs the raw
+policy against a past self; ``AlphaGo/training/
+reinforcement_policy_trainer.py``, SURVEY.md §3.2): this closes the
+modern loop the device search makes possible — self-play games where
+EVERY move comes from the batched on-device MCTS
+(:func:`search.device_mcts.make_mcts_selfplay`), then one update that
+trains the policy toward the search's visit distributions and the
+value net toward the game outcomes:
+
+    loss = CE(policy(s_t), π_t) + MSE(value(s_t), z_t)
+
+with π_t the root visit distribution at ply t and z_t the final
+outcome from ply t's player-to-move perspective.
+
+TPU-native structure (same watchdog discipline as the chunked RL
+iteration): the game phase is the chunk-driven search self-play; the
+training phase REPLAYS the recorded actions through the engine in
+compiled segments, accumulating both nets' gradients in a
+params-shaped carry — constant memory in game length, no
+``[T, B, 19, 19, F]`` plane materialization; only the visit targets
+``[T, B, A]`` are kept (a few MB). One optimizer step per net per
+iteration.
+
+Policy targets and the pass action: the policy net's head covers the
+N board points (pass is an agent-layer decision, reference parity —
+``models/policy.py``), while the search's visit distribution includes
+pass. Pass gets visits only when nothing sensible exists (its prior
+is 0 otherwise), and those plies carry no board signal — so each
+ply's target is the board slice of π renormalized, and plies whose
+board mass is zero (forced passes, finished games) get weight 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from rocalphago_tpu.engine import jaxgo
+from rocalphago_tpu.features.planes import encode, needs_member
+from rocalphago_tpu.features.pyfeatures import output_planes
+from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
+from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
+from rocalphago_tpu.search.selfplay import sensible_mask
+
+
+class ZeroState(NamedTuple):
+    policy_params: dict
+    value_params: dict
+    opt_policy: tuple
+    opt_value: tuple
+    iteration: jax.Array   # int32 []
+    rng: jax.Array         # uint32 key data
+
+
+def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
+                        value_features: tuple, policy_apply: Callable,
+                        value_apply: Callable, tx_policy, tx_value,
+                        batch: int, move_limit: int, n_sim: int,
+                        max_nodes: int, temperature: float = 1.0,
+                        sim_chunk: int = 8, replay_chunk: int = 10):
+    """``(ZeroState) -> (ZeroState, metrics)`` — one full iteration:
+    search self-play, replay-gradient accumulation for both nets, one
+    optimizer step each. Host-driven (chunk-compiled throughout); the
+    search phase and every replay segment stay under the TPU worker
+    watchdog."""
+    n = cfg.num_points
+    selfplay = make_mcts_selfplay(
+        cfg, policy_features, value_features, policy_apply,
+        value_apply, batch, move_limit, n_sim, max_nodes,
+        temperature=temperature, sim_chunk=sim_chunk,
+        record_visits=True)
+
+    n_policy_planes = output_planes(policy_features)
+    vgd = jax.vmap(lambda s: jaxgo.group_data(
+        cfg, s.board, with_member=needs_member(value_features),
+        with_zxor=cfg.enforce_superko, labels=s.labels))
+    venc = jax.vmap(lambda s, g: encode(
+        cfg, s, features=value_features, gd=g))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
+
+    def ply(policy_params, value_params, winners, carry, xs):
+        states, grads_p, grads_v, stats = carry
+        actions_t, live_t, visits_t = xs
+
+        gd = vgd(states)
+        planes = venc(states, gd)
+        sens = vsens(states, gd)
+        # search-policy target: board slice of the root visit
+        # distribution, renormalized (see module docstring)
+        board_counts = visits_t[:, :n].astype(jnp.float32)
+        mass = board_counts.sum(axis=-1)
+        pi = board_counts / jnp.maximum(mass, 1.0)[:, None]
+        w = live_t * (mass > 0)                      # f32-able [B]
+        wf = w.astype(jnp.float32)
+        # outcome from ply t's player-to-move perspective
+        z = (winners * states.turn).astype(jnp.float32)
+
+        def loss_fn(pp, vp):
+            # nested layout: the policy reads the prefix slice of the
+            # value planes (one encode serves both nets, as in search)
+            logits = policy_apply(pp, planes[..., :n_policy_planes])
+            neg = jnp.finfo(logits.dtype).min
+            logp = jax.nn.log_softmax(
+                jnp.where(sens, logits, neg), axis=-1)
+            ce = -(pi * logp).sum(axis=-1)
+            v = value_apply(vp, planes)
+            mse = (v - z) ** 2
+            lp = (wf * ce).sum() / batch
+            lv = (live_t.astype(jnp.float32) * mse).sum() / batch
+            return lp + lv, (lp, lv)
+
+        (gp, gv), (lp, lv) = jax.grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(
+                policy_params, value_params)
+        grads_p = jax.tree.map(jnp.add, grads_p, gp)
+        grads_v = jax.tree.map(jnp.add, grads_v, gv)
+        stats = (stats[0] + lp, stats[1] + lv)
+        # share the ply's one group analysis with the rules step
+        return (vstep(states, actions_t, gd), grads_p, grads_v, stats)
+
+    @jax.jit
+    def replay_segment(policy_params, value_params, winners, carry,
+                       actions, live, visits):
+        # segment length rides the xs shapes (one compile per distinct
+        # segment length — the fixed chunk plus at most one remainder)
+        def body(c, xs):
+            return ply(policy_params, value_params, winners, c,
+                       xs), None
+
+        carry, _ = lax.scan(body, carry, (actions, live, visits))
+        return carry
+
+    @jax.jit
+    def apply_updates(state: ZeroState, grads_p, grads_v, stats,
+                      winners, num_moves, key):
+        up, opt_p = tx_policy.update(grads_p, state.opt_policy,
+                                     state.policy_params)
+        uv, opt_v = tx_value.update(grads_v, state.opt_value,
+                                    state.value_params)
+        metrics = {
+            "policy_loss": stats[0],
+            "value_loss": stats[1],
+            "black_win_rate": (winners > 0).mean(),
+            "draw_rate": (winners == 0).mean(),
+            "mean_moves": num_moves.astype(jnp.float32).mean(),
+        }
+        return ZeroState(
+            optax.apply_updates(state.policy_params, up),
+            optax.apply_updates(state.value_params, uv),
+            opt_p, opt_v, state.iteration + 1, pack_rng(key)), metrics
+
+    def iteration(state: ZeroState):
+        key = unpack_rng(state.rng)
+        key, game_key = jax.random.split(key)
+
+        final, actions, live, visits = selfplay(
+            state.policy_params, state.value_params, game_key)
+        winners = jax.vmap(
+            functools.partial(jaxgo.winner, cfg))(final)
+        wf = winners.astype(jnp.float32)
+
+        states = jaxgo.new_states(cfg, batch)
+        grads_p = jax.tree.map(jnp.zeros_like, state.policy_params)
+        grads_v = jax.tree.map(jnp.zeros_like, state.value_params)
+        stats = (jnp.float32(0), jnp.float32(0))
+        live_f = live.astype(jnp.float32)
+        plies = actions.shape[0]
+        carry = (states, grads_p, grads_v, stats)
+        for offset in range(0, plies, replay_chunk):
+            sl = slice(offset, offset + replay_chunk)
+            carry = replay_segment(
+                state.policy_params, state.value_params, wf, carry,
+                actions[sl], live_f[sl], visits[sl])
+        _, grads_p, grads_v, stats = carry
+
+        num_moves = live.sum(axis=0, dtype=jnp.int32)
+        return apply_updates(state, grads_p, grads_v, stats, winners,
+                             num_moves, key)
+
+    return iteration
+
+
+def init_zero_state(policy_params, value_params, tx_policy, tx_value,
+                    seed: int = 0) -> ZeroState:
+    return ZeroState(policy_params, value_params,
+                     tx_policy.init(policy_params),
+                     tx_value.init(value_params),
+                     jnp.int32(0), pack_rng(jax.random.key(seed)))
