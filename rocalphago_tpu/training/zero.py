@@ -194,3 +194,110 @@ def init_zero_state(policy_params, value_params, tx_policy, tx_value,
                      tx_policy.init(policy_params),
                      tx_value.init(value_params),
                      jnp.int32(0), pack_rng(jax.random.key(seed)))
+
+
+def run_training(argv=None) -> dict:
+    """CLI: ``python -m rocalphago_tpu.training.zero policy.json
+    value.json out_dir [...]`` — same entry-point shape as the other
+    trainers (argparse, JSONL metrics, per-save model.json exports
+    loadable by GTP/tournament)."""
+    import argparse
+    import json
+    import os
+    import time
+
+    from rocalphago_tpu.io.checkpoint import TrainCheckpointer
+    from rocalphago_tpu.io.metrics import MetricsLogger
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+    from rocalphago_tpu.parallel import mesh as meshlib
+
+    # multi-host bring-up (DCN); no-op single-process — same shape as
+    # the sibling trainers
+    meshlib.distributed_init()
+
+    ap = argparse.ArgumentParser(
+        description="AlphaZero-style training: device-MCTS self-play "
+                    "+ visit-distribution policy targets")
+    ap.add_argument("policy_json")
+    ap.add_argument("value_json")
+    ap.add_argument("out_dir")
+    ap.add_argument("--learning-rate", type=float, default=0.001)
+    ap.add_argument("--game-batch", type=int, default=8)
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--save-every", type=int, default=5)
+    ap.add_argument("--move-limit", type=int, default=500)
+    ap.add_argument("--sims", type=int, default=64)
+    ap.add_argument("--max-nodes", type=int, default=None)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--sim-chunk", type=int, default=8)
+    ap.add_argument("--replay-chunk", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    a = ap.parse_args(argv)
+
+    policy = NeuralNetBase.load_model(a.policy_json)
+    value = NeuralNetBase.load_model(a.value_json)
+    if policy.board != value.board:
+        raise SystemExit(
+            f"policy is {policy.board}x{policy.board} but value is "
+            f"{value.board}x{value.board} — the nets must share a "
+            "board size")
+    tx_p = optax.sgd(a.learning_rate)
+    tx_v = optax.sgd(a.learning_rate)
+    iteration = make_zero_iteration(
+        policy.cfg, policy.feature_list, value.feature_list,
+        policy.module.apply, value.module.apply, tx_p, tx_v,
+        batch=a.game_batch, move_limit=a.move_limit, n_sim=a.sims,
+        max_nodes=a.max_nodes or 2 * a.sims,
+        temperature=a.temperature, sim_chunk=a.sim_chunk,
+        replay_chunk=a.replay_chunk)
+    state = init_zero_state(policy.params, value.params, tx_p, tx_v,
+                            seed=a.seed)
+
+    os.makedirs(a.out_dir, exist_ok=True)
+    # artifact writes are coordinator-only in multi-host runs; Orbax
+    # checkpoint saves stay all-process (sibling-trainer convention)
+    coord = meshlib.is_coordinator()
+    ckpt = TrainCheckpointer(os.path.join(a.out_dir, "checkpoints"))
+    metrics = MetricsLogger(
+        os.path.join(a.out_dir, "metrics.jsonl") if coord else None,
+        echo=coord)
+    start = 0
+    restored, _ = ckpt.restore(jax.device_get(state))
+    if restored is not None:
+        state = ZeroState(*restored)
+        start = int(state.iteration)
+        metrics.log("resume", iteration=start)
+    final = {}
+
+    def export(it):
+        if not coord:
+            return
+        for net, params, name in ((policy, state.policy_params,
+                                   "policy"),
+                                  (value, state.value_params,
+                                   "value")):
+            net.params = jax.device_get(params)
+            weights = os.path.join(
+                a.out_dir, f"{name}.{it:05d}.flax.msgpack")
+            net.save_model(
+                os.path.join(a.out_dir, f"{name}.json"), weights)
+
+    for it in range(start, a.iterations):
+        t0 = time.time()
+        state, m = iteration(state)
+        entry = {"iteration": it,
+                 **{k: float(jax.device_get(v)) for k, v in m.items()},
+                 "games_per_min": a.game_batch * 60.0
+                 / max(time.time() - t0, 1e-9)}
+        metrics.log("iteration", **entry)
+        final = entry
+        if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
+            ckpt.save(it + 1, jax.device_get(state))
+            export(it + 1)
+    ckpt.wait()
+    print(json.dumps(final))
+    return final
+
+
+if __name__ == "__main__":
+    run_training()
