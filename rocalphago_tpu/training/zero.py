@@ -198,22 +198,28 @@ def init_zero_state(policy_params, value_params, tx_policy, tx_value,
 
 def run_training(argv=None) -> dict:
     """CLI: ``python -m rocalphago_tpu.training.zero policy.json
-    value.json out_dir [...]`` — same entry-point shape as the other
-    trainers (argparse, JSONL metrics, per-save model.json exports
-    loadable by GTP/tournament)."""
+    value.json out_dir [...]`` — the sibling trainers' operational
+    surface (argparse, Orbax checkpoint/resume, JSONL metrics +
+    metadata.json, per-save model.json exports loadable by
+    GTP/tournament).
+
+    SINGLE-PROCESS trainer: unlike ``rl.py`` this CLI does not yet
+    replicate state over a mesh, so multi-host launches would train N
+    independent copies — run it on one process. (The underlying
+    search already shards over a mesh by root placement; wiring the
+    iteration like ``RLTrainer`` is the extension point.)"""
     import argparse
     import json
     import os
+    import sys
     import time
 
-    from rocalphago_tpu.io.checkpoint import TrainCheckpointer
+    from rocalphago_tpu.io.checkpoint import (
+        MetadataWriter,
+        TrainCheckpointer,
+    )
     from rocalphago_tpu.io.metrics import MetricsLogger
     from rocalphago_tpu.models.nn_util import NeuralNetBase
-    from rocalphago_tpu.parallel import mesh as meshlib
-
-    # multi-host bring-up (DCN); no-op single-process — same shape as
-    # the sibling trainers
-    meshlib.distributed_init()
 
     ap = argparse.ArgumentParser(
         description="AlphaZero-style training: device-MCTS self-play "
@@ -254,13 +260,12 @@ def run_training(argv=None) -> dict:
                             seed=a.seed)
 
     os.makedirs(a.out_dir, exist_ok=True)
-    # artifact writes are coordinator-only in multi-host runs; Orbax
-    # checkpoint saves stay all-process (sibling-trainer convention)
-    coord = meshlib.is_coordinator()
     ckpt = TrainCheckpointer(os.path.join(a.out_dir, "checkpoints"))
     metrics = MetricsLogger(
-        os.path.join(a.out_dir, "metrics.jsonl") if coord else None,
-        echo=coord)
+        os.path.join(a.out_dir, "metrics.jsonl"), echo=True)
+    meta = MetadataWriter(
+        os.path.join(a.out_dir, "metadata.json"),
+        header={"cmd": " ".join(sys.argv), "config": vars(a)})
     start = 0
     restored, _ = ckpt.restore(jax.device_get(state))
     if restored is not None:
@@ -270,8 +275,6 @@ def run_training(argv=None) -> dict:
     final = {}
 
     def export(it):
-        if not coord:
-            return
         for net, params, name in ((policy, state.policy_params,
                                    "policy"),
                                   (value, state.value_params,
@@ -290,6 +293,7 @@ def run_training(argv=None) -> dict:
                  "games_per_min": a.game_batch * 60.0
                  / max(time.time() - t0, 1e-9)}
         metrics.log("iteration", **entry)
+        meta.record_epoch(entry)
         final = entry
         if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
             ckpt.save(it + 1, jax.device_get(state))

@@ -60,3 +60,38 @@ def test_zero_iteration_trains_both_nets(nets):
     assert int(jax.device_get(newer.iteration)) == 2
     assert not np.array_equal(np.asarray(new.rng),
                               np.asarray(newer.rng))
+
+
+def test_zero_cli_trains_saves_and_resumes(tmp_path, nets):
+    """The trainer CLI end to end on tiny specs: metrics written,
+    GTP-loadable exports, and a rerun with a higher --iterations
+    resumes from the checkpoint instead of restarting."""
+    import json
+
+    from rocalphago_tpu.training.zero import run_training
+
+    pol, val = nets
+    pj, vj = str(tmp_path / "p.json"), str(tmp_path / "v.json")
+    pol.save_model(pj)
+    val.save_model(vj)
+    out = str(tmp_path / "out")
+    args = [pj, vj, out, "--game-batch", "2", "--iterations", "1",
+            "--move-limit", "16", "--sims", "4", "--sim-chunk", "2",
+            "--save-every", "1"]
+    final = run_training(args)
+    assert final["iteration"] == 0
+
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+
+    exported = NeuralNetBase.load_model(str(tmp_path / "out"
+                                            / "policy.json"))
+    assert exported.board == SIZE
+
+    args[args.index("--iterations") + 1] = "2"
+    final = run_training(args)
+    assert final["iteration"] == 1          # resumed, ran only iter 1
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "out" / "metrics.jsonl").read_text()
+             .splitlines()]
+    assert any(e["event"] == "resume" and e["iteration"] == 1
+               for e in lines)
