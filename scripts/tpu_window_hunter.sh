@@ -1,0 +1,94 @@
+#!/bin/bash
+# TPU window hunter (round 3): the axon tunnel flaps — minutes of
+# health between ~25-minute outages (a client hangs in backend init
+# and then raises UNAVAILABLE). A fixed-order sweep burns each healthy
+# window on whatever step it happens to be stuck at, and every step
+# attempted during an outage costs a ~25-minute init hang. This driver
+# instead:
+#  - PROBE-GATES every step: a 90s-bounded init+matmul probe (same
+#    kill-safety protocol as bench.py's _preflight — the timeout-kill
+#    can only land on a client hung in backend init, which has no
+#    device program in flight and cannot wedge the tunnel);
+#  - runs the single HIGHEST-PRIORITY remaining bench per healthy
+#    probe, one client on the tunnel at a time;
+#  - never kills a step once it is past the probe (every program here
+#    is chunked/sized for the ~40s worker watchdog);
+#  - records completed steps in $STATE so a restart resumes.
+#
+# Usage: bash scripts/tpu_window_hunter.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-benchmarks/tpu_hunt_r3}
+STATE="$LOG/done"
+mkdir -p "$LOG" "$STATE"
+
+probe() {
+    # bounded: rc 0/3 = backend alive (3 = startup ate the dispatch
+    # window — alive but slow); timeout/other = down. Mirrors
+    # bench.py::_preflight.
+    timeout 90 python - <<'EOF' >>"$LOG/probe.log" 2>&1
+import sys, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+jax.devices()
+if time.time() - t0 > 60:
+    sys.exit(3)
+x = jnp.ones((256, 256)); print(float((x @ x).sum()))
+EOF
+    rc=$?
+    [ $rc -eq 0 ] || [ $rc -eq 3 ]
+}
+
+run() {
+    name=$1; shift
+    [ -e "$STATE/$name" ] && return 0
+    echo "=== $name: $* [$(date +%H:%M:%S)]" | tee -a "$LOG/hunt.log"
+    "$@" >>"$LOG/hunt.log" 2>&1
+    rc=$?
+    echo "    rc=$rc [$(date +%H:%M:%S)]" | tee -a "$LOG/hunt.log"
+    if [ $rc -eq 0 ]; then
+        touch "$STATE/$name"
+    fi
+    # crashed-worker self-recovery grace (~15s) before the next client
+    sleep 15
+    return $rc
+}
+
+# headline note: bench.py falls back to CPU when the TPU dies
+# mid-attempt; only a platform=tpu result marks that step done.
+STEPS="headline train preprocess chase_xla chase_pls selfplay devmcts9 mcts19 mcts19r rl"
+n_steps=$(echo $STEPS | wc -w)
+deadline=$(( $(date +%s) + ${HUNT_BUDGET_S:-28800} ))
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+    n_done=$(ls "$STATE" | wc -l)
+    if [ "$n_done" -eq "$n_steps" ]; then
+        echo "hunt complete [$(date +%H:%M:%S)]" | tee -a "$LOG/hunt.log"
+        break
+    fi
+    if ! probe; then
+        sleep 45
+        continue
+    fi
+    echo "--- window open ($n_done/$n_steps done) [$(date +%H:%M:%S)]" \
+        | tee -a "$LOG/hunt.log"
+    # one pass over the remaining steps; each step is itself
+    # probe-gated so a window that closes mid-pass stops cheaply
+    for s in $STEPS; do
+        [ -e "$STATE/$s" ] && continue
+        case $s in
+            headline)   run headline env _GRAFT_BENCH_MAX_MOVES=300 bash -c 'python bench.py | tail -1 | tee /dev/stderr | grep -q "\"platform\": \"tpu\""' ;;
+            train)      run train      python benchmarks/bench_train.py --batch-sweep 64,256,1024 --reps 3 ;;
+            preprocess) run preprocess python benchmarks/bench_preprocess.py --reps 2 ;;
+            chase_xla)  run chase_xla  python benchmarks/bench_chase.py --reps 2 ;;
+            chase_pls)  run chase_pls  env ROCALPHAGO_PALLAS_CHASE=1 python benchmarks/bench_chase.py --reps 2 ;;
+            selfplay)   run selfplay   python benchmarks/bench_selfplay.py --batch-sweep 16,64,256 --reps 2 ;;
+            devmcts9)   run devmcts9   python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
+            mcts19)     run mcts19     python benchmarks/bench_mcts.py --board 19 --playouts 48 --reps 2 ;;
+            mcts19r)    run mcts19r    python benchmarks/bench_mcts.py --board 19 --playouts 48 --lmbda 0.5 --device-rollout --reps 2 ;;
+            rl)         run rl         python benchmarks/bench_rl.py --batch 16 --moves 100 --chunk 10 --reps 1 ;;
+        esac || break   # step failed → backend likely died → reprobe
+        probe || break
+    done
+done
+echo "hunter exiting: $(ls "$STATE" | wc -l)/$n_steps done [$(date +%H:%M:%S)]" | tee -a "$LOG/hunt.log"
