@@ -214,10 +214,16 @@ def random_game_states(cfg, batch: int, moves: int, rng_key):
     import jax
     import jax.numpy as jnp
 
-    from rocalphago_tpu.engine.jaxgo import legal_mask, new_states, step
+    from rocalphago_tpu.engine.jaxgo import (
+        legal_mask,
+        new_states,
+        step,
+        vgroup_data,
+    )
 
     vstep = jax.vmap(functools.partial(step, cfg))
     vlegal = jax.vmap(functools.partial(legal_mask, cfg))
+    vgd = vgroup_data(cfg, with_zxor=cfg.enforce_superko)
 
     @jax.jit
     def run(rng):
@@ -226,13 +232,16 @@ def random_game_states(cfg, batch: int, moves: int, rng_key):
         def ply(carry, _):
             states, rng = carry
             rng, sub = jax.random.split(rng)
-            legal = vlegal(states)[:, :-1]
+            # share one group analysis between legality and step — the
+            # same structure as the real self-play loop
+            gd = vgd(states)
+            legal = vlegal(states, gd)[:, :-1]
             logits = jnp.where(legal, 0.0, -1e30)
             action = jnp.where(
                 legal.any(-1),
                 jax.random.categorical(sub, logits, axis=-1),
                 cfg.num_points).astype(jnp.int32)
-            return (vstep(states, action), rng), None
+            return (vstep(states, action, gd), rng), None
 
         (states, _), _ = jax.lax.scan(ply, (states, rng), length=moves)
         return states

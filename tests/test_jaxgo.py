@@ -83,6 +83,58 @@ def test_random_game_differential(size, superko):
         assert jwin == pst.get_winner()
 
 
+def test_dense_engine_parity_differential(monkeypatch):
+    """The dense (shift/matmul) group-analysis formulation — the TPU
+    default, which CPU CI otherwise never executes — must match pygo
+    move-for-move exactly like the scatter path does, and must agree
+    with the scatter path on the full GroupData contract."""
+    from rocalphago_tpu.engine.jaxgo import group_data
+
+    monkeypatch.setenv("ROCALPHAGO_ENGINE_DENSE", "1")
+    jaxgo._dense_engine.cache_clear()
+    try:
+        assert jaxgo._dense_engine()
+        cfg = GoConfig(size=5, komi=5.5)
+        eng = GoEngine(cfg)  # fresh closures → traces the dense branch
+        rng = np.random.default_rng(7)
+        jst = eng.init()
+        pst = pygo.GameState(size=5, komi=5.5)
+        for move_i in range(120):
+            jmask = np.asarray(eng.legal_mask(jst))
+            assert jmask[:-1].tolist() == py_legal_points(pst).tolist(), (
+                f"dense legality diverged at move {move_i}")
+            legal_idx = np.flatnonzero(jmask[:-1])
+            if len(legal_idx) == 0 or rng.random() < 0.03:
+                action = cfg.num_points
+                pst.do_move(pygo.PASS_MOVE)
+            else:
+                action = int(rng.choice(legal_idx))
+                pst.do_move(divmod(action, cfg.size))
+            jst = eng.step(jst, np.int32(action))
+            assert py_board_flat(pst).tolist() == np.asarray(
+                jst.board).tolist()
+            if move_i % 10 == 0:
+                dense = group_data(cfg, jst.board, with_member=True,
+                                   with_zxor=True, labels=jst.labels)
+                monkeypatch.setenv("ROCALPHAGO_ENGINE_DENSE", "0")
+                jaxgo._dense_engine.cache_clear()
+                scat = group_data(cfg, jst.board, with_member=True,
+                                  with_zxor=True, labels=jst.labels)
+                monkeypatch.setenv("ROCALPHAGO_ENGINE_DENSE", "1")
+                jaxgo._dense_engine.cache_clear()
+                for a, b, name in [
+                        (dense.sizes, scat.sizes, "sizes"),
+                        (dense.lib_counts, scat.lib_counts, "lib_counts"),
+                        (dense.member, scat.member, "member"),
+                        (dense.zxor, scat.zxor, "zxor")]:
+                    assert np.asarray(a).tolist() == np.asarray(
+                        b).tolist(), f"{name} diverged at move {move_i}"
+            if pst.is_end_of_game:
+                break
+    finally:
+        jaxgo._dense_engine.cache_clear()  # monkeypatch restored the env
+
+
 class TestUnit:
     def setup_method(self):
         self.cfg = GoConfig(size=5, komi=0.0)
