@@ -91,7 +91,24 @@ def _measure() -> None:
             batch, mm or max_moves, chunk=chunk, temperature=1.0,
             score_on_device=False)
 
-    if on_tpu or os.environ.get("_GRAFT_BENCH_FORCE_ADAPTIVE") == "1":
+    # operator override "batch,chunk": skip the adaptive probe
+    # entirely — on a flapping tunnel the probe's extra programs
+    # (mid-game seeding + one per candidate batch) each pay a fresh
+    # compile, which can eat a whole healthy window; a fixed config
+    # plays full games with ONE compiled program. Only honored when
+    # the child really is on TPU: a TPU-sized batch on the host CPU
+    # (explicit fallback or a silent plugin fallback) would blow the
+    # attempt budget and cost the run its liveness number.
+    fixed = os.environ.get("_GRAFT_BENCH_FIXED", "") if on_tpu else ""
+    try:
+        fixed_cfg = tuple(int(v) for v in fixed.split(","))
+        if len(fixed_cfg) != 2:
+            fixed_cfg = None
+    except ValueError:
+        fixed_cfg = None
+    if fixed_cfg:
+        batch, chunk = fixed_cfg
+    elif on_tpu or os.environ.get("_GRAFT_BENCH_FORCE_ADAPTIVE") == "1":
         # ADAPTIVE sizing: the tunnel's worker crashes past ~40s of
         # device execution, and per-ply cost per batch size moves with
         # every engine/encoder optimization — so probe instead of

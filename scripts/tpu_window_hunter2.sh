@@ -1,0 +1,150 @@
+#!/bin/bash
+# TPU window hunter v2 (round 3). The v1 hunter ran the expensive
+# headline bench FIRST in every healthy window; with the tunnel
+# flapping (minutes of health between ~25-min init-hang outages) that
+# starves every other measurement: the 03:16 window was spent on a
+# headline attempt whose seeding/probe programs each paid a fresh
+# compile, hung when the window closed mid-run, and banked nothing.
+# v2 fixes the ordering and the granularity:
+#  - steps are COST-ASCENDING and fine-grained (one batch size per
+#    step), so even a 2-minute window banks a number;
+#  - the headline runs LAST, first with a FIXED batch/chunk config
+#    (one compiled program; batch picked from the day's on-chip
+#    self-play rates in results.jsonl), then — stretch goal — the
+#    driver-equivalent adaptive run;
+#  - same kill-safety protocol as v1: a 90s-bounded init+matmul probe
+#    gates every step (a timeout-kill can only land on a client hung
+#    in backend init — nothing in flight, cannot wedge the tunnel);
+#    no step is ever killed past its probe; completed steps checkpoint
+#    to $STATE so restarts resume.
+#
+# Usage: bash scripts/tpu_window_hunter2.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-benchmarks/tpu_hunt2_r3}
+STATE="$LOG/done"
+mkdir -p "$LOG" "$STATE"
+
+probe() {
+    timeout 90 python - <<'EOF' >>"$LOG/probe.log" 2>&1
+import sys, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+jax.devices()
+if time.time() - t0 > 60:
+    sys.exit(3)
+x = jnp.ones((256, 256)); print(float((x @ x).sum()))
+EOF
+    rc=$?
+    echo "probe rc=$rc [$(date +%H:%M:%S)]" >>"$LOG/probe.log"
+    [ $rc -eq 0 ] || [ $rc -eq 3 ]
+}
+
+run() {
+    name=$1; shift
+    [ -e "$STATE/$name" ] && return 0
+    echo "=== $name: $* [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
+    "$@" >>"$LOG/hunt.log" 2>&1
+    rc=$?
+    echo "    rc=$rc [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
+    [ $rc -eq 0 ] && touch "$STATE/$name"
+    sleep 15
+    return $rc
+}
+
+# best self-play batch from today's on-chip records (falls back to
+# 64; tolerates missing file, partial lines, stale days)
+best_batch() {
+    TODAY=$(date +%Y-%m-%d) python - <<'EOF'
+import json, os
+best, rate = 64, -1.0
+today = os.environ.get("TODAY", "")
+try:
+    for line in open("benchmarks/results.jsonl"):
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        if (r.get("metric") == "selfplay_ply_program"
+                and r.get("platform") == "tpu"
+                and r.get("date", "") >= today
+                and r.get("value", 0) > rate):
+            best, rate = r.get("batch", 64), r["value"]
+except OSError:
+    pass
+print(best)
+EOF
+}
+
+SPECS=benchmarks/tpu_extra_r3   # tiny 9x9 nets for the tournament smoke
+
+# spec JSONs reference sibling .flax.msgpack weight files — regenerate
+# unless all four exist (generation is host-side CPU; never touches
+# the tunnel)
+make_specs() {
+    [ -f "$SPECS/p9.json" ] && [ -f "$SPECS/p9.flax.msgpack" ] && \
+    [ -f "$SPECS/v9.json" ] && [ -f "$SPECS/v9.flax.msgpack" ] && return 0
+    mkdir -p "$SPECS"
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m \
+        rocalphago_tpu.models.specs policy --out "$SPECS/p9.json" \
+        --board 9 --layers 3 --filters 32 >>"$LOG/hunt.log" 2>&1 && \
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m \
+        rocalphago_tpu.models.specs value --out "$SPECS/v9.json" \
+        --board 9 --layers 3 --filters 32 >>"$LOG/hunt.log" 2>&1
+}
+make_specs
+
+STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
+preprocess chase_xla chase_pls devmcts9 selfplay16 selfplay64 selfplay256 \
+mcts19 mcts19r rl engine_trace train_trace preprocess_trace tournament \
+headline_fixed headline"
+n_steps=$(echo $STEPS | wc -w)
+deadline=$(( $(date +%s) + ${HUNT_BUDGET_S:-36000} ))
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+    n_done=$(ls "$STATE" | wc -l)
+    if [ "$n_done" -eq "$n_steps" ]; then
+        echo "hunt complete [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
+        break
+    fi
+    if ! probe; then
+        sleep 45
+        continue
+    fi
+    echo "--- window open ($n_done/$n_steps done) [$(date +%H:%M:%S)]" \
+        >>"$LOG/hunt.log"
+    for s in $STEPS; do
+        [ -e "$STATE/$s" ] && continue
+        case $s in
+            train64)     run train64     python benchmarks/bench_train.py --batch 64 --reps 3 ;;
+            train256)    run train256    python benchmarks/bench_train.py --batch 256 --reps 3 ;;
+            train1024)   run train1024   python benchmarks/bench_train.py --batch 1024 --reps 3 ;;
+            engine_dense)   run engine_dense   env ROCALPHAGO_ENGINE_DENSE=1 python benchmarks/bench_engine.py --batch 1024 --moves 64 --reps 2 ;;
+            engine_scatter) run engine_scatter env ROCALPHAGO_ENGINE_DENSE=0 python benchmarks/bench_engine.py --batch 1024 --moves 64 --reps 2 ;;
+            rollout)     run rollout     python benchmarks/bench_rollout.py --reps 3 ;;
+            preprocess)  run preprocess  python benchmarks/bench_preprocess.py --reps 2 ;;
+            chase_xla)   run chase_xla   python benchmarks/bench_chase.py --reps 2 ;;
+            chase_pls)   run chase_pls   env ROCALPHAGO_PALLAS_CHASE=1 python benchmarks/bench_chase.py --reps 2 ;;
+            devmcts9)    run devmcts9    python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
+            selfplay16)  run selfplay16  python benchmarks/bench_selfplay.py --batch-sweep 16 --reps 2 ;;
+            selfplay64)  run selfplay64  python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;
+            selfplay256) run selfplay256 python benchmarks/bench_selfplay.py --batch-sweep 256 --reps 2 ;;
+            mcts19)      run mcts19      python benchmarks/bench_mcts.py --board 19 --playouts 48 --reps 2 ;;
+            mcts19r)     run mcts19r     python benchmarks/bench_mcts.py --board 19 --playouts 48 --lmbda 0.5 --device-rollout --reps 2 ;;
+            rl)          run rl          python benchmarks/bench_rl.py --batch 16 --moves 100 --chunk 10 --reps 1 ;;
+            engine_trace)     run engine_trace     python benchmarks/bench_engine.py --batch 1024 --moves 64 --reps 1 --profile "$LOG/trace_engine" ;;
+            train_trace)      run train_trace      python benchmarks/bench_train.py --batch 1024 --reps 1 --profile "$LOG/trace_train" ;;
+            preprocess_trace) run preprocess_trace python benchmarks/bench_preprocess.py --reps 1 --profile "$LOG/trace_preprocess" ;;
+            tournament)  run tournament  python -m rocalphago_tpu.interface.tournament "mcts:$SPECS/p9.json:$SPECS/v9.json" "greedy:$SPECS/p9.json" --games 1 --board 9 --playouts 16 --move-limit 60 --log "$LOG/tournament.jsonl" ;;
+            headline_fixed)
+                B=$(best_batch)
+                run headline_fixed env _GRAFT_BENCH_FIXED="$B,10" _GRAFT_BENCH_BUDGET_S=420 \
+                    bash -c 'python bench.py | tail -1 | tee -a '"$LOG"'/hunt.log | grep -q "\"platform\": \"tpu\""' ;;
+            headline)
+                run headline env _GRAFT_BENCH_MAX_MOVES=300 \
+                    bash -c 'python bench.py | tail -1 | tee -a '"$LOG"'/hunt.log | grep -q "\"platform\": \"tpu\""' ;;
+        esac || break   # step failed -> backend likely died -> reprobe
+        probe || break
+    done
+done
+echo "hunter v2 exiting: $(ls "$STATE" | wc -l)/$n_steps done [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
