@@ -102,10 +102,16 @@ def _measure() -> None:
     fixed = os.environ.get("_GRAFT_BENCH_FIXED", "") if on_tpu else ""
     try:
         fixed_cfg = tuple(int(v) for v in fixed.split(","))
-        if len(fixed_cfg) != 2:
+        if len(fixed_cfg) != 2 or min(fixed_cfg) <= 0:
             fixed_cfg = None
     except ValueError:
         fixed_cfg = None
+    if fixed and not fixed_cfg:
+        # fall through to the adaptive probe, but say why — a silent
+        # discard here burns a flapping-tunnel window undiagnosed
+        print(f"bench: ignoring malformed _GRAFT_BENCH_FIXED={fixed!r}"
+              " (want 'batch,chunk' positive ints); running adaptive",
+              file=sys.stderr)
     if fixed_cfg:
         batch, chunk = fixed_cfg
     elif on_tpu or os.environ.get("_GRAFT_BENCH_FORCE_ADAPTIVE") == "1":

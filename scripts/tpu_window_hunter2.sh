@@ -26,28 +26,47 @@ STATE="$LOG/done"
 mkdir -p "$LOG" "$STATE"
 
 probe() {
-    timeout 90 python - <<'EOF' >>"$LOG/probe.log" 2>&1
-import sys, time
-t0 = time.time()
-import jax, jax.numpy as jnp
-jax.devices()
-if time.time() - t0 > 60:
-    sys.exit(3)
-x = jnp.ones((256, 256)); print(float((x @ x).sum()))
-EOF
+    # the shared kill-safe probe: rc 0 = healthy TPU, 3 = backend
+    # alive but startup ate the dispatch window (counts as alive),
+    # 4 = matmul ran on the WRONG platform (silent CPU fallback —
+    # must NOT open the window, or every step would bank host-CPU
+    # numbers as TPU results), timeout/other = down. The timeout-kill
+    # is safe: tpu_probe refuses to dispatch after a slow startup, so
+    # a kill can only land on a client with nothing in flight.
+    timeout 90 python scripts/tpu_probe.py >>"$LOG/probe.log" 2>&1
     rc=$?
     echo "probe rc=$rc [$(date +%H:%M:%S)]" >>"$LOG/probe.log"
     [ $rc -eq 0 ] || [ $rc -eq 3 ]
 }
 
+# a step that keeps failing must not starve everything behind it
+# (cost-ascending order means the headline is LAST): after FAILCAP
+# consecutive failures a step is skipped for the rest of the hunt.
+FAILCAP=${FAILCAP:-4}
+
+fails() { cat "$STATE/fail_$1" 2>/dev/null || echo 0; }
+
+skippable() {     # done, or failed out
+    [ -e "$STATE/$1" ] && return 0
+    [ "$(fails "$1")" -ge "$FAILCAP" ]
+}
+
 run() {
     name=$1; shift
-    [ -e "$STATE/$name" ] && return 0
+    skippable "$name" && return 0
     echo "=== $name: $* [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
     "$@" >>"$LOG/hunt.log" 2>&1
     rc=$?
     echo "    rc=$rc [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
-    [ $rc -eq 0 ] && touch "$STATE/$name"
+    if [ $rc -eq 0 ]; then
+        touch "$STATE/$name"
+    else
+        echo $(( $(fails "$name") + 1 )) >"$STATE/fail_$name"
+        if [ "$(fails "$name")" -ge "$FAILCAP" ]; then
+            echo "    $name failed out after $FAILCAP tries" \
+                >>"$LOG/hunt.log"
+        fi
+    fi
     sleep 15
     return $rc
 }
@@ -102,9 +121,14 @@ n_steps=$(echo $STEPS | wc -w)
 deadline=$(( $(date +%s) + ${HUNT_BUDGET_S:-36000} ))
 
 while [ "$(date +%s)" -lt "$deadline" ]; do
-    n_done=$(ls "$STATE" | wc -l)
-    if [ "$n_done" -eq "$n_steps" ]; then
-        echo "hunt complete [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
+    n_done=0; remaining=0
+    for s in $STEPS; do
+        [ -e "$STATE/$s" ] && n_done=$((n_done + 1))
+        skippable "$s" || remaining=$((remaining + 1))
+    done
+    if [ "$remaining" -eq 0 ]; then
+        echo "hunt complete: $n_done/$n_steps done (rest failed out)" \
+            "[$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
         break
     fi
     if ! probe; then
@@ -114,7 +138,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     echo "--- window open ($n_done/$n_steps done) [$(date +%H:%M:%S)]" \
         >>"$LOG/hunt.log"
     for s in $STEPS; do
-        [ -e "$STATE/$s" ] && continue
+        skippable "$s" && continue
         case $s in
             train64)     run train64     python benchmarks/bench_train.py --batch 64 --reps 3 ;;
             train256)    run train256    python benchmarks/bench_train.py --batch 256 --reps 3 ;;
@@ -147,4 +171,4 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         probe || break
     done
 done
-echo "hunter v2 exiting: $(ls "$STATE" | wc -l)/$n_steps done [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
+echo "hunter v2 exiting: $(ls "$STATE" | grep -cv '^fail_')/$n_steps done [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"

@@ -40,3 +40,64 @@ def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
     # claim a ratio against the full-game north star (VERDICT r2)
     assert rec["truncated"] is True
     assert rec["vs_baseline"] is None
+
+
+def test_fixed_override_ignored_off_tpu(monkeypatch):
+    """_GRAFT_BENCH_FIXED must not leak into a CPU child: a TPU-sized
+    batch on host would blow the liveness fallback's budget."""
+    monkeypatch.setenv("_GRAFT_BENCH_FIXED", "1024,10")
+    monkeypatch.setenv("_GRAFT_BENCH_MAX_MOVES", "4")
+    monkeypatch.syspath_prepend(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench._measure()
+    rec = json.loads([ln for ln in out.getvalue().splitlines()
+                      if ln.strip()][-1])
+    assert rec["batch"] == 8          # CPU default, not the override
+    assert rec["chunk"] == 40
+
+
+def test_analyze_trace_summarizes_device_lane(tmp_path, monkeypatch):
+    """scripts/analyze_trace.py: lane grouping, python-lane exclusion,
+    per-op aggregation over a synthetic Perfetto trace."""
+    import gzip
+
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 9, "tid": 3, "name": "thread_name",
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.1",
+         "ts": 0.0, "dur": 100.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.1",
+         "ts": 150.0, "dur": 50.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "dot.2",
+         "ts": 300.0, "dur": 700.0},
+        {"ph": "X", "pid": 9, "tid": 3, "name": "frame",
+         "ts": 0.0, "dur": 9999.0},
+    ]
+    d = tmp_path / "plugins" / "profile" / "t1"
+    d.mkdir(parents=True)
+    with gzip.open(d / "m.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    monkeypatch.syspath_prepend(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import analyze_trace
+
+    lanes = analyze_trace.summarize(
+        analyze_trace.load_events(analyze_trace.newest_trace(
+            str(tmp_path))))
+    assert list(lanes) == ["/device:TPU:0/XLA Ops"]   # python excluded
+    lane = lanes["/device:TPU:0/XLA Ops"]
+    assert lane["total_us"] == 850.0
+    assert lane["span_us"] == 1000.0
+    assert lane["ops"][0] == ("dot.2", 700.0, 1)
+    assert lane["ops"][1] == ("fusion.1", 150.0, 2)
