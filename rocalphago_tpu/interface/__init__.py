@@ -11,6 +11,8 @@ _EXPORTS = {
     "move_to_vertex": "rocalphago_tpu.interface.gtp",
     "run_gtp": "rocalphago_tpu.interface.gtp",
     "vertex_to_move": "rocalphago_tpu.interface.gtp",
+    "elo_table": "rocalphago_tpu.interface.elo",
+    "run_tournament": "rocalphago_tpu.interface.tournament",
 }
 
 __getattr__, __dir__, __all__ = make_lazy(__name__, _EXPORTS)

@@ -1,0 +1,80 @@
+"""Elo/Bradley-Terry rating fit over tournament logs.
+
+Strategy mirrors the suite's oracle style: deterministic synthetic
+game sets with hand-checkable ordinal structure (A beats B beats C),
+plus CLI round-trip through a real tournament-format JSONL file.
+"""
+
+import json
+
+from rocalphago_tpu.interface import elo
+
+
+def g(black, white, winner):
+    return {"game": 0, "black": black, "white": white, "winner": winner}
+
+
+def test_win_rate_orders_ratings():
+    games = [g("A", "B", "A")] * 7 + [g("B", "A", "B")] * 3 \
+        + [g("B", "C", "B")] * 7 + [g("C", "B", "C")] * 3
+    t = elo.elo_table(games, anchor="C", anchor_elo=0.0)
+    p = t["players"]
+    assert p["C"]["elo"] == 0.0
+    assert p["A"]["elo"] > p["B"]["elo"] > p["C"]["elo"]
+    # 7:3 corresponds to ~147 Elo per step; regularized fit lands near
+    assert 80 < p["B"]["elo"] < 220
+    # transitive spread is roughly additive on the BT scale
+    assert p["A"]["elo"] > 1.5 * p["B"]["elo"]
+    assert t["anchor"] == "C"
+
+
+def test_draws_count_half():
+    games = [g("A", "B", "draw")] * 10
+    p = elo.elo_table(games)["players"]
+    assert p["A"]["elo"] == p["B"]["elo"]
+    assert p["A"]["draws"] == 10 and p["A"]["wins"] == 0
+
+
+def test_disconnected_component_gets_null():
+    games = [g("A", "B", "A")] * 4 + [g("X", "Y", "X")] * 4
+    p = elo.elo_table(games, anchor="A")["players"]
+    assert p["A"]["elo"] is not None and p["B"]["elo"] is not None
+    assert p["X"]["elo"] is None and p["Y"]["elo"] is None
+
+
+def test_undefeated_player_stays_finite():
+    games = [g("A", "B", "A")] * 5
+    p = elo.elo_table(games, anchor="B")["players"]
+    assert p["A"]["elo"] is not None
+    assert 0 < p["A"]["elo"] < 2000       # regularized, not infinite
+
+
+def test_cli_roundtrip(tmp_path, capsys):
+    log = tmp_path / "t.jsonl"
+    lines = [json.dumps(g("mcts", "greedy", "mcts"))] * 3 \
+        + [json.dumps(g("greedy", "mcts", "greedy"))] \
+        + ["{not json"]                   # malformed line skipped
+    log.write_text("\n".join(lines) + "\n")
+    rc = elo.main([str(log), "--anchor", "greedy",
+                   "--anchor-elo", "1000"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["players"]["greedy"]["elo"] == 1000.0
+    assert out["players"]["mcts"]["elo"] > 1000.0
+    assert out["players"]["mcts"]["games"] == 4
+
+
+def test_unknown_anchor_is_an_error():
+    import pytest
+
+    games = [g("A", "B", "A")]
+    with pytest.raises(ValueError, match="anchor"):
+        elo.elo_table(games, anchor="typo")
+
+
+def test_non_object_json_lines_skipped(tmp_path):
+    log = tmp_path / "t.jsonl"
+    log.write_text('[1,2]\n"scalar"\n'
+                   + json.dumps(g("A", "B", "A")) + "\n")
+    games = elo.read_games([str(log)])
+    assert len(games) == 1
