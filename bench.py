@@ -36,6 +36,56 @@ _DEADLINE_MARK = "_GRAFT_BENCH_BUDGET_S"
 FULL_GAME_PLIES = 250
 
 
+def _self_size_from_results():
+    """(batch, chunk) from today's on-chip self-play rates, or None.
+
+    The adaptive probe exists because per-ply cost is unknowable a
+    priori — but when the component sweep has ALREADY measured it
+    today (``benchmarks/results.jsonl`` records from
+    ``bench_selfplay.py``, written by the TPU window hunter), the
+    probe's extra programs (mid-game seeding + one per candidate
+    batch, each a fresh 20-40s compile on the flaky tunnel) are pure
+    risk. Pick the best-throughput measured batch and size the chunk
+    to ≤20s segments (2x margin under the ~40s worker watchdog).
+    Same-day records only: the engine/encoder change daily."""
+    # same resolution as benchmarks/_harness.py::report — the log the
+    # component sweep writes is the log this reads
+    path = os.environ.get(
+        "ROCALPHAGO_BENCH_LOG",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "benchmarks", "results.jsonl"))
+    if not path:
+        return None
+    today = time.strftime("%Y-%m-%d")
+    best = None     # (plies_per_s, batch)
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if (r.get("metric") == "selfplay_ply_program"
+                        and r.get("platform") == "tpu"
+                        and str(r.get("date", "")).startswith(today)
+                        and isinstance(r.get("batch"), int)
+                        and r.get("value", 0) > 0):
+                    cand = (float(r["value"]), r["batch"])
+                    if best is None or cand > best:
+                        best = cand
+    except OSError:
+        return None
+    if best is None:
+        return None
+    rate, batch = best
+    sec_per_ply = batch / rate
+    chunk = max(5, min(100, int(20.0 / max(sec_per_ply, 1e-3))))
+    print(f"bench: self-sized from today's results.jsonl: "
+          f"batch {batch}, chunk {chunk} "
+          f"({rate:.0f} board-plies/s measured)", file=sys.stderr)
+    return batch, chunk
+
+
 def _measure() -> None:
     """Child: run the benchmark on whatever backend the env selects.
 
@@ -112,6 +162,9 @@ def _measure() -> None:
         print(f"bench: ignoring malformed _GRAFT_BENCH_FIXED={fixed!r}"
               " (want 'batch,chunk' positive ints); running adaptive",
               file=sys.stderr)
+    if not fixed_cfg and on_tpu \
+            and os.environ.get("_GRAFT_BENCH_NO_SELF_SIZE") != "1":
+        fixed_cfg = _self_size_from_results()
     if fixed_cfg:
         batch, chunk = fixed_cfg
     elif on_tpu or os.environ.get("_GRAFT_BENCH_FORCE_ADAPTIVE") == "1":

@@ -56,9 +56,9 @@ run() {
     skippable "$name" && return 0
     echo "=== $name: $* [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
     "$@" >>"$LOG/hunt.log" 2>&1
-    rc=$?
-    echo "    rc=$rc [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
-    if [ $rc -eq 0 ]; then
+    step_rc=$?      # probe() below clobbers the shared rc variable
+    echo "    rc=$step_rc [$(date +%H:%M:%S)]" >>"$LOG/hunt.log"
+    if [ $step_rc -eq 0 ]; then
         touch "$STATE/$name"
         sleep 15
         return 0
@@ -80,31 +80,7 @@ run() {
         echo "    $name failure not counted (tunnel down)" \
             >>"$LOG/hunt.log"
     fi
-    return $rc
-}
-
-# best self-play batch from today's on-chip records (falls back to
-# 64; tolerates missing file, partial lines, stale days)
-best_batch() {
-    TODAY=$(date +%Y-%m-%d) python - <<'EOF'
-import json, os
-best, rate = 64, -1.0
-today = os.environ.get("TODAY", "")
-try:
-    for line in open("benchmarks/results.jsonl"):
-        try:
-            r = json.loads(line)
-        except ValueError:
-            continue
-        if (r.get("metric") == "selfplay_ply_program"
-                and r.get("platform") == "tpu"
-                and r.get("date", "") >= today
-                and r.get("value", 0) > rate):
-            best, rate = r.get("batch", 64), r["value"]
-except OSError:
-    pass
-print(best)
-EOF
+    return $step_rc
 }
 
 SPECS=benchmarks/tpu_extra_r3   # tiny 9x9 nets for the tournament smoke
@@ -128,7 +104,7 @@ make_specs
 STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
 preprocess chase_xla chase_pls devmcts9 selfplay16 selfplay64 selfplay256 \
 mcts19 mcts19r rl engine_trace train_trace preprocess_trace tournament \
-headline_fixed headline"
+headline_sized headline"
 n_steps=$(echo $STEPS | wc -w)
 deadline=$(( $(date +%s) + ${HUNT_BUDGET_S:-36000} ))
 
@@ -172,12 +148,17 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             train_trace)      run train_trace      python benchmarks/bench_train.py --batch 1024 --reps 1 --profile "$LOG/trace_train" ;;
             preprocess_trace) run preprocess_trace python benchmarks/bench_preprocess.py --reps 1 --profile "$LOG/trace_preprocess" ;;
             tournament)  run tournament  python -m rocalphago_tpu.interface.tournament "mcts:$SPECS/p9.json:$SPECS/v9.json" "greedy:$SPECS/p9.json" --games 1 --board 9 --playouts 16 --move-limit 60 --log "$LOG/tournament.jsonl" ;;
-            headline_fixed)
-                B=$(best_batch)
-                run headline_fixed env _GRAFT_BENCH_FIXED="$B,10" _GRAFT_BENCH_BUDGET_S=420 \
+            headline_sized)
+                # bench.py self-sizes batch/chunk from the same-day
+                # selfplay_ply_program records the selfplay* steps
+                # above banked (one compiled program, no probe)
+                run headline_sized env _GRAFT_BENCH_BUDGET_S=420 \
                     bash -c 'python bench.py | tail -1 | tee -a '"$LOG"'/hunt.log | grep -q "\"platform\": \"tpu\""' ;;
             headline)
-                run headline env _GRAFT_BENCH_MAX_MOVES=300 \
+                # the driver-equivalent ADAPTIVE run (stretch goal):
+                # self-sizing off so the probe path itself gets
+                # exercised on hardware
+                run headline env _GRAFT_BENCH_MAX_MOVES=300 _GRAFT_BENCH_NO_SELF_SIZE=1 \
                     bash -c 'python bench.py | tail -1 | tee -a '"$LOG"'/hunt.log | grep -q "\"platform\": \"tpu\""' ;;
         esac || break   # step failed -> backend likely died -> reprobe
         probe || break

@@ -101,3 +101,42 @@ def test_analyze_trace_summarizes_device_lane(tmp_path, monkeypatch):
     assert lane["span_us"] == 1000.0
     assert lane["ops"][0] == ("dot.2", 700.0, 1)
     assert lane["ops"][1] == ("fusion.1", 150.0, 2)
+
+
+def test_self_size_from_results(tmp_path, monkeypatch):
+    """bench.py self-sizes from today's on-chip self-play records
+    (and ignores other metrics, other platforms, other days)."""
+    import time as _time
+
+    monkeypatch.syspath_prepend(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    today = _time.strftime("%Y-%m-%d")
+    log = tmp_path / "results.jsonl"
+    log.write_text("\n".join([
+        json.dumps({"metric": "selfplay_ply_program", "value": 80.0,
+                    "batch": 64, "platform": "tpu",
+                    "date": f"{today}T01:00:00"}),
+        json.dumps({"metric": "selfplay_ply_program", "value": 120.0,
+                    "batch": 256, "platform": "tpu",
+                    "date": f"{today}T02:00:00"}),
+        json.dumps({"metric": "selfplay_ply_program", "value": 999.0,
+                    "batch": 16, "platform": "cpu",
+                    "date": f"{today}T03:00:00"}),
+        json.dumps({"metric": "selfplay_ply_program", "value": 999.0,
+                    "batch": 16, "platform": "tpu",
+                    "date": "2020-01-01T00:00:00"}),
+        json.dumps({"metric": "engine_steps", "value": 9999.0,
+                    "batch": 1024, "platform": "tpu",
+                    "date": f"{today}T04:00:00"}),
+        "{broken",
+    ]) + "\n")
+    monkeypatch.setenv("ROCALPHAGO_BENCH_LOG", str(log))
+    got = bench._self_size_from_results()
+    # best same-day TPU record: 120 plies/s at batch 256 ->
+    # 2.13 s/ply -> chunk = int(20 / 2.13) = 9
+    assert got == (256, 9)
+
+    monkeypatch.setenv("ROCALPHAGO_BENCH_LOG", str(tmp_path / "no"))
+    assert bench._self_size_from_results() is None
