@@ -69,6 +69,7 @@ def _self_size_from_results():
                         and r.get("platform") == "tpu"
                         and str(r.get("date", "")).startswith(today)
                         and isinstance(r.get("batch"), int)
+                        and r.get("board", 19) == 19  # headline board
                         and r.get("value", 0) > 0):
                     cand = (float(r["value"]), r["batch"])
                     if best is None or cand > best:
@@ -157,12 +158,15 @@ def _measure() -> None:
     except ValueError:
         fixed_cfg = None
     if fixed and not fixed_cfg:
-        # fall through to the adaptive probe, but say why — a silent
-        # discard here burns a flapping-tunnel window undiagnosed
+        # the operator asked for explicit control and got the value
+        # wrong — fall through to the adaptive probe (NOT self-sizing,
+        # which would silently substitute a different fixed config)
+        # and say why: a silent discard burns a flapping-tunnel window
+        # undiagnosed
         print(f"bench: ignoring malformed _GRAFT_BENCH_FIXED={fixed!r}"
               " (want 'batch,chunk' positive ints); running adaptive",
               file=sys.stderr)
-    if not fixed_cfg and on_tpu \
+    elif not fixed_cfg and on_tpu \
             and os.environ.get("_GRAFT_BENCH_NO_SELF_SIZE") != "1":
         fixed_cfg = _self_size_from_results()
     if fixed_cfg:

@@ -140,3 +140,63 @@ def test_self_size_from_results(tmp_path, monkeypatch):
 
     monkeypatch.setenv("ROCALPHAGO_BENCH_LOG", str(tmp_path / "no"))
     assert bench._self_size_from_results() is None
+
+
+def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
+    """scripts/bench_report.py: latest-record-per-config selection,
+    date/platform filters, probe-window extraction."""
+    monkeypatch.syspath_prepend(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import bench_report
+
+    log = tmp_path / "r.jsonl"
+    log.write_text("\n".join([
+        json.dumps({"metric": "m", "value": 1.0, "unit": "u",
+                    "batch": 64, "platform": "tpu",
+                    "date": "2026-07-31T01:00:00"}),
+        json.dumps({"metric": "m", "value": 2.0, "unit": "u",
+                    "batch": 64, "platform": "tpu",
+                    "date": "2026-07-31T02:00:00"}),   # newer wins
+        json.dumps({"metric": "m", "value": 9.0, "unit": "u",
+                    "batch": 256, "platform": "tpu",
+                    "date": "2026-07-31T01:30:00"}),   # distinct cfg
+        json.dumps({"metric": "m", "value": 5.0, "unit": "u",
+                    "batch": 64, "platform": "cpu",
+                    "date": "2026-07-31T03:00:00"}),   # other platform
+        json.dumps({"metric": "m", "value": 7.0, "unit": "u",
+                    "batch": 64, "platform": "tpu",
+                    "date": "2026-07-30T01:00:00"}),   # other day
+    ]) + "\n")
+    recs = bench_report.load_records(str(log), "2026-07-31", "tpu")
+    assert [(r["value"], r.get("batch")) for r in recs] \
+        == [(2.0, 64), (9.0, 256)]
+    table = bench_report.render_table(recs)
+    assert "| m | 2.0 | u | batch=64 |" in table
+
+    probe = tmp_path / "probe.log"
+    probe.write_text(
+        "probe rc=124 [01:00:00]\n"
+        "probe rc=0 [01:02:00]\nprobe rc=3 [01:04:00]\n"
+        "probe rc=124 [01:06:00]\n"
+        "probe rc=0 [01:10:00]\n")
+    s = bench_report.probe_stats([str(probe)])
+    assert s["probes"] == 5 and s["up"] == 3
+    assert s["windows"] == 2
+    assert s["window_spans_s"] == [120, 0]
+
+
+def test_probe_stats_midnight_and_file_boundaries(tmp_path, monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import bench_report
+
+    a = tmp_path / "a.probe.log"
+    a.write_text("probe rc=0 [23:50:00]\nprobe rc=0 [00:20:00]\n")
+    b = tmp_path / "b.probe.log"
+    b.write_text("probe rc=0 [00:21:00]\n")
+    s = bench_report.probe_stats([str(a), str(b)])
+    # midnight wrap inside one file: one 30-min window, not clamped 0;
+    # file boundary: b's window is separate, never stitched onto a's
+    assert s["windows"] == 2
+    assert s["window_spans_s"] == [1800, 0]
+    assert s["probes"] == 3 and s["up"] == 3
