@@ -352,7 +352,7 @@ def main(argv=None):
     ap.add_argument("--rollout", help="rollout model JSON spec")
     ap.add_argument("--player", default="greedy",
                     choices=("greedy", "probabilistic", "mcts",
-                             "device-mcts"))
+                             "device-mcts", "gumbel-mcts"))
     ap.add_argument("--temperature", type=float, default=0.1)
     ap.add_argument("--lmbda", type=float, default=0.5)
     ap.add_argument("--playouts", type=int, default=100)

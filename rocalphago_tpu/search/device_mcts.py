@@ -18,6 +18,10 @@ simulations are strictly sequential per game (no virtual loss — the
 batch axis provides the parallelism), and the tree is capacity-bounded
 (``max_nodes``; a full slab keeps evaluating leaves but stops
 allocating, so extra simulations still improve Q estimates).
+:func:`make_gumbel_mcts` swaps the ROOT rule for Gumbel-top-k
+candidate sampling + sequential halving (the mctx pattern) — the
+stronger decision procedure at the low simulation budgets this search
+serves at; selection below the root stays PUCT.
 
 Layout notes (TPU): per game the slab holds the node states (a stacked
 :class:`GoState` pytree), edge stats ``P/N/W [M, A]`` and the child
@@ -183,11 +187,17 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         score = jnp.where(prior_n > 0, q + u, -jnp.inf)
         return jnp.argmax(score).astype(jnp.int32)
 
-    def _descend_one(prior, visits, value_sum, child, done_m):
+    def _descend_one(prior, visits, value_sum, child, done_m,
+                     root_action):
         """Single-game descend ([M, ...] arrays): walk existing child
         pointers from the root until an unexpanded edge or a terminal
         node. Returns ``(node, action)``; ``action`` = -1 when the
-        walk ended ON a terminal node (evaluate that node itself)."""
+        walk ended ON a terminal node (evaluate that node itself).
+
+        ``root_action >= 0`` forces the FIRST edge out of the root
+        (the Gumbel searcher's scheduled candidate); selection below
+        the root is PUCT either way. ``-1`` = free PUCT from the root.
+        """
         def cond(carry):
             node, action, stop = carry
             return ~stop
@@ -203,8 +213,19 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             stop = at_term | (nxt < 0)
             return (jnp.where(stop, node, nxt), action, stop)
 
-        node, action, _ = lax.while_loop(
-            cond, body, (jnp.int32(0), jnp.int32(-1), jnp.bool_(False)))
+        # pre-execute the root step with the forced action (if any):
+        # the carry then starts at the forced edge's child — or stops
+        # on the root edge itself when it is unexpanded/terminal
+        at_term0 = done_m[0]
+        forced = (root_action >= 0) & ~at_term0
+        nxt0 = jnp.where(forced, child[0, root_action], -1)
+        stop0 = at_term0 | (forced & (nxt0 < 0))
+        init = (jnp.where(stop0 | ~forced, 0, nxt0).astype(jnp.int32),
+                jnp.where(at_term0, -1,
+                          jnp.where(forced, root_action, -1))
+                .astype(jnp.int32),
+                stop0)
+        node, action, _ = lax.while_loop(cond, body, init)
         return node, action
 
     def _backup_one(visits, value_sum, parent, paction, start_node,
@@ -228,11 +249,17 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             (start_node, start_action, -v_child, visits, value_sum))
         return visits, value_sum
 
-    def simulate(params_p, params_v, tree: DeviceTree) -> DeviceTree:
-        """One lockstep simulation across the whole game batch."""
+    def simulate(params_p, params_v, tree: DeviceTree,
+                 root_actions=None) -> DeviceTree:
+        """One lockstep simulation across the whole game batch.
+        ``root_actions`` (i32 [B], -1 = free) forces each game's first
+        edge — the Gumbel searcher's scheduled candidates."""
+        if root_actions is None:
+            root_actions = jnp.full(
+                (tree.n_nodes.shape[0],), -1, jnp.int32)
         node, action = jax.vmap(_descend_one)(
             tree.prior, tree.visits, tree.value_sum, tree.child,
-            tree.states.done)
+            tree.states.done, root_actions)
 
         # candidate child states: step the selected edge (terminal
         # descends step a no-op pass on an already-done state — the
@@ -340,6 +367,160 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     search.run_sims = run_sims
     search.root_stats = jax.jit(_root_stats)
     search.run_chunked = run_chunked
+    search.simulate = simulate          # forced-root hook (Gumbel)
+    return search
+
+
+def _halving_schedule(n_sim: int, m: int) -> list[tuple[int, int]]:
+    """Sequential-halving plan: ``[(k_candidates, visits_per_cand)]``.
+
+    Candidate count halves each phase (m, m//2, …, 2); the simulation
+    budget is split evenly across phases, and whatever the integer
+    division leaves over goes to the final (2-candidate) phase, where
+    extra visits sharpen exactly the comparison that decides the move.
+    Every phase visits each surviving candidate at least once, so for
+    tiny ``n_sim`` the actual total can exceed ``n_sim`` (documented
+    in :func:`make_gumbel_mcts`)."""
+    ks, k = [], m
+    while k >= 2:
+        ks.append(k)
+        k //= 2
+    p = len(ks)
+    sched = [(k, max(1, n_sim // (p * k))) for k in ks]
+    used = sum(k * v for k, v in sched)
+    leftover = n_sim - used
+    if leftover >= ks[-1]:
+        k, v = sched[-1]
+        sched[-1] = (k, v + leftover // k)
+    return sched
+
+
+def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
+                     value_features: tuple,
+                     policy_apply: Callable, value_apply: Callable,
+                     n_sim: int, max_nodes: int, m_root: int = 16,
+                     c_visit: float = 50.0, c_scale: float = 1.0,
+                     c_puct: float = 5.0):
+    """Gumbel root search over the device tree (Danihelka et al. 2022,
+    the mctx pattern): the move decision at low simulation budgets.
+
+    PUCT spends its root budget proportionally to priors + optimism —
+    at 16–64 sims/move (the regime the on-device search serves in) it
+    often never tries the 2nd-best prior twice. Gumbel instead:
+
+    1. samples ``m_root`` root candidates without replacement via
+       Gumbel-top-k on the masked policy logits (``g(a) = logits(a) +
+       Gumbel noise``) — a principled exploration draw;
+    2. runs SEQUENTIAL HALVING over the candidates: every survivor
+       gets the same number of simulations per phase (scheduled by
+       :func:`_halving_schedule`; below the root, selection stays
+       PUCT), then the worse half is dropped by the score
+       ``g(a) + σ(q̂(a))`` with ``σ(q) = (c_visit + max_N)·c_scale·q``;
+    3. returns the last survivor as ``best`` — the action the player
+       should take (argmax root visits is the PUCT convention; under
+       a halving schedule visit counts reflect the schedule, not the
+       conclusion, so callers must use ``best``).
+
+    Returns ``search(params_p, params_v, roots, rng) ->
+    (root_visits [B, A], root_q [B, A], best [B])`` plus the same
+    chunk-driving surface as :func:`make_device_mcts`
+    (``init/run_phase/rerank/root_stats/run_chunked``). For tiny
+    ``n_sim`` (< one visit per candidate per phase) the actual
+    simulation count can exceed ``n_sim`` — every phase must visit
+    each survivor once to have a score to halve on.
+    """
+    base = make_device_mcts(cfg, policy_features, value_features,
+                            policy_apply, value_apply, n_sim=n_sim,
+                            max_nodes=max_nodes, c_puct=c_puct)
+    num_actions = cfg.num_points + 1
+    m = max(2, min(m_root, num_actions))
+    schedule = _halving_schedule(n_sim, m)
+    neg = jnp.float32(jnp.finfo(jnp.float32).min)
+
+    def init(params_p, params_v, roots: GoState, rng):
+        """-> (tree, g f32 [B, A], cand i32 [B, m]) — the tree with
+        root priors, the gumbel-perturbed root logits, and the ranked
+        candidate actions."""
+        tree = base.init(params_p, params_v, roots)
+        root_prior = tree.prior[:, 0, :]
+        logits = jnp.where(root_prior > 0, jnp.log(
+            jnp.maximum(root_prior, 1e-38)), neg)
+        gumbel = jax.random.gumbel(rng, logits.shape, jnp.float32)
+        g = jnp.where(root_prior > 0, logits + gumbel, neg)
+        _, cand = lax.top_k(g, m)
+        return tree, g, cand.astype(jnp.int32)
+
+    def _scores(tree: DeviceTree, g):
+        visits, q = base.root_stats(tree)
+        maxn = visits.max(axis=-1, keepdims=True).astype(jnp.float32)
+        sigma = (c_visit + maxn) * c_scale * q
+        return jnp.where(visits > 0, g + sigma, g)
+
+    def rerank(tree: DeviceTree, g, cand, k: int):
+        """Sort the first ``k`` candidates by ``g + σ(q̂)`` descending
+        (the halving step: the next phase reads the first k//2)."""
+        s = jnp.take_along_axis(_scores(tree, g), cand[:, :k], axis=-1)
+        order = jnp.argsort(-s, axis=-1)
+        head = jnp.take_along_axis(cand[:, :k], order, axis=-1)
+        return jnp.concatenate([head, cand[:, k:]], axis=-1)
+
+    @functools.partial(jax.jit, static_argnames=("count", "k"))
+    def run_phase(params_p, params_v, tree: DeviceTree, g, cand, j0,
+                  count: int, k: int):
+        """``count`` scheduled simulations (one compiled program):
+        sim ``j`` forces root candidate ``(j0 + j) % k``. Candidates
+        beyond the sensible set (possible when fewer than m moves are
+        sensible) carry ``-inf`` g — those slots redirect to the top
+        candidate instead of forcing an unreachable edge."""
+        def body(i, t):
+            slot = (j0 + i) % k
+            forced = jnp.take_along_axis(
+                cand, jnp.broadcast_to(slot, (cand.shape[0], 1)),
+                axis=-1)[:, 0]
+            g_f = jnp.take_along_axis(g, forced[:, None],
+                                      axis=-1)[:, 0]
+            forced = jnp.where(g_f > neg / 2, forced, cand[:, 0])
+            return base.simulate(params_p, params_v, t, forced)
+
+        return lax.fori_loop(0, count, body, tree)
+
+    def search_impl(params_p, params_v, roots: GoState, rng):
+        tree, g, cand = init(params_p, params_v, roots, rng)
+        for k, v in schedule:        # static plan — unrolls into jit
+            tree = run_phase(params_p, params_v, tree, g, cand,
+                             jnp.int32(0), count=k * v, k=k)
+            cand = rerank(tree, g, cand, k)
+        visits, q = base.root_stats(tree)
+        return visits, q, cand[:, 0]
+
+    search = jax.jit(search_impl)
+
+    def run_chunked(params_p, params_v, roots: GoState, rng,
+                    chunk: int):
+        """Phase-by-phase, ``chunk``-simulation compiled programs with
+        the tree device-resident in between (the ~40s TPU worker
+        watchdog); identical results to :func:`search`."""
+        tree, g, cand = init_j(params_p, params_v, roots, rng)
+        for k, v in schedule:
+            total = k * v
+            for j0 in range(0, total, chunk):
+                tree = run_phase(params_p, params_v, tree, g, cand,
+                                 jnp.int32(j0),
+                                 count=min(chunk, total - j0), k=k)
+            cand = rerank_j(tree, g, cand, k)
+        visits, q = base.root_stats(tree)
+        return visits, q, cand[:, 0]
+
+    init_j = jax.jit(init)
+    rerank_j = jax.jit(rerank, static_argnames=("k",))
+
+    search.init = init_j
+    search.rerank = rerank_j
+    search.run_phase = run_phase
+    search.root_stats = base.root_stats
+    search.run_chunked = run_chunked
+    search.schedule = schedule
+    search.m_root = m
     return search
 
 
@@ -356,7 +537,8 @@ class DeviceMCTSPlayer:
 
     def __init__(self, value_net, policy_net, n_sim: int = 100,
                  max_nodes: int | None = None, c_puct: float = 5.0,
-                 sim_chunk: int = 8):
+                 sim_chunk: int = 8, gumbel: bool = False,
+                 m_root: int = 16, seed: int = 0):
         self.policy = policy_net
         self.value = value_net
         self.board = policy_net.board
@@ -365,6 +547,9 @@ class DeviceMCTSPlayer:
         self._n_sim = n_sim
         self._max_nodes = max_nodes or 2 * n_sim
         self._c_puct = c_puct
+        self._gumbel = gumbel
+        self._m_root = m_root
+        self._rng = jax.random.key(seed)
         # searchers are cached PER KOMI: the search's terminal-node
         # evaluations score with its GoConfig's komi, and GTP can set
         # any komi per game — same handling as the host MCTSPlayer's
@@ -380,7 +565,10 @@ class DeviceMCTSPlayer:
             import dataclasses
 
             cfg = dataclasses.replace(self._cfg, komi=komi)
-            self._searchers[komi] = (cfg, make_device_mcts(
+            make = (functools.partial(make_gumbel_mcts,
+                                      m_root=self._m_root)
+                    if self._gumbel else make_device_mcts)
+            self._searchers[komi] = (cfg, make(
                 cfg, self.policy.feature_list, self.value.feature_list,
                 self.policy.module.apply, self.value.module.apply,
                 n_sim=self._n_sim, max_nodes=self._max_nodes,
@@ -396,10 +584,19 @@ class DeviceMCTSPlayer:
         cfg, search = self._searcher_for(float(state.komi))
         root = _jaxgo.from_pygo(cfg, state)
         roots = jax.tree.map(lambda x: x[None], root)
-        visits, _ = search.run_chunked(
-            self.policy.params, self.value.params, roots, self._chunk)
-        counts = np.asarray(jax.device_get(visits))[0]
-        action = int(counts.argmax())
+        if self._gumbel:
+            self._rng, sub = jax.random.split(self._rng)
+            visits, _, best = search.run_chunked(
+                self.policy.params, self.value.params, roots, sub,
+                self._chunk)
+            action = int(jax.device_get(best)[0])
+            counts = np.asarray(jax.device_get(visits))[0]
+        else:
+            visits, _ = search.run_chunked(
+                self.policy.params, self.value.params, roots,
+                self._chunk)
+            counts = np.asarray(jax.device_get(visits))[0]
+            action = int(counts.argmax())
         if action >= cfg.num_points or counts[action] == 0:
             return None                              # pass
         return unflatten_idx(action, cfg.size)

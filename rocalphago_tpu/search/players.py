@@ -136,13 +136,14 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
                           n_playout=playouts, leaf_batch=leaf_batch,
                           symmetric=symmetric,
                           device_rollout=device_rollout)
-    if kind == "device-mcts":
+    if kind in ("device-mcts", "gumbel-mcts"):
         from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
 
         if not value_path:
-            raise ValueError("device-mcts player needs a value model")
+            raise ValueError(f"{kind} player needs a value model")
         value = NeuralNetBase.load_model(value_path)
-        return DeviceMCTSPlayer(value, policy, n_sim=playouts)
+        return DeviceMCTSPlayer(value, policy, n_sim=playouts,
+                                gumbel=(kind == "gumbel-mcts"))
     raise ValueError(f"unknown player kind {kind!r}")
 
 

@@ -32,7 +32,9 @@ def load_records(path: str, date: str, platform: str | None):
     latest: dict = {}
     try:
         f = open(path)
-    except OSError:
+    except OSError as e:
+        print(f"bench_report: cannot read {path}: {e}",
+              file=sys.stderr)
         return []
     with f:
         for line in f:
@@ -70,8 +72,8 @@ def render_table(records) -> str:
                         if k not in _SKIP_FIELDS)
         extra = ("" if r.get("vs_baseline") in (None, "")
                  else f" (vs_baseline {r['vs_baseline']})")
-        lines.append(f"| {r['metric']} | {r['value']}{extra} | "
-                     f"{r['unit']} | {cfg} |")
+        lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
+                     f" | {r.get('unit', '?')} | {cfg} |")
     return "\n".join(lines)
 
 
@@ -96,7 +98,9 @@ def probe_stats(paths):
                     per_file.append([(m.group(2),
                                       int(m.group(1)) in (0, 3))
                                      for m in _PROBE.finditer(f.read())])
-            except OSError:
+            except OSError as e:
+                print(f"bench_report: cannot read {fp}: {e}",
+                      file=sys.stderr)
                 continue
 
     def hms_to_s(h):

@@ -179,3 +179,113 @@ def test_terminal_root_backs_up_nothing():
     visits, q = jax.device_get(searcher(None, None, st))
     np.testing.assert_array_equal(visits, 0)
     np.testing.assert_array_equal(q, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Gumbel sequential-halving root search
+
+
+def fake_value_zero(params, planes):
+    return jnp.zeros((planes.shape[0],))
+
+
+def test_halving_schedule_shapes():
+    from rocalphago_tpu.search.device_mcts import _halving_schedule
+
+    # budget divides exactly: 128 sims over 16 candidates
+    sched = _halving_schedule(128, 16)
+    assert sched == [(16, 2), (8, 4), (4, 8), (2, 16)]
+    assert sum(k * v for k, v in sched) == 128
+    # tiny budget: every phase still visits each survivor once
+    sched = _halving_schedule(32, 16)
+    assert [k for k, _ in sched] == [16, 8, 4, 2]
+    assert all(v >= 1 for _, v in sched)
+    # leftover lands on the final 2-candidate phase
+    sched = _halving_schedule(100, 4)
+    assert sched[-1][0] == 2
+    assert sum(k * v for k, v in sched) <= 100
+
+
+def test_gumbel_visits_follow_schedule():
+    """Constant value net => candidate ranking is fixed by the gumbel
+    draw alone, so the visit pattern must equal the halving schedule:
+    the top candidate attends every phase, total visits = plan total,
+    and best is the global gumbel argmax."""
+    from rocalphago_tpu.search.device_mcts import make_gumbel_mcts
+
+    search = make_gumbel_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value_zero, n_sim=32, max_nodes=64,
+                              m_root=8)
+    roots = new_states(CFG, 3)
+    rng = jax.random.key(7)
+    visits, q, best = jax.device_get(
+        search(None, None, roots, rng))
+    plan_total = sum(k * v for k, v in search.schedule)
+    top_total = sum(v for _, v in search.schedule)
+    np.testing.assert_array_equal(visits.sum(axis=1), plan_total)
+    np.testing.assert_array_equal(visits.max(axis=1), top_total)
+    # with constant values, best == argmax of the gumbel-perturbed
+    # logits (recover them via init with the same rng)
+    _, g, cand = search.init(None, None, roots, rng)
+    np.testing.assert_array_equal(best, np.asarray(g).argmax(axis=1))
+    np.testing.assert_array_equal(best, np.asarray(cand)[:, 0])
+
+
+def test_gumbel_chunked_equals_monolithic():
+    from rocalphago_tpu.search.device_mcts import make_gumbel_mcts
+
+    search = make_gumbel_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value, n_sim=24, max_nodes=48,
+                              m_root=8)
+    roots = new_states(CFG, 2)
+    rng = jax.random.key(3)
+    v1, q1, b1 = jax.device_get(search(None, None, roots, rng))
+    v2, q2, b2 = jax.device_get(
+        search.run_chunked(None, None, roots, rng, chunk=5))
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(b1, b2)
+    np.testing.assert_allclose(q1, q2, rtol=1e-6)
+
+
+def test_gumbel_finds_capture():
+    """Same oracle as the PUCT capture test: with all actions as
+    candidates, sequential halving must keep and pick the capture (the
+    biggest stone-count swing) as best."""
+    from rocalphago_tpu.search.device_mcts import make_gumbel_mcts
+
+    # c_scale=4: the stone-count net's value gaps are ~0.04-0.08, so
+    # at the default scale a lucky gumbel draw on a quiet move can
+    # legitimately outweigh sigma(q) — weighting value up makes the
+    # oracle decisive (exactly the knob's purpose)
+    search = make_gumbel_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value, n_sim=64, max_nodes=128,
+                              m_root=N + 1, c_scale=4.0)
+    st = pygo.GameState(size=SIZE)
+    st.do_move((1, 0), pygo.BLACK)
+    st.do_move((0, 0), pygo.WHITE)
+    st.current_player = pygo.BLACK
+    root = jaxgo.from_pygo(CFG, st)
+    roots = jax.tree.map(lambda x: x[None], root)
+    capture = 0 * SIZE + 1
+    for seed in (0, 1, 2):
+        _, _, best = jax.device_get(
+            search(None, None, roots, jax.random.key(seed)))
+        assert int(best[0]) == capture, (seed, int(best[0]))
+
+
+def test_gumbel_player_plays_gtp_game():
+    from rocalphago_tpu.interface.gtp import GTPEngine
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol = CNNPolicy(FEATS, board=SIZE, layers=1, filters_per_layer=4)
+    val = CNNValue(VFEATS, board=SIZE, layers=1, filters_per_layer=4)
+    player = DeviceMCTSPlayer(val, pol, n_sim=8, max_nodes=16,
+                              sim_chunk=4, gumbel=True, m_root=4)
+    engine = GTPEngine(player)
+    for cmd, ok_prefix in ((f"boardsize {SIZE}", "="),
+                           ("clear_board", "="),
+                           ("genmove b", "= ")):
+        reply, _ = engine.handle(cmd + "\n")
+        assert reply.startswith(ok_prefix), (cmd, reply)
+    assert reply.split()[-1].upper() != "RESIGN"
