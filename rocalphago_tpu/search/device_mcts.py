@@ -422,9 +422,12 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
        conclusion, so callers must use ``best``).
 
     Returns ``search(params_p, params_v, roots, rng) ->
-    (root_visits [B, A], root_q [B, A], best [B])`` plus the same
-    chunk-driving surface as :func:`make_device_mcts`
-    (``init/run_phase/rerank/root_stats/run_chunked``). For tiny
+    (root_visits [B, A], root_q [B, A], best [B], pi [B, A])`` — with
+    ``pi`` the improved policy ``softmax(logits + σ(completed q̂))``,
+    the Gumbel MuZero training target — plus the same chunk-driving
+    surface as :func:`make_device_mcts`
+    (``init/run_phase/rerank/root_stats/improved_policy/
+    run_chunked``). For tiny
     ``n_sim`` (< one visit per candidate per phase) the actual
     simulation count can exceed ``n_sim`` — every phase must visit
     each survivor once to have a score to halve on.
@@ -438,9 +441,10 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     neg = jnp.float32(jnp.finfo(jnp.float32).min)
 
     def init(params_p, params_v, roots: GoState, rng):
-        """-> (tree, g f32 [B, A], cand i32 [B, m]) — the tree with
-        root priors, the gumbel-perturbed root logits, and the ranked
-        candidate actions."""
+        """-> (tree, g f32 [B, A], cand i32 [B, m], logits f32 [B, A])
+        — the tree with root priors, the gumbel-perturbed root logits,
+        the ranked candidate actions, and the raw (noise-free) masked
+        logits the improved-policy target is built from."""
         tree = base.init(params_p, params_v, roots)
         root_prior = tree.prior[:, 0, :]
         logits = jnp.where(root_prior > 0, jnp.log(
@@ -448,13 +452,34 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         gumbel = jax.random.gumbel(rng, logits.shape, jnp.float32)
         g = jnp.where(root_prior > 0, logits + gumbel, neg)
         _, cand = lax.top_k(g, m)
-        return tree, g, cand.astype(jnp.int32)
+        return tree, g, cand.astype(jnp.int32), logits
+
+    def _sigma(visits, values):
+        """The Gumbel value transform σ: monotone scaling of a value
+        estimate onto the logit scale, weighted up as the search gets
+        more evidence (``max_N``)."""
+        maxn = visits.max(axis=-1, keepdims=True).astype(jnp.float32)
+        return (c_visit + maxn) * c_scale * values
 
     def _scores(tree: DeviceTree, g):
         visits, q = base.root_stats(tree)
-        maxn = visits.max(axis=-1, keepdims=True).astype(jnp.float32)
-        sigma = (c_visit + maxn) * c_scale * q
-        return jnp.where(visits > 0, g + sigma, g)
+        return jnp.where(visits > 0, g + _sigma(visits, q), g)
+
+    def improved_policy(tree: DeviceTree, logits):
+        """π' = softmax(logits + σ(completed q̂)) — the Gumbel MuZero
+        training target. Unvisited actions are completed with the
+        visit-weighted mean of the visited q̂ (a simplification of
+        mctx's prior-weighted mixed value: no extra value-net call,
+        same fixed point when the net is consistent)."""
+        visits, q = base.root_stats(tree)
+        nv = visits.astype(jnp.float32)
+        total = nv.sum(axis=-1, keepdims=True)
+        q_bar = (nv * q).sum(axis=-1, keepdims=True) \
+            / jnp.maximum(total, 1.0)
+        completed = jnp.where(visits > 0, q, q_bar)
+        masked = jnp.where(logits > neg / 2,
+                           logits + _sigma(visits, completed), neg)
+        return jax.nn.softmax(masked, axis=-1)
 
     def rerank(tree: DeviceTree, g, cand, k: int):
         """Sort the first ``k`` candidates by ``g + σ(q̂)`` descending
@@ -485,13 +510,13 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         return lax.fori_loop(0, count, body, tree)
 
     def search_impl(params_p, params_v, roots: GoState, rng):
-        tree, g, cand = init(params_p, params_v, roots, rng)
+        tree, g, cand, logits = init(params_p, params_v, roots, rng)
         for k, v in schedule:        # static plan — unrolls into jit
             tree = run_phase(params_p, params_v, tree, g, cand,
                              jnp.int32(0), count=k * v, k=k)
             cand = rerank(tree, g, cand, k)
         visits, q = base.root_stats(tree)
-        return visits, q, cand[:, 0]
+        return visits, q, cand[:, 0], improved_policy(tree, logits)
 
     search = jax.jit(search_impl)
 
@@ -500,7 +525,7 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         """Phase-by-phase, ``chunk``-simulation compiled programs with
         the tree device-resident in between (the ~40s TPU worker
         watchdog); identical results to :func:`search`."""
-        tree, g, cand = init_j(params_p, params_v, roots, rng)
+        tree, g, cand, logits = init_j(params_p, params_v, roots, rng)
         for k, v in schedule:
             total = k * v
             for j0 in range(0, total, chunk):
@@ -509,15 +534,17 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                                  count=min(chunk, total - j0), k=k)
             cand = rerank_j(tree, g, cand, k)
         visits, q = base.root_stats(tree)
-        return visits, q, cand[:, 0]
+        return visits, q, cand[:, 0], improved_j(tree, logits)
 
     init_j = jax.jit(init)
     rerank_j = jax.jit(rerank, static_argnames=("k",))
+    improved_j = jax.jit(improved_policy)
 
     search.init = init_j
     search.rerank = rerank_j
     search.run_phase = run_phase
     search.root_stats = base.root_stats
+    search.improved_policy = improved_j
     search.run_chunked = run_chunked
     search.schedule = schedule
     search.m_root = m
@@ -586,7 +613,7 @@ class DeviceMCTSPlayer:
         roots = jax.tree.map(lambda x: x[None], root)
         if self._gumbel:
             self._rng, sub = jax.random.split(self._rng)
-            visits, _, best = search.run_chunked(
+            visits, _, best, _ = search.run_chunked(
                 self.policy.params, self.value.params, roots, sub,
                 self._chunk)
             action = int(jax.device_get(best)[0])
@@ -608,7 +635,8 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        max_moves: int, n_sim: int, max_nodes: int,
                        c_puct: float = 5.0, temperature: float = 1.0,
                        sim_chunk: int = 8,
-                       record_visits: bool = False):
+                       record_visits: bool = False,
+                       gumbel: bool = False, m_root: int = 16):
     """Search-driven self-play: every move of every game comes from a
     fresh :func:`make_device_mcts` search over the batch.
 
@@ -628,13 +656,24 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
 
     Returns ``run(params_p, params_v, rng) -> (final GoState,
     actions i32 [T, B], live bool [T, B])`` — with
-    ``record_visits=True``, ``(..., visits i32 [T, B, A])``: the raw
-    root visit counts per ply, the search-policy targets an
-    AlphaZero-style trainer (``training.zero``) learns from.
+    ``record_visits=True``, ``(..., targets [T, B, A])``: the
+    search-policy targets an AlphaZero-style trainer
+    (``training.zero``) learns from — raw root visit counts (i32)
+    under PUCT, the improved policy π' (f32, the Gumbel MuZero
+    target) under ``gumbel=True``. Gumbel self-play plays each ply's
+    halving winner directly: the per-ply fresh Gumbel draw is the
+    exploration, so no visit-count temperature sampling applies.
     """
-    search = make_device_mcts(cfg, policy_features, value_features,
-                              policy_apply, value_apply, n_sim,
-                              max_nodes, c_puct)
+    if gumbel:
+        search = make_gumbel_mcts(cfg, policy_features,
+                                  value_features, policy_apply,
+                                  value_apply, n_sim, max_nodes,
+                                  m_root=m_root, c_puct=c_puct)
+    else:
+        search = make_device_mcts(cfg, policy_features,
+                                  value_features, policy_apply,
+                                  value_apply, n_sim, max_nodes,
+                                  c_puct)
     n = cfg.num_points
     vstep = jax.vmap(functools.partial(step, cfg))
 
@@ -653,18 +692,35 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         live = ~states.done
         return vstep(states, action), rng, action, live
 
+    @jax.jit
+    def step_best(states: GoState, best):
+        """Gumbel move rule: play the halving winner — the per-ply
+        fresh Gumbel draw already IS the exploration (sampling from
+        the policy via the Gumbel-max trick), so no visit-count
+        temperature sampling on top."""
+        live = ~states.done
+        return vstep(states, best), best, live
+
     def run(params_p, params_v, rng):
         states = new_states(cfg, batch)
         actions, lives, visit_seq = [], [], []
         for _ in range(max_moves):
-            visits, _ = search.run_chunked(params_p, params_v, states,
-                                           sim_chunk)
-            states, rng, action, live = pick_and_step(
-                states, visits, rng)
+            if gumbel:
+                rng, sub = jax.random.split(rng)
+                visits, _, best, pi = search.run_chunked(
+                    params_p, params_v, states, sub, sim_chunk)
+                states, action, live = step_best(states, best)
+                target = pi
+            else:
+                visits, _ = search.run_chunked(params_p, params_v,
+                                               states, sim_chunk)
+                states, rng, action, live = pick_and_step(
+                    states, visits, rng)
+                target = visits
             actions.append(action)
             lives.append(live)
             if record_visits:
-                visit_seq.append(visits)
+                visit_seq.append(target)
             if bool(jax.device_get(states.done.all())):
                 break
         n_act = cfg.num_points + 1
@@ -674,8 +730,9 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                jnp.stack(lives) if lives
                else jnp.zeros((0, batch), bool))
         if record_visits:
+            tdtype = jnp.float32 if gumbel else jnp.int32
             out += (jnp.stack(visit_seq) if visit_seq
-                    else jnp.zeros((0, batch, n_act), jnp.int32),)
+                    else jnp.zeros((0, batch, n_act), tdtype),)
         return out
 
     return run

@@ -64,7 +64,8 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                         value_apply: Callable, tx_policy, tx_value,
                         batch: int, move_limit: int, n_sim: int,
                         max_nodes: int, temperature: float = 1.0,
-                        sim_chunk: int = 8, replay_chunk: int = 10):
+                        sim_chunk: int = 8, replay_chunk: int = 10,
+                        gumbel: bool = False, m_root: int = 16):
     """``(ZeroState) -> (ZeroState, metrics)`` — one full iteration:
     search self-play, replay-gradient accumulation for both nets, one
     optimizer step each. Host-driven (chunk-compiled throughout); the
@@ -75,7 +76,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         cfg, policy_features, value_features, policy_apply,
         value_apply, batch, move_limit, n_sim, max_nodes,
         temperature=temperature, sim_chunk=sim_chunk,
-        record_visits=True)
+        record_visits=True, gumbel=gumbel, m_root=m_root)
 
     n_policy_planes = output_planes(policy_features)
     vgd = jax.vmap(lambda s: jaxgo.group_data(
@@ -93,12 +94,17 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         gd = vgd(states)
         planes = venc(states, gd)
         sens = vsens(states, gd)
-        # search-policy target: board slice of the root visit
-        # distribution, renormalized (see module docstring)
+        # search-policy target: board slice of the per-ply target
+        # distribution (root visit counts, or π' under gumbel),
+        # renormalized (see module docstring). Visit counts are
+        # integers so mass>0 implies mass>=1; π' is a probability
+        # vector whose board mass can be any positive fraction —
+        # normalize by the actual mass and skip plies where almost
+        # everything sat on pass
         board_counts = visits_t[:, :n].astype(jnp.float32)
         mass = board_counts.sum(axis=-1)
-        pi = board_counts / jnp.maximum(mass, 1.0)[:, None]
-        w = live_t * (mass > 0)                      # f32-able [B]
+        pi = board_counts / jnp.maximum(mass, 1e-6)[:, None]
+        w = live_t * (mass > 1e-3)                   # f32-able [B]
         wf = w.astype(jnp.float32)
         # outcome from ply t's player-to-move perspective
         z = (winners * states.turn).astype(jnp.float32)
@@ -238,7 +244,24 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--sim-chunk", type=int, default=8)
     ap.add_argument("--replay-chunk", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--gumbel", action="store_true",
+                    help="Gumbel root search self-play with improved-"
+                         "policy (π') targets instead of PUCT + "
+                         "visit counts. Plays each ply's halving "
+                         "winner (--temperature does not apply); "
+                         "NOTE the halving schedule visits every "
+                         "candidate at least once per phase, so at "
+                         "small --sims the real per-ply simulation "
+                         "count is max(sims, schedule total) — "
+                         "lower --m-root accordingly")
+    ap.add_argument("--m-root", type=int, default=16,
+                    help="gumbel root candidate count (top-k of the "
+                         "gumbel-perturbed logits)")
     a = ap.parse_args(argv)
+    if a.gumbel and a.temperature != 1.0:
+        print("zero: --temperature is ignored with --gumbel (the "
+              "per-ply gumbel draw is the exploration)",
+              file=sys.stderr)
 
     policy = NeuralNetBase.load_model(a.policy_json)
     value = NeuralNetBase.load_model(a.value_json)
@@ -255,7 +278,8 @@ def run_training(argv=None) -> dict:
         batch=a.game_batch, move_limit=a.move_limit, n_sim=a.sims,
         max_nodes=a.max_nodes or 2 * a.sims,
         temperature=a.temperature, sim_chunk=a.sim_chunk,
-        replay_chunk=a.replay_chunk)
+        replay_chunk=a.replay_chunk, gumbel=a.gumbel,
+        m_root=a.m_root)
     state = init_zero_state(policy.params, value.params, tx_p, tx_v,
                             seed=a.seed)
 

@@ -67,7 +67,7 @@ def main(argv=None) -> int:
         root = jaxgo.from_pygo(cfg, st)
         roots = jax.tree.map(lambda x: x[None], root)
         if gumbel:
-            visits, _, best = search(None, None, roots, rng)
+            visits, _, best, _ = search(None, None, roots, rng)
             action = int(jax.device_get(best)[0])
             counts = jax.device_get(visits)[0]
         else:

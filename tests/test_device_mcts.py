@@ -218,7 +218,7 @@ def test_gumbel_visits_follow_schedule():
                               m_root=8)
     roots = new_states(CFG, 3)
     rng = jax.random.key(7)
-    visits, q, best = jax.device_get(
+    visits, q, best, pi = jax.device_get(
         search(None, None, roots, rng))
     plan_total = sum(k * v for k, v in search.schedule)
     top_total = sum(v for _, v in search.schedule)
@@ -226,7 +226,7 @@ def test_gumbel_visits_follow_schedule():
     np.testing.assert_array_equal(visits.max(axis=1), top_total)
     # with constant values, best == argmax of the gumbel-perturbed
     # logits (recover them via init with the same rng)
-    _, g, cand = search.init(None, None, roots, rng)
+    _, g, cand, _ = search.init(None, None, roots, rng)
     np.testing.assert_array_equal(best, np.asarray(g).argmax(axis=1))
     np.testing.assert_array_equal(best, np.asarray(cand)[:, 0])
 
@@ -239,12 +239,13 @@ def test_gumbel_chunked_equals_monolithic():
                               m_root=8)
     roots = new_states(CFG, 2)
     rng = jax.random.key(3)
-    v1, q1, b1 = jax.device_get(search(None, None, roots, rng))
-    v2, q2, b2 = jax.device_get(
+    v1, q1, b1, p1 = jax.device_get(search(None, None, roots, rng))
+    v2, q2, b2, p2 = jax.device_get(
         search.run_chunked(None, None, roots, rng, chunk=5))
     np.testing.assert_array_equal(v1, v2)
     np.testing.assert_array_equal(b1, b2)
     np.testing.assert_allclose(q1, q2, rtol=1e-6)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
 
 
 def test_gumbel_finds_capture():
@@ -268,7 +269,7 @@ def test_gumbel_finds_capture():
     roots = jax.tree.map(lambda x: x[None], root)
     capture = 0 * SIZE + 1
     for seed in (0, 1, 2):
-        _, _, best = jax.device_get(
+        _, _, best, _ = jax.device_get(
             search(None, None, roots, jax.random.key(seed)))
         assert int(best[0]) == capture, (seed, int(best[0]))
 
@@ -289,3 +290,38 @@ def test_gumbel_player_plays_gtp_game():
         reply, _ = engine.handle(cmd + "\n")
         assert reply.startswith(ok_prefix), (cmd, reply)
     assert reply.split()[-1].upper() != "RESIGN"
+
+
+def test_improved_policy_reduces_to_priors_on_constant_value():
+    """With a constant value net, completed q is constant, so sigma
+    adds the same offset everywhere and pi' must equal the root
+    priors (softmax of the unmodified masked logits)."""
+    from rocalphago_tpu.search.device_mcts import make_gumbel_mcts
+
+    search = make_gumbel_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value_zero, n_sim=16, max_nodes=32,
+                              m_root=8)
+    roots = new_states(CFG, 2)
+    _, _, _, pi = jax.device_get(
+        search(None, None, roots, jax.random.key(0)))
+    np.testing.assert_allclose(pi.sum(axis=-1), 1.0, rtol=1e-5)
+    # uniform logits over 25 sensible moves, pass masked out
+    np.testing.assert_allclose(pi[:, :N], 1.0 / N, rtol=1e-4)
+    np.testing.assert_allclose(pi[:, N], 0.0, atol=1e-6)
+
+
+def test_gumbel_selfplay_records_improved_policy():
+    from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
+
+    run = make_mcts_selfplay(CFG, FEATS, VFEATS, fake_policy,
+                             fake_value, batch=2, max_moves=6,
+                             n_sim=8, max_nodes=16, sim_chunk=4,
+                             record_visits=True, gumbel=True,
+                             m_root=4)
+    final, actions, live, targets = run(None, None, jax.random.key(1))
+    t = np.asarray(targets)
+    assert t.dtype == np.float32
+    assert t.shape == (actions.shape[0], 2, N + 1)
+    np.testing.assert_allclose(t.sum(axis=-1), 1.0, rtol=1e-4)
+    acts = np.asarray(actions)
+    assert ((acts >= 0) & (acts <= N)).all()

@@ -95,3 +95,27 @@ def test_zero_cli_trains_saves_and_resumes(tmp_path, nets):
              .splitlines()]
     assert any(e["event"] == "resume" and e["iteration"] == 1
                for e in lines)
+
+
+def test_zero_iteration_gumbel_targets(nets):
+    """The Gumbel variant: self-play plays halving winners and the
+    policy learns from pi' (improved policy) float targets - one
+    iteration must move both nets with finite losses."""
+    pol, val = nets
+    cfg = GoConfig(size=SIZE)
+    tx_p, tx_v = optax.sgd(0.01), optax.sgd(0.01)
+    iteration = make_zero_iteration(
+        cfg, FEATS, VFEATS, pol.module.apply, val.module.apply,
+        tx_p, tx_v, batch=2, move_limit=30, n_sim=8, max_nodes=16,
+        sim_chunk=4, replay_chunk=8, gumbel=True)
+    state = init_zero_state(pol.params, val.params, tx_p, tx_v,
+                            seed=3)
+    new_state, metrics = iteration(state)
+    for k in ("policy_loss", "value_loss"):
+        assert np.isfinite(float(metrics[k])), (k, metrics[k])
+    flat0, _ = jax.flatten_util.ravel_pytree(state.policy_params)
+    flat1, _ = jax.flatten_util.ravel_pytree(new_state.policy_params)
+    assert not np.allclose(np.asarray(flat0), np.asarray(flat1))
+    vflat0, _ = jax.flatten_util.ravel_pytree(state.value_params)
+    vflat1, _ = jax.flatten_util.ravel_pytree(new_state.value_params)
+    assert not np.allclose(np.asarray(vflat0), np.asarray(vflat1))
