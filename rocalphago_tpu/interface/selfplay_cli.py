@@ -102,7 +102,18 @@ def main(argv=None):
                          "--opponent/--shard)")
     ap.add_argument("--value", default=None,
                     help="value model JSON (with --search-sims)")
+    ap.add_argument("--gumbel", action="store_true",
+                    help="with --search-sims: Gumbel root search "
+                         "(sequential halving) instead of PUCT; "
+                         "plays each ply's halving winner, so "
+                         "--temperature does not apply")
+    ap.add_argument("--m-root", type=int, default=16,
+                    help="gumbel root candidate count; lower it at "
+                         "small --search-sims (every halving phase "
+                         "visits each survivor at least once)")
     a = ap.parse_args(argv)
+    if a.gumbel and not a.search_sims:
+        raise SystemExit("--gumbel requires --search-sims")
     if a.games % 2 and not a.search_sims:
         # search self-play uses ONE net for both colors — no color
         # split, so odd batches are fine there
@@ -129,7 +140,8 @@ def main(argv=None):
             net.module.apply, value.module.apply, batch=a.games,
             max_moves=a.max_moves, n_sim=a.search_sims,
             max_nodes=2 * a.search_sims, temperature=a.temperature,
-            sim_chunk=a.chunk or 8)
+            sim_chunk=a.chunk or 8, gumbel=a.gumbel,
+            m_root=a.m_root)
 
         def run(params_a, params_b, rng):
             final, actions, live = mcts_run(params_a, value.params,

@@ -638,7 +638,11 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        record_visits: bool = False,
                        gumbel: bool = False, m_root: int = 16):
     """Search-driven self-play: every move of every game comes from a
-    fresh :func:`make_device_mcts` search over the batch.
+    fresh on-device search over the batch — PUCT
+    (:func:`make_device_mcts`, move sampled from root visit counts by
+    ``temperature``) or, with ``gumbel=True``,
+    :func:`make_gumbel_mcts` (each ply plays the halving winner;
+    ``temperature`` does not apply — see the return-contract note).
 
     This is the AlphaZero-shaped generation loop the reference never
     had (its RL self-play samples the raw policy; SURVEY.md §3.2) —
