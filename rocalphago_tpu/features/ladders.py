@@ -27,6 +27,8 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -374,8 +376,6 @@ def _chase_impl() -> str:
     interpreter — CPU CI). Read from ``$ROCALPHAGO_PALLAS_CHASE`` at
     trace time; the kernel is opt-in until real-chip measurements
     favor it (same policy as ``ops.labels``)."""
-    import os
-
     v = os.environ.get("ROCALPHAGO_PALLAS_CHASE", "")
     return {"1": "pallas", "pallas": "pallas",
             "interpret": "interpret"}.get(v, "xla")
@@ -399,6 +399,21 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
     (slot_idx,) = jnp.nonzero(need_chase, size=slots, fill_value=k)
     valid = slot_idx < k
     safe = jnp.where(valid, slot_idx, 0)
+    if os.environ.get("ROCALPHAGO_DEBUG_LADDER_OVERFLOW") == "1":
+        # runtime signal for the silent truncation contract (advisor
+        # r2): flag positions whose live chases exceed capacity so a
+        # user encoding dense ladder problems knows to raise
+        # ``ladder_chase_slots``. Trace-time opt-in — zero cost off.
+        # host-side condition: under the encoder's vmap a lax.cond
+        # lowers to both-branches select, which would print for every
+        # board; the callback sees each board's own count instead
+        def _warn(c):
+            if int(c) > slots:
+                print(f"ladders: {int(c)} live chases > {slots} "
+                      "chase slots — truncating (raise "
+                      "ladder_chase_slots)")
+
+        jax.debug.callback(_warn, need_chase.sum())
     impl = _chase_impl()
     if impl == "xla":
         captured = jax.vmap(
