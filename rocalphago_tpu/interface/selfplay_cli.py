@@ -111,9 +111,20 @@ def main(argv=None):
                     help="gumbel root candidate count; lower it at "
                          "small --search-sims (every halving phase "
                          "visits each survivor at least once)")
+    ap.add_argument("--dirichlet-alpha", type=float, default=0.0,
+                    help="AlphaZero root-noise Dir(α) for PUCT "
+                         "search self-play (0 = off; incompatible "
+                         "with --gumbel)")
+    ap.add_argument("--noise-frac", type=float, default=0.25,
+                    help="root-noise mix fraction ε")
     a = ap.parse_args(argv)
     if a.gumbel and not a.search_sims:
         raise SystemExit("--gumbel requires --search-sims")
+    if a.dirichlet_alpha and not a.search_sims:
+        raise SystemExit("--dirichlet-alpha requires --search-sims")
+    if a.dirichlet_alpha and a.gumbel:
+        raise SystemExit("--dirichlet-alpha is PUCT-mode root noise; "
+                         "--gumbel explores via the gumbel draw")
     if a.games % 2 and not a.search_sims:
         # search self-play uses ONE net for both colors — no color
         # split, so odd batches are fine there
@@ -141,7 +152,8 @@ def main(argv=None):
             max_moves=a.max_moves, n_sim=a.search_sims,
             max_nodes=2 * a.search_sims, temperature=a.temperature,
             sim_chunk=a.chunk or 8, gumbel=a.gumbel,
-            m_root=a.m_root)
+            m_root=a.m_root, dirichlet_alpha=a.dirichlet_alpha,
+            noise_frac=a.noise_frac)
 
         def run(params_a, params_b, rng):
             final, actions, live = mcts_run(params_a, value.params,

@@ -347,13 +347,17 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         tree = run_sims(params_p, params_v, tree, n_sim)
         return _root_stats(tree)
 
-    def run_chunked(params_p, params_v, roots: GoState, chunk: int):
+    def run_chunked(params_p, params_v, roots: GoState, chunk: int,
+                    tree: DeviceTree | None = None):
         """Full search as ``chunk``-simulation compiled programs with
         the tree device-resident in between — THE way to drive this
         on watchdog-limited backends (the ~40s TPU worker limit);
         identical results to :func:`search` (deterministic, the tree
-        carry is the entire state)."""
-        tree = search.init(params_p, params_v, roots)
+        carry is the entire state). Pass ``tree`` to resume from a
+        prepared tree (e.g. root priors mixed with exploration noise)
+        instead of ``init(roots)``."""
+        if tree is None:
+            tree = search.init(params_p, params_v, roots)
         for done in range(0, n_sim, chunk):
             tree = run_sims(params_p, params_v, tree,
                             k=min(chunk, n_sim - done))
@@ -399,7 +403,7 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                      value_features: tuple,
                      policy_apply: Callable, value_apply: Callable,
                      n_sim: int, max_nodes: int, m_root: int = 16,
-                     c_visit: float = 50.0, c_scale: float = 1.0,
+                     c_visit: float = 50.0, c_scale: float = 0.1,
                      c_puct: float = 5.0):
     """Gumbel root search over the device tree (Danihelka et al. 2022,
     the mctx pattern): the move decision at low simulation budgets.
@@ -415,7 +419,9 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
        gets the same number of simulations per phase (scheduled by
        :func:`_halving_schedule`; below the root, selection stays
        PUCT), then the worse half is dropped by the score
-       ``g(a) + σ(q̂(a))`` with ``σ(q) = (c_visit + max_N)·c_scale·q``;
+       ``g(a) + σ(q̂(a))``, where σ min–max-rescales the completed q̂
+       to [0, 1] and scales by ``(c_visit + max_N)·c_scale`` (see
+       :func:`_sigma_completed`);
     3. returns the last survivor as ``best`` — the action the player
        should take (argmax root visits is the PUCT convention; under
        a halving schedule visit counts reflect the schedule, not the
@@ -454,31 +460,49 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         _, cand = lax.top_k(g, m)
         return tree, g, cand.astype(jnp.int32), logits
 
-    def _sigma(visits, values):
-        """The Gumbel value transform σ: monotone scaling of a value
-        estimate onto the logit scale, weighted up as the search gets
-        more evidence (``max_N``)."""
-        maxn = visits.max(axis=-1, keepdims=True).astype(jnp.float32)
-        return (c_visit + maxn) * c_scale * values
+    def _sigma_completed(tree: DeviceTree):
+        """σ(completed q̂) over every root action — the Gumbel value
+        transform shared by halving ranking and the π' target
+        (mctx's ``qtransform_completed_by_mix_value`` shape):
 
-    def _scores(tree: DeviceTree, g):
-        visits, q = base.root_stats(tree)
-        return jnp.where(visits > 0, g + _sigma(visits, q), g)
+        1. complete: unvisited actions take the visit-weighted mean
+           of the visited q̂ (a no-extra-eval simplification of
+           mctx's prior-weighted mixed value);
+        2. rescale completed q̂ to [0, 1] per state (min–max over the
+           prior-supported actions) — without this, raw q ∈ [-1, 1]
+           times (c_visit + maxN) swamps the logits and π' collapses
+           to argmax-of-value-noise (observed: a π'-target zero run
+           whose policy loss would not fall);
+        3. scale by ``(c_visit + max_N) · c_scale`` (mctx defaults:
+           50.0 / 0.1), growing value weight as evidence accumulates.
 
-    def improved_policy(tree: DeviceTree, logits):
-        """π' = softmax(logits + σ(completed q̂)) — the Gumbel MuZero
-        training target. Unvisited actions are completed with the
-        visit-weighted mean of the visited q̂ (a simplification of
-        mctx's prior-weighted mixed value: no extra value-net call,
-        same fixed point when the net is consistent)."""
+        Returns ``(visits, sigma)``.
+        """
         visits, q = base.root_stats(tree)
         nv = visits.astype(jnp.float32)
         total = nv.sum(axis=-1, keepdims=True)
         q_bar = (nv * q).sum(axis=-1, keepdims=True) \
             / jnp.maximum(total, 1.0)
         completed = jnp.where(visits > 0, q, q_bar)
-        masked = jnp.where(logits > neg / 2,
-                           logits + _sigma(visits, completed), neg)
+        valid = tree.prior[:, 0, :] > 0
+        lo = jnp.min(jnp.where(valid, completed, jnp.inf),
+                     axis=-1, keepdims=True)
+        hi = jnp.max(jnp.where(valid, completed, -jnp.inf),
+                     axis=-1, keepdims=True)
+        rescaled = (completed - lo) / jnp.maximum(hi - lo, 1e-8)
+        rescaled = jnp.where(valid & (hi > lo), rescaled, 0.0)
+        maxn = visits.max(axis=-1, keepdims=True).astype(jnp.float32)
+        return visits, (c_visit + maxn) * c_scale * rescaled
+
+    def _scores(tree: DeviceTree, g):
+        visits, sigma = _sigma_completed(tree)
+        return jnp.where(visits > 0, g + sigma, g)
+
+    def improved_policy(tree: DeviceTree, logits):
+        """π' = softmax(logits + σ(completed q̂)) — the Gumbel MuZero
+        training target (see :func:`_sigma_completed`)."""
+        _, sigma = _sigma_completed(tree)
+        masked = jnp.where(logits > neg / 2, logits + sigma, neg)
         return jax.nn.softmax(masked, axis=-1)
 
     def rerank(tree: DeviceTree, g, cand, k: int):
@@ -636,7 +660,9 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        c_puct: float = 5.0, temperature: float = 1.0,
                        sim_chunk: int = 8,
                        record_visits: bool = False,
-                       gumbel: bool = False, m_root: int = 16):
+                       gumbel: bool = False, m_root: int = 16,
+                       dirichlet_alpha: float = 0.0,
+                       noise_frac: float = 0.25):
     """Search-driven self-play: every move of every game comes from a
     fresh on-device search over the batch — PUCT
     (:func:`make_device_mcts`, move sampled from root visit counts by
@@ -667,7 +693,20 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
     target) under ``gumbel=True``. Gumbel self-play plays each ply's
     halving winner directly: the per-ply fresh Gumbel draw is the
     exploration, so no visit-count temperature sampling applies.
+
+    ``dirichlet_alpha > 0`` (PUCT mode only) mixes AlphaZero root
+    exploration noise into each ply's root priors before the
+    simulations: ``p ← (1−ε)·p + ε·Dir(α)`` over the prior-supported
+    actions, with ``ε = noise_frac`` (paper values: α=0.03, ε=0.25
+    for 19×19). Self-play generation only — serving
+    (:class:`DeviceMCTSPlayer`) never adds noise. Gumbel mode
+    rejects the knob: the gumbel draw is already the root
+    exploration mechanism.
     """
+    if gumbel and dirichlet_alpha > 0:
+        raise ValueError(
+            "dirichlet_alpha is a PUCT-mode knob; gumbel self-play's "
+            "root exploration is the gumbel draw itself")
     if gumbel:
         search = make_gumbel_mcts(cfg, policy_features,
                                   value_features, policy_apply,
@@ -705,6 +744,28 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         live = ~states.done
         return vstep(states, best), best, live
 
+    @jax.jit
+    def add_root_noise(tree: DeviceTree, rng):
+        """AlphaZero root exploration: mix Dir(α) into the root
+        priors over the prior-supported actions."""
+        p0 = tree.prior[:, 0, :]
+        valid = p0 > 0
+        gam = jnp.where(valid, jax.random.gamma(
+            rng, dirichlet_alpha, p0.shape, jnp.float32), 0.0)
+        dirichlet = gam / jnp.maximum(
+            gam.sum(axis=-1, keepdims=True), 1e-12)
+        mixed = jnp.where(
+            valid, (1.0 - noise_frac) * p0 + noise_frac * dirichlet,
+            0.0)
+        return tree._replace(prior=tree.prior.at[:, 0, :].set(mixed))
+
+    def puct_search_noisy(params_p, params_v, states, rng):
+        """init → noise → the searcher's own chunk loop."""
+        tree = search.init(params_p, params_v, states)
+        tree = add_root_noise(tree, rng)
+        return search.run_chunked(params_p, params_v, states,
+                                  sim_chunk, tree=tree)
+
     def run(params_p, params_v, rng):
         states = new_states(cfg, batch)
         actions, lives, visit_seq = [], [], []
@@ -715,6 +776,13 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                     params_p, params_v, states, sub, sim_chunk)
                 states, action, live = step_best(states, best)
                 target = pi
+            elif dirichlet_alpha > 0:
+                rng, sub = jax.random.split(rng)
+                visits, _ = puct_search_noisy(params_p, params_v,
+                                              states, sub)
+                states, rng, action, live = pick_and_step(
+                    states, visits, rng)
+                target = visits
             else:
                 visits, _ = search.run_chunked(params_p, params_v,
                                                states, sim_chunk)

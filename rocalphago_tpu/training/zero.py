@@ -65,7 +65,9 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                         batch: int, move_limit: int, n_sim: int,
                         max_nodes: int, temperature: float = 1.0,
                         sim_chunk: int = 8, replay_chunk: int = 10,
-                        gumbel: bool = False, m_root: int = 16):
+                        gumbel: bool = False, m_root: int = 16,
+                        dirichlet_alpha: float = 0.0,
+                        noise_frac: float = 0.25):
     """``(ZeroState) -> (ZeroState, metrics)`` — one full iteration:
     search self-play, replay-gradient accumulation for both nets, one
     optimizer step each. Host-driven (chunk-compiled throughout); the
@@ -76,7 +78,8 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         cfg, policy_features, value_features, policy_apply,
         value_apply, batch, move_limit, n_sim, max_nodes,
         temperature=temperature, sim_chunk=sim_chunk,
-        record_visits=True, gumbel=gumbel, m_root=m_root)
+        record_visits=True, gumbel=gumbel, m_root=m_root,
+        dirichlet_alpha=dirichlet_alpha, noise_frac=noise_frac)
 
     n_policy_planes = output_planes(policy_features)
     vgd = jax.vmap(lambda s: jaxgo.group_data(
@@ -257,7 +260,16 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--m-root", type=int, default=16,
                     help="gumbel root candidate count (top-k of the "
                          "gumbel-perturbed logits)")
+    ap.add_argument("--dirichlet-alpha", type=float, default=0.0,
+                    help="AlphaZero root-noise Dir(α) for PUCT "
+                         "self-play (0 = off; paper: 0.03 on 19x19; "
+                         "incompatible with --gumbel)")
+    ap.add_argument("--noise-frac", type=float, default=0.25,
+                    help="root-noise mix fraction ε")
     a = ap.parse_args(argv)
+    if a.gumbel and a.dirichlet_alpha > 0:
+        raise SystemExit("--dirichlet-alpha is PUCT-mode root noise; "
+                         "--gumbel explores via the gumbel draw")
     if a.gumbel and a.temperature != 1.0:
         print("zero: --temperature is ignored with --gumbel (the "
               "per-ply gumbel draw is the exploration)",
@@ -279,7 +291,8 @@ def run_training(argv=None) -> dict:
         max_nodes=a.max_nodes or 2 * a.sims,
         temperature=a.temperature, sim_chunk=a.sim_chunk,
         replay_chunk=a.replay_chunk, gumbel=a.gumbel,
-        m_root=a.m_root)
+        m_root=a.m_root, dirichlet_alpha=a.dirichlet_alpha,
+        noise_frac=a.noise_frac)
     state = init_zero_state(policy.params, value.params, tx_p, tx_v,
                             seed=a.seed)
 

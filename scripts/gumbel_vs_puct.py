@@ -87,7 +87,7 @@ def main(argv=None) -> int:
                                 fake_value, **mk)
         gmb = make_gumbel_mcts(cfg, feats, vfeats, fake_policy,
                                fake_value, m_root=min(16, n + 1),
-                               c_scale=4.0, **mk)
+                               **mk)
         rng = jax.random.key(a.seed + n_sim)
         tally = [0, 0, 0]          # gumbel, puct, draw
         t0 = time.time()

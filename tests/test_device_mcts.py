@@ -325,3 +325,28 @@ def test_gumbel_selfplay_records_improved_policy():
     np.testing.assert_allclose(t.sum(axis=-1), 1.0, rtol=1e-4)
     acts = np.asarray(actions)
     assert ((acts >= 0) & (acts <= N)).all()
+
+
+def test_dirichlet_root_noise_perturbs_search():
+    """PUCT self-play with root noise: different rng seeds must yield
+    different visit patterns (the noiseless searcher is fully
+    deterministic), and gumbel+noise is rejected up front."""
+    from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
+
+    runs = {}
+    for seed in (0, 1):
+        run = make_mcts_selfplay(
+            CFG, FEATS, VFEATS, fake_policy, fake_value, batch=2,
+            max_moves=1, n_sim=12, max_nodes=24, sim_chunk=6,
+            record_visits=True, dirichlet_alpha=1.0, noise_frac=0.5,
+            temperature=0)
+        _, _, _, targets = run(None, None, jax.random.key(seed))
+        runs[seed] = np.asarray(targets)
+    assert not np.array_equal(runs[0], runs[1]), (
+        "root noise had no effect on the search")
+
+    with pytest.raises(ValueError, match="gumbel"):
+        make_mcts_selfplay(CFG, FEATS, VFEATS, fake_policy,
+                           fake_value, batch=2, max_moves=1, n_sim=8,
+                           max_nodes=16, gumbel=True,
+                           dirichlet_alpha=0.03)
