@@ -30,15 +30,32 @@ def main() -> None:
     ap.add_argument("--sims", type=int, default=64)
     ap.add_argument("--max-nodes", type=int, default=None,
                     help="tree slab capacity (default: 2x sims)")
+    ap.add_argument("--gumbel", action="store_true",
+                    help="Gumbel sequential-halving root search "
+                         "(make_gumbel_mcts) instead of PUCT")
     args = ap.parse_args()
     on_tpu = jax.devices()[0].platform == "tpu"
     batch = args.batch or (16 if on_tpu else 4)
-    max_nodes = args.max_nodes or 2 * args.sims
+    make = make_device_mcts
+    plan_sims = args.sims
+    if args.gumbel:
+        from rocalphago_tpu.search.device_mcts import (
+            _halving_schedule,
+            make_gumbel_mcts,
+        )
+
+        make = make_gumbel_mcts
+        # the halving plan can exceed the requested sims at small
+        # budgets — size the slab (and report) from the real count,
+        # or the bench would measure a capacity-saturated search
+        plan_sims = sum(k * v for k, v in _halving_schedule(
+            args.sims, min(16, args.board ** 2 + 1)))
+    max_nodes = args.max_nodes or 2 * plan_sims
 
     policy = CNNPolicy(board=args.board, layers=12,
                        filters_per_layer=128)
     value = CNNValue(board=args.board, layers=12, filters_per_layer=128)
-    search = make_device_mcts(
+    search = make(
         GoConfig(size=args.board), policy.feature_list,
         value.feature_list, policy.module.apply, value.module.apply,
         n_sim=args.sims, max_nodes=max_nodes)
@@ -48,16 +65,22 @@ def main() -> None:
     # tree device-resident between calls — the ~40s worker watchdog
     # must never see the whole search as one program
     chunk = 8 if on_tpu else args.sims
+    rng = [jax.random.key(0)]
 
     def once():
-        visits, _ = search.run_chunked(policy.params, value.params,
-                                       roots, chunk)
+        if args.gumbel:
+            rng[0], sub = jax.random.split(rng[0])
+            visits, _, _, _ = search.run_chunked(
+                policy.params, value.params, roots, sub, chunk)
+        else:
+            visits, _ = search.run_chunked(policy.params,
+                                           value.params, roots, chunk)
         return jax.device_get(visits)
 
     dt = timed(once, reps=args.reps, profile_dir=args.profile)
-    report("device_mcts_sims", batch * args.sims / dt, "sims/s",
-           batch=batch, sims=args.sims, max_nodes=max_nodes,
-           board=args.board)
+    report("device_mcts_sims", batch * plan_sims / dt, "sims/s",
+           batch=batch, sims=plan_sims, max_nodes=max_nodes,
+           board=args.board, gumbel=args.gumbel)
 
 
 if __name__ == "__main__":
