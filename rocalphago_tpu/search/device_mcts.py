@@ -74,6 +74,10 @@ class DeviceTree(NamedTuple):
     parent: jax.Array    # i32 [B, M]     -1 at the root
     paction: jax.Array   # i32 [B, M]
     n_nodes: jax.Array   # i32 [B]
+    root: jax.Array      # i32 [B]  current root node (0 at init;
+    #   advance_root moves it down a child edge for subtree reuse —
+    #   backups above it waste a few adds but root_stats never reads
+    #   them, and allocation keeps appending to the shared slab)
 
 
 def _state_at(states: GoState, idx) -> GoState:
@@ -170,6 +174,7 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             parent=jnp.full((batch, m), -1, jnp.int32),
             paction=jnp.zeros((batch, m), jnp.int32),
             n_nodes=jnp.ones((batch,), jnp.int32),
+            root=jnp.zeros((batch,), jnp.int32),
         )
 
     def _select_action(prior_n, visits_n, value_n):
@@ -188,9 +193,9 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         return jnp.argmax(score).astype(jnp.int32)
 
     def _descend_one(prior, visits, value_sum, child, done_m,
-                     root_action):
+                     root_action, root):
         """Single-game descend ([M, ...] arrays): walk existing child
-        pointers from the root until an unexpanded edge or a terminal
+        pointers from ``root`` until an unexpanded edge or a terminal
         node. Returns ``(node, action)``; ``action`` = -1 when the
         walk ended ON a terminal node (evaluate that node itself).
 
@@ -216,11 +221,12 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         # pre-execute the root step with the forced action (if any):
         # the carry then starts at the forced edge's child — or stops
         # on the root edge itself when it is unexpanded/terminal
-        at_term0 = done_m[0]
+        at_term0 = done_m[root]
         forced = (root_action >= 0) & ~at_term0
-        nxt0 = jnp.where(forced, child[0, root_action], -1)
+        nxt0 = jnp.where(forced, child[root, root_action], -1)
         stop0 = at_term0 | (forced & (nxt0 < 0))
-        init = (jnp.where(stop0 | ~forced, 0, nxt0).astype(jnp.int32),
+        init = (jnp.where(stop0 | ~forced, root, nxt0)
+                .astype(jnp.int32),
                 jnp.where(at_term0, -1,
                           jnp.where(forced, root_action, -1))
                 .astype(jnp.int32),
@@ -259,7 +265,7 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
                 (tree.n_nodes.shape[0],), -1, jnp.int32)
         node, action = jax.vmap(_descend_one)(
             tree.prior, tree.visits, tree.value_sum, tree.child,
-            tree.states.done, root_actions)
+            tree.states.done, root_actions, tree.root)
 
         # candidate child states: step the selected edge (terminal
         # descends step a no-op pass on an already-done state — the
@@ -321,16 +327,32 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             start_node, start_action, values)
 
         return DeviceTree(states, prior, visits, value_sum, child,
-                          parent, paction, n_nodes)
+                          parent, paction, n_nodes, tree.root)
 
     def _root_stats(tree: DeviceTree):
-        root_visits = tree.visits[:, 0, :]
+        idx = tree.root[:, None, None]
+        root_visits = jnp.take_along_axis(tree.visits, idx,
+                                          axis=1)[:, 0, :]
+        root_vsum = jnp.take_along_axis(tree.value_sum, idx,
+                                        axis=1)[:, 0, :]
         root_q = jnp.where(
             root_visits > 0,
-            tree.value_sum[:, 0, :]
+            root_vsum
             / jnp.maximum(root_visits.astype(jnp.float32), 1.0),
             0.0)
         return root_visits, root_q
+
+    @jax.jit
+    def advance_root(tree: DeviceTree, actions):
+        """Move each game's root down the ``actions`` edge (subtree
+        reuse after a move is played). Returns ``(tree, ok bool [B])``
+        — where the edge is unexpanded (``ok`` False) the root is
+        unchanged and the caller must rebuild with :func:`init`."""
+        nxt = jax.vmap(lambda c, r, a: c[r, a])(
+            tree.child, tree.root, actions.astype(jnp.int32))
+        ok = nxt >= 0
+        return tree._replace(
+            root=jnp.where(ok, nxt, tree.root).astype(jnp.int32)), ok
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def run_sims(params_p, params_v, tree: DeviceTree, k: int):
@@ -347,6 +369,16 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         tree = run_sims(params_p, params_v, tree, n_sim)
         return _root_stats(tree)
 
+    def run_sims_chunked(params_p, params_v, tree: DeviceTree,
+                         chunk: int) -> DeviceTree:
+        """The one owner of the watchdog chunk schedule: ``n_sim``
+        simulations as ``chunk``-sized compiled programs, tree
+        device-resident in between."""
+        for done in range(0, n_sim, chunk):
+            tree = run_sims(params_p, params_v, tree,
+                            k=min(chunk, n_sim - done))
+        return tree
+
     def run_chunked(params_p, params_v, roots: GoState, chunk: int,
                     tree: DeviceTree | None = None):
         """Full search as ``chunk``-simulation compiled programs with
@@ -354,14 +386,12 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         on watchdog-limited backends (the ~40s TPU worker limit);
         identical results to :func:`search` (deterministic, the tree
         carry is the entire state). Pass ``tree`` to resume from a
-        prepared tree (e.g. root priors mixed with exploration noise)
-        instead of ``init(roots)``."""
+        prepared tree (e.g. root priors mixed with exploration noise,
+        or a reused subtree) instead of ``init(roots)``."""
         if tree is None:
             tree = search.init(params_p, params_v, roots)
-        for done in range(0, n_sim, chunk):
-            tree = run_sims(params_p, params_v, tree,
-                            k=min(chunk, n_sim - done))
-        return search.root_stats(tree)
+        return search.root_stats(
+            run_sims_chunked(params_p, params_v, tree, chunk))
 
     # chunk-driving surface (same convention as the chunked runners):
     # search.init → DeviceTree, search.run_sims(…, k=) → DeviceTree,
@@ -369,9 +399,11 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     # all three composed
     search.init = jax.jit(init_tree)
     search.run_sims = run_sims
+    search.run_sims_chunked = run_sims_chunked
     search.root_stats = jax.jit(_root_stats)
     search.run_chunked = run_chunked
     search.simulate = simulate          # forced-root hook (Gumbel)
+    search.advance_root = advance_root  # subtree reuse across moves
     return search
 
 
@@ -582,14 +614,25 @@ class DeviceMCTSPlayer:
     host state is bridged once (:func:`jaxgo.from_pygo`), the whole
     search runs on device (chunk-driven under the worker watchdog),
     and the argmax-visits move comes back — two host↔device transfers
-    per move, total. No subtree reuse across moves (slab searches
-    rebuild; see :func:`make_device_mcts`).
+    per move, total.
+
+    PUCT serving REUSES the previous move's subtree: the tree is
+    carried across ``get_move`` calls and its root walked down the
+    moves actually played (``advance_root``), so the new search
+    starts from the visits the old one already spent below that child
+    — the host-tree player's ``update_with_move`` economy, in slab
+    form. Falls back to a fresh tree on komi/board change, undo, an
+    unexpanded edge, a near-full slab, or any position mismatch
+    (handicap stones placed outside the history); ``reuse=False``
+    disables, ``.reuses`` counts engagements. Gumbel mode always
+    rebuilds (its root draw is per-move by design).
     """
 
     def __init__(self, value_net, policy_net, n_sim: int = 100,
                  max_nodes: int | None = None, c_puct: float = 5.0,
                  sim_chunk: int = 8, gumbel: bool = False,
-                 m_root: int = 16, seed: int = 0):
+                 m_root: int = 16, seed: int = 0,
+                 reuse: bool = True):
         self.policy = policy_net
         self.value = value_net
         self.board = policy_net.board
@@ -601,6 +644,14 @@ class DeviceMCTSPlayer:
         self._gumbel = gumbel
         self._m_root = m_root
         self._rng = jax.random.key(seed)
+        # subtree reuse (PUCT only — gumbel redraws its root noise
+        # every move, so its tree is rebuilt by design): the previous
+        # move's tree + the (komi, turns_played) it was searched at;
+        # get_move walks the actual history delta down child pointers
+        # and resumes the search from the shifted root when possible
+        self._reuse = reuse and not gumbel
+        self._carry = None
+        self.reuses = 0     # observability: # of reused searches
         # searchers are cached PER KOMI: the search's terminal-node
         # evaluations score with its GoConfig's komi, and GTP can set
         # any komi per game — same handling as the host MCTSPlayer's
@@ -610,6 +661,10 @@ class DeviceMCTSPlayer:
         # validation must fail at construction (like build_player's
         # missing-value guard), not on the first genmove
         self._searcher_for(self._cfg.komi)
+
+    def reset(self) -> None:
+        """Forget cross-move search state (new game)."""
+        self._carry = None
 
     def _searcher_for(self, komi: float):
         if komi not in self._searchers:
@@ -626,13 +681,55 @@ class DeviceMCTSPlayer:
                 c_puct=self._c_puct))
         return self._searchers[komi]
 
+    def _reused_tree(self, search, state, komi, bridged):
+        """Walk the carried tree's root down the moves actually played
+        since it was searched; None when a rebuild is needed (no
+        carry, komi/board changed, undo, unexpanded edge, the shared
+        slab is nearly full, or the walked-to position does not match
+        the real one — e.g. free handicap stones placed outside the
+        move history)."""
+        import numpy as np
+
+        from rocalphago_tpu.utils.coords import flatten_idx
+
+        if self._carry is None:
+            return None
+        ck, csize, cturns, tree = self._carry
+        if (ck != komi or csize != state.size
+                or state.turns_played < cturns):
+            return None
+        n = csize * csize
+        for mv in state.history[cturns:]:
+            a = n if mv is None else flatten_idx(mv, csize)
+            tree, ok = search.advance_root(
+                tree, jnp.array([a], jnp.int32))
+            if not bool(jax.device_get(ok)[0]):
+                return None
+        if int(jax.device_get(tree.n_nodes)[0]) \
+                > 0.75 * self._max_nodes:
+            return None                # slab nearly full: rebuild
+        # identity check: the reused root must BE the position we
+        # were asked to search (board + turn + ko) — anything the
+        # history walk can't see (handicap placement, clear_board)
+        # falls back to a fresh tree instead of searching a stale one
+        r = int(jax.device_get(tree.root)[0])
+        rs = jax.device_get(jax.tree.map(
+            lambda x: x[0, r], tree.states))
+        ok_pos = (np.array_equal(np.asarray(rs.board),
+                                 np.asarray(jax.device_get(
+                                     bridged.board)))
+                  and int(rs.turn) == int(jax.device_get(bridged.turn))
+                  and int(rs.ko) == int(jax.device_get(bridged.ko)))
+        return tree if ok_pos else None
+
     def get_move(self, state):
         import numpy as np
 
         from rocalphago_tpu.engine import jaxgo as _jaxgo
         from rocalphago_tpu.utils.coords import unflatten_idx
 
-        cfg, search = self._searcher_for(float(state.komi))
+        komi = float(state.komi)
+        cfg, search = self._searcher_for(komi)
         root = _jaxgo.from_pygo(cfg, state)
         roots = jax.tree.map(lambda x: x[None], root)
         if self._gumbel:
@@ -643,11 +740,22 @@ class DeviceMCTSPlayer:
             action = int(jax.device_get(best)[0])
             counts = np.asarray(jax.device_get(visits))[0]
         else:
-            visits, _ = search.run_chunked(
-                self.policy.params, self.value.params, roots,
+            tree = (self._reused_tree(search, state, komi, root)
+                    if self._reuse else None)
+            if tree is not None:
+                self.reuses += 1
+            else:
+                tree = search.init(self.policy.params,
+                                   self.value.params, roots)
+            tree = search.run_sims_chunked(
+                self.policy.params, self.value.params, tree,
                 self._chunk)
+            visits, _ = search.root_stats(tree)
             counts = np.asarray(jax.device_get(visits))[0]
             action = int(counts.argmax())
+            if self._reuse:
+                self._carry = (komi, state.size, state.turns_played,
+                               tree)
         if action >= cfg.num_points or counts[action] == 0:
             return None                              # pass
         return unflatten_idx(action, cfg.size)

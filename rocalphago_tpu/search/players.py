@@ -162,6 +162,8 @@ def reset_player(player) -> None:
     mcts = getattr(player, "mcts", None)
     if mcts is not None and hasattr(mcts, "reset"):
         mcts.reset()
+    if hasattr(player, "reset") and callable(player.reset):
+        player.reset()      # e.g. DeviceMCTSPlayer's carried tree
     if hasattr(player, "_tree_history"):
         player._tree_history = None
 

@@ -350,3 +350,73 @@ def test_dirichlet_root_noise_perturbs_search():
                            fake_value, batch=2, max_moves=1, n_sim=8,
                            max_nodes=16, gumbel=True,
                            dirichlet_alpha=0.03)
+
+
+def test_advance_root_follows_child_edges(searcher):
+    """advance_root moves the root down an expanded edge: the shifted
+    root's stats must equal the child node's rows, and searching from
+    it must keep accumulating there."""
+    roots = new_states(CFG, 1)
+    tree = searcher.init(None, None, roots)
+    tree = searcher.run_sims(None, None, tree, k=16)
+    visits0, _ = jax.device_get(searcher.root_stats(tree))
+    a = int(visits0[0].argmax())
+    child_idx = int(jax.device_get(tree.child)[0, 0, a])
+    assert child_idx >= 0
+    tree2, ok = searcher.advance_root(tree, jnp.array([a]))
+    assert bool(jax.device_get(ok)[0])
+    assert int(jax.device_get(tree2.root)[0]) == child_idx
+    v_child = jax.device_get(tree.visits)[0, child_idx]
+    v_root2, _ = jax.device_get(searcher.root_stats(tree2))
+    np.testing.assert_array_equal(v_root2[0], v_child)
+    # resumed search allocates/visits below the NEW root
+    tree3 = searcher.run_sims(None, None, tree2, k=8)
+    v_root3, _ = jax.device_get(searcher.root_stats(tree3))
+    assert v_root3.sum() == v_child.sum() + 8
+    # unexpanded edge: ok=False, root unchanged
+    unvisited = int(np.argmin(jax.device_get(
+        tree.child)[0, 0] >= 0))
+    _, ok2 = searcher.advance_root(tree, jnp.array([unvisited]))
+    assert not bool(jax.device_get(ok2)[0])
+
+
+def test_player_subtree_reuse_across_moves():
+    """A two-player scripted exchange: the second get_move must engage
+    the carried subtree (reuses == 1) and still return a legal move;
+    clear-board reset forgets it."""
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+    from rocalphago_tpu.search.players import reset_player
+
+    from rocalphago_tpu.utils.coords import flatten_idx, unflatten_idx
+
+    pol = CNNPolicy(FEATS, board=SIZE, layers=1, filters_per_layer=4)
+    val = CNNValue(VFEATS, board=SIZE, layers=1, filters_per_layer=4)
+    player = DeviceMCTSPlayer(val, pol, n_sim=32, max_nodes=128,
+                              sim_chunk=8)
+    st = pygo.GameState(size=SIZE)
+    mv = player.get_move(st)
+    assert player.reuses == 0
+    st.do_move(mv)
+    # pick an opponent reply the search actually EXPANDED (reuse can
+    # only follow explored edges): walk the carried tree to our
+    # move's child, take any grandchild edge
+    _, _, _, tree = player._carry
+    child = np.asarray(jax.device_get(tree.child))[0]
+    our_child = child[0, flatten_idx(mv, SIZE)]
+    assert our_child >= 0
+    replies = np.nonzero(child[our_child][:N] >= 0)[0]
+    assert replies.size, "no grandchildren expanded at 32 sims"
+    st.do_move(unflatten_idx(int(replies[0]), SIZE))
+    mv2 = player.get_move(st)
+    assert player.reuses == 1
+    assert mv2 is None or st.is_legal(mv2)
+    # an opponent move the search never expanded (pass) -> rebuild
+    st.do_move(mv2)
+    st.do_move(None)
+    player.get_move(st)
+    assert player.reuses == 1
+    reset_player(player)
+    st2 = pygo.GameState(size=SIZE)
+    player.get_move(st2)
+    assert player.reuses == 1             # fresh game -> fresh tree
