@@ -770,7 +770,7 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        record_visits: bool = False,
                        gumbel: bool = False, m_root: int = 16,
                        dirichlet_alpha: float = 0.0,
-                       noise_frac: float = 0.25):
+                       noise_frac: float = 0.25, mesh=None):
     """Search-driven self-play: every move of every game comes from a
     fresh on-device search over the batch — PUCT
     (:func:`make_device_mcts`, move sampled from root visit counts by
@@ -876,6 +876,13 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
 
     def run(params_p, params_v, rng):
         states = new_states(cfg, batch)
+        if mesh is not None:
+            # the search shards by placement alone (module docstring):
+            # sharding the game batch here shards every per-ply search
+            # and the engine steps; params stay replicated
+            from rocalphago_tpu.parallel import mesh as meshlib
+
+            states = meshlib.shard_batch(mesh, states)
         actions, lives, visit_seq = [], [], []
         for _ in range(max_moves):
             if gumbel:

@@ -46,6 +46,7 @@ from rocalphago_tpu.engine import jaxgo
 from rocalphago_tpu.features.planes import encode, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
+from rocalphago_tpu.parallel import mesh as meshlib
 from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
 from rocalphago_tpu.search.selfplay import sensible_mask
 
@@ -67,7 +68,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                         sim_chunk: int = 8, replay_chunk: int = 10,
                         gumbel: bool = False, m_root: int = 16,
                         dirichlet_alpha: float = 0.0,
-                        noise_frac: float = 0.25):
+                        noise_frac: float = 0.25, mesh=None):
     """``(ZeroState) -> (ZeroState, metrics)`` — one full iteration:
     search self-play, replay-gradient accumulation for both nets, one
     optimizer step each. Host-driven (chunk-compiled throughout); the
@@ -79,7 +80,8 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         value_apply, batch, move_limit, n_sim, max_nodes,
         temperature=temperature, sim_chunk=sim_chunk,
         record_visits=True, gumbel=gumbel, m_root=m_root,
-        dirichlet_alpha=dirichlet_alpha, noise_frac=noise_frac)
+        dirichlet_alpha=dirichlet_alpha, noise_frac=noise_frac,
+        mesh=mesh)
 
     n_policy_planes = output_planes(policy_features)
     vgd = jax.vmap(lambda s: jaxgo.group_data(
@@ -93,6 +95,12 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
     def ply(policy_params, value_params, winners, carry, xs):
         states, grads_p, grads_v, stats = carry
         actions_t, live_t, visits_t = xs
+        if mesh is not None:
+            # anchor the replayed game batch on the data axis (same
+            # pattern as the RL iteration); the batch-summed losses
+            # and grads then all-reduce via XLA-inserted collectives
+            states = lax.with_sharding_constraint(
+                states, meshlib.data_sharding(mesh))
 
         gd = vgd(states)
         planes = venc(states, gd)
@@ -177,6 +185,8 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         wf = winners.astype(jnp.float32)
 
         states = jaxgo.new_states(cfg, batch)
+        if mesh is not None:
+            states = meshlib.shard_batch(mesh, states)
         grads_p = jax.tree.map(jnp.zeros_like, state.policy_params)
         grads_v = jax.tree.map(jnp.zeros_like, state.value_params)
         stats = (jnp.float32(0), jnp.float32(0))
@@ -212,11 +222,13 @@ def run_training(argv=None) -> dict:
     metadata.json, per-save model.json exports loadable by
     GTP/tournament).
 
-    SINGLE-PROCESS trainer: unlike ``rl.py`` this CLI does not yet
-    replicate state over a mesh, so multi-host launches would train N
-    independent copies — run it on one process. (The underlying
-    search already shards over a mesh by root placement; wiring the
-    iteration like ``RLTrainer`` is the extension point.)"""
+    Multi-chip/multi-host wired like the sibling trainers:
+    ``distributed_init`` (no-op single-process), a ``(data, model)``
+    mesh with the game batch sharded over ``data`` (the search shards
+    by root placement; the replay's batch-summed grads all-reduce via
+    XLA collectives), replicated net/optimizer state, and
+    coordinator-only artifact writes (Orbax saves participate on
+    every process)."""
     import argparse
     import json
     import os
@@ -266,6 +278,9 @@ def run_training(argv=None) -> dict:
                          "incompatible with --gumbel)")
     ap.add_argument("--noise-frac", type=float, default=0.25,
                     help="root-noise mix fraction ε")
+    ap.add_argument("--num-devices", type=int, default=None,
+                    help="mesh width (default: every device whose "
+                         "count divides --game-batch)")
     a = ap.parse_args(argv)
     if a.gumbel and a.dirichlet_alpha > 0:
         raise SystemExit("--dirichlet-alpha is PUCT-mode root noise; "
@@ -282,6 +297,25 @@ def run_training(argv=None) -> dict:
             f"policy is {policy.board}x{policy.board} but value is "
             f"{value.board}x{value.board} — the nets must share a "
             "board size")
+    # multi-host/multi-chip bring-up, same wiring as the sibling
+    # trainers: DCN init (no-op single-process), a (data, model)
+    # mesh, the game batch sharded over data, state replicated,
+    # artifact writes on the coordinator only
+    meshlib.distributed_init()
+    requested = a.num_devices or len(jax.devices())
+    # the game batch shards over the data axis — use the largest
+    # device count that divides it (a 2-game smoke run on an 8-device
+    # mesh must not die on divisibility)
+    n_dev = requested
+    while a.game_batch % n_dev:
+        n_dev -= 1
+    if n_dev < requested:
+        print(f"zero: using {n_dev}/{requested} devices "
+              f"(--game-batch {a.game_batch} must divide evenly; "
+              "raise it to use the full mesh)", file=sys.stderr)
+    mesh = meshlib.make_mesh(n_dev)
+    coord = meshlib.is_coordinator()
+
     tx_p = optax.sgd(a.learning_rate)
     tx_v = optax.sgd(a.learning_rate)
     iteration = make_zero_iteration(
@@ -292,26 +326,33 @@ def run_training(argv=None) -> dict:
         temperature=a.temperature, sim_chunk=a.sim_chunk,
         replay_chunk=a.replay_chunk, gumbel=a.gumbel,
         m_root=a.m_root, dirichlet_alpha=a.dirichlet_alpha,
-        noise_frac=a.noise_frac)
-    state = init_zero_state(policy.params, value.params, tx_p, tx_v,
-                            seed=a.seed)
+        noise_frac=a.noise_frac, mesh=mesh)
+    state = meshlib.replicate(mesh, init_zero_state(
+        policy.params, value.params, tx_p, tx_v, seed=a.seed))
 
     os.makedirs(a.out_dir, exist_ok=True)
     ckpt = TrainCheckpointer(os.path.join(a.out_dir, "checkpoints"))
     metrics = MetricsLogger(
-        os.path.join(a.out_dir, "metrics.jsonl"), echo=True)
+        os.path.join(a.out_dir, "metrics.jsonl") if coord else None,
+        echo=coord)
     meta = MetadataWriter(
         os.path.join(a.out_dir, "metadata.json"),
-        header={"cmd": " ".join(sys.argv), "config": vars(a)})
+        header={"cmd": " ".join(sys.argv), "config": vars(a)},
+        enabled=coord)
     start = 0
     restored, _ = ckpt.restore(jax.device_get(state))
     if restored is not None:
-        state = ZeroState(*restored)
+        # re-replicate over the mesh (rl.py does the same): the
+        # restore yields host arrays, but the iteration's sharding
+        # contract is replicated state next to data-sharded batches
+        state = meshlib.replicate(mesh, ZeroState(*restored))
         start = int(state.iteration)
         metrics.log("resume", iteration=start)
     final = {}
 
     def export(it):
+        if not coord:
+            return
         for net, params, name in ((policy, state.policy_params,
                                    "policy"),
                                   (value, state.value_params,

@@ -119,3 +119,32 @@ def test_zero_iteration_gumbel_targets(nets):
     vflat0, _ = jax.flatten_util.ravel_pytree(state.value_params)
     vflat1, _ = jax.flatten_util.ravel_pytree(new_state.value_params)
     assert not np.allclose(np.asarray(vflat0), np.asarray(vflat1))
+
+
+def test_zero_iteration_sharded_matches_unsharded(nets):
+    """Mesh wiring is placement + constraints only: one iteration on
+    the virtual 8-device mesh must match the unsharded run
+    bit-for-bit (same rng, same math; XLA inserts the collectives)."""
+    from rocalphago_tpu.parallel import mesh as meshlib
+
+    pol, val = nets
+    cfg = GoConfig(size=SIZE)
+    tx_p, tx_v = optax.sgd(0.01), optax.sgd(0.01)
+    kw = dict(batch=4, move_limit=20, n_sim=8, max_nodes=16,
+              sim_chunk=4, replay_chunk=8)
+    base = make_zero_iteration(
+        cfg, FEATS, VFEATS, pol.module.apply, val.module.apply,
+        tx_p, tx_v, **kw)
+    mesh = meshlib.make_mesh(4)
+    sharded = make_zero_iteration(
+        cfg, FEATS, VFEATS, pol.module.apply, val.module.apply,
+        tx_p, tx_v, mesh=mesh, **kw)
+    s0 = init_zero_state(pol.params, val.params, tx_p, tx_v, seed=7)
+    s0m = meshlib.replicate(mesh, init_zero_state(
+        pol.params, val.params, tx_p, tx_v, seed=7))
+    _, m1 = base(s0)
+    _, m2 = sharded(s0m)
+    for k in m1:
+        np.testing.assert_allclose(
+            float(jax.device_get(m1[k])), float(jax.device_get(m2[k])),
+            rtol=1e-5, err_msg=k)
