@@ -231,6 +231,7 @@ def test_gumbel_visits_follow_schedule():
     np.testing.assert_array_equal(best, np.asarray(cand)[:, 0])
 
 
+@pytest.mark.slow
 def test_gumbel_chunked_equals_monolithic():
     from rocalphago_tpu.search.device_mcts import make_gumbel_mcts
 
@@ -248,6 +249,7 @@ def test_gumbel_chunked_equals_monolithic():
     np.testing.assert_allclose(p1, p2, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_gumbel_finds_capture():
     """Same oracle as the PUCT capture test: with all actions as
     candidates, sequential halving must keep and pick the capture (the
@@ -327,6 +329,7 @@ def test_gumbel_selfplay_records_improved_policy():
     assert ((acts >= 0) & (acts <= N)).all()
 
 
+@pytest.mark.slow
 def test_dirichlet_root_noise_perturbs_search():
     """PUCT self-play with root noise: different rng seeds must yield
     different visit patterns (the noiseless searcher is fully

@@ -97,6 +97,7 @@ def test_zero_cli_trains_saves_and_resumes(tmp_path, nets):
                for e in lines)
 
 
+@pytest.mark.slow
 def test_zero_iteration_gumbel_targets(nets):
     """The Gumbel variant: self-play plays halving winners and the
     policy learns from pi' (improved policy) float targets - one
@@ -121,6 +122,7 @@ def test_zero_iteration_gumbel_targets(nets):
     assert not np.allclose(np.asarray(vflat0), np.asarray(vflat1))
 
 
+@pytest.mark.slow
 def test_zero_iteration_sharded_matches_unsharded(nets):
     """Mesh wiring is placement + constraints only: one iteration on
     the virtual 8-device mesh must match the unsharded run
