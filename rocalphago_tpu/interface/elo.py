@@ -21,7 +21,11 @@ Conventions:
 
 CLI:
     python -m rocalphago_tpu.interface.elo games1.jsonl games2.jsonl \
-        [--anchor NAME] [--anchor-elo E]
+        [--anchor NAME] [--anchor-elo E] [--bootstrap N]
+
+``--bootstrap N`` adds percentile-bootstrap 95% rating intervals from
+N game resamples — small-sample Elo is noisy, and the tool says so
+with numbers.
 """
 
 from __future__ import annotations
@@ -182,6 +186,54 @@ def elo_table(games, anchor: str | None = None,
     return {"players": out, "anchor": anchor}
 
 
+def bootstrap_ci(games, anchor=None, anchor_elo: float = 0.0,
+                 n_boot: int = 200, seed: int = 0,
+                 pct: tuple = (2.5, 97.5)) -> dict:
+    """Percentile bootstrap over games: ``{player: [lo, hi] | None}``.
+
+    Resamples the game list with replacement ``n_boot`` times and
+    refits; a player whose rating is null (disconnected from the
+    anchor) in any resample — or who drops out of a resample entirely
+    — contributes no sample there, and gets null bounds if fewer than
+    half the resamples rate them. Small-sample Elo is NOISY; the
+    point of this is to say so with numbers."""
+    import random
+
+    rng = random.Random(seed)
+    # resolve the anchor ONCE from the full game set: with
+    # anchor=None each resample would otherwise pick its own
+    # alphabetically-first player, mixing rating scales across
+    # resamples and corrupting the intervals
+    _, players = pair_counts(games)
+    if anchor is None and players:
+        anchor = sorted(players)[0]
+    samples: dict = {}
+    for _ in range(n_boot):
+        resample = rng.choices(games, k=len(games))
+        try:
+            t = elo_table(resample, anchor, anchor_elo)
+        except ValueError:      # anchor absent from this resample
+            continue
+        for name, row in t["players"].items():
+            if row["elo"] is not None:
+                samples.setdefault(name, []).append(row["elo"])
+
+    def pick(vals, q):
+        vals = sorted(vals)
+        i = q / 100.0 * (len(vals) - 1)
+        lo, hi = int(math.floor(i)), int(math.ceil(i))
+        return vals[lo] + (vals[hi] - vals[lo]) * (i - lo)
+
+    out = {}
+    for name, vals in samples.items():
+        if len(vals) < n_boot / 2:
+            out[name] = None
+        else:
+            out[name] = [round(pick(vals, pct[0]), 1),
+                         round(pick(vals, pct[1]), 1)]
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Elo ratings from tournament JSONL logs")
@@ -190,10 +242,18 @@ def main(argv=None) -> int:
                     help="player pinned to --anchor-elo "
                          "(default: alphabetically first)")
     ap.add_argument("--anchor-elo", type=float, default=0.0)
+    ap.add_argument("--bootstrap", type=int, default=0, metavar="N",
+                    help="add [2.5%%, 97.5%%] percentile-bootstrap "
+                         "rating intervals from N game resamples")
     a = ap.parse_args(argv)
     games = read_games(a.logs)
     try:
         table = elo_table(games, a.anchor, a.anchor_elo)
+        if a.bootstrap and games:
+            ci = bootstrap_ci(games, a.anchor, a.anchor_elo,
+                              n_boot=a.bootstrap)
+            for name, row in table["players"].items():
+                row["elo_ci95"] = ci.get(name)
     except ValueError as e:
         raise SystemExit(str(e))
     print(json.dumps(table, indent=2))

@@ -78,3 +78,39 @@ def test_non_object_json_lines_skipped(tmp_path):
                    + json.dumps(g("A", "B", "A")) + "\n")
     games = elo.read_games([str(log)])
     assert len(games) == 1
+
+
+def test_bootstrap_ci_brackets_the_point_estimate():
+    games = [g("A", "B", "A")] * 12 + [g("B", "A", "B")] * 4
+    t = elo.elo_table(games, anchor="B", anchor_elo=0.0)
+    ci = elo.bootstrap_ci(games, anchor="B", n_boot=100, seed=1)
+    lo, hi = ci["A"]
+    assert lo <= t["players"]["A"]["elo"] <= hi
+    assert lo < hi                       # 16 games: a real interval
+    assert ci["B"] == [0.0, 0.0]         # the anchor is pinned
+
+
+def test_bootstrap_cli_flag(tmp_path, capsys):
+    log = tmp_path / "t.jsonl"
+    log.write_text("\n".join(
+        [json.dumps(g("x", "y", "x"))] * 5
+        + [json.dumps(g("y", "x", "y"))] * 2) + "\n")
+    rc = elo.main([str(log), "--anchor", "y", "--bootstrap", "50"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["players"]["x"]["elo_ci95"] is not None
+    assert len(out["players"]["x"]["elo_ci95"]) == 2
+
+
+def test_bootstrap_default_anchor_is_stable_across_resamples():
+    """Reviewer repro: with no explicit anchor, a resample that drops
+    the alphabetically-first player must NOT re-anchor to someone
+    else — B's interval may not include the anchor value 0."""
+    games = [g("A", "B", "B")] + [g("B", "C", "B")] * 9
+    t = elo.elo_table(games)                 # anchor A = 0
+    ci = elo.bootstrap_ci(games, n_boot=120, seed=3)
+    b_elo = t["players"]["B"]["elo"]
+    assert b_elo > 0
+    if ci.get("B") is not None:
+        lo, hi = ci["B"]
+        assert lo > 0, (lo, hi, b_elo)
