@@ -12,6 +12,7 @@ _EXPORTS = {
     "DeviceMCTSPlayer": "rocalphago_tpu.search.device_mcts",
     "DeviceTree": "rocalphago_tpu.search.device_mcts",
     "make_device_mcts": "rocalphago_tpu.search.device_mcts",
+    "make_gumbel_mcts": "rocalphago_tpu.search.device_mcts",
     "make_mcts_selfplay": "rocalphago_tpu.search.device_mcts",
     "MCTS": "rocalphago_tpu.search.mcts",
     "MCTSPlayer": "rocalphago_tpu.search.mcts",
