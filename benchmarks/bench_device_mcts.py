@@ -40,7 +40,7 @@ def main() -> None:
     plan_sims = args.sims
     if args.gumbel:
         from rocalphago_tpu.search.device_mcts import (
-            _halving_schedule,
+            gumbel_plan_sims,
             make_gumbel_mcts,
         )
 
@@ -48,8 +48,8 @@ def main() -> None:
         # the halving plan can exceed the requested sims at small
         # budgets — size the slab (and report) from the real count,
         # or the bench would measure a capacity-saturated search
-        plan_sims = sum(k * v for k, v in _halving_schedule(
-            args.sims, min(16, args.board ** 2 + 1)))
+        plan_sims = gumbel_plan_sims(args.sims, 16,
+                                     args.board ** 2 + 1)
     max_nodes = args.max_nodes or 2 * plan_sims
 
     policy = CNNPolicy(board=args.board, layers=12,

@@ -41,7 +41,11 @@ def read_games(paths) -> list[dict]:
     """Parse tournament JSONL logs; skips malformed lines."""
     games = []
     for path in paths:
-        with open(path) as f:
+        try:
+            f = open(path)
+        except OSError as e:
+            raise SystemExit(f"cannot read game log {path}: {e}")
+        with f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -195,7 +199,9 @@ def bootstrap_ci(games, anchor=None, anchor_elo: float = 0.0,
     refits; a player whose rating is null (disconnected from the
     anchor) in any resample — or who drops out of a resample entirely
     — contributes no sample there, and gets null bounds if fewer than
-    half the resamples rate them. Small-sample Elo is NOISY; the
+    half the COMPLETED resamples (those whose table fit — resamples
+    that drop the anchor entirely are skipped and don't count) rate
+    them. Small-sample Elo is NOISY; the
     point of this is to say so with numbers."""
     import random
 
@@ -208,12 +214,16 @@ def bootstrap_ci(games, anchor=None, anchor_elo: float = 0.0,
     if anchor is None and players:
         anchor = sorted(players)[0]
     samples: dict = {}
-    for _ in range(n_boot):
+    completed = 0   # resamples whose table fit — the null-CI
+    for _ in range(n_boot):     # threshold denominator (advisor r3:
+        # skipped resamples must not count against always-rated
+        # players on sparse logs)
         resample = rng.choices(games, k=len(games))
         try:
             t = elo_table(resample, anchor, anchor_elo)
         except ValueError:      # anchor absent from this resample
             continue
+        completed += 1
         for name, row in t["players"].items():
             if row["elo"] is not None:
                 samples.setdefault(name, []).append(row["elo"])
@@ -226,7 +236,7 @@ def bootstrap_ci(games, anchor=None, anchor_elo: float = 0.0,
 
     out = {}
     for name, vals in samples.items():
-        if len(vals) < n_boot / 2:
+        if len(vals) < completed / 2:
             out[name] = None
         else:
             out[name] = [round(pick(vals, pct[0]), 1),

@@ -150,7 +150,7 @@ def main(argv=None):
             cfg, net.feature_list, value.feature_list,
             net.module.apply, value.module.apply, batch=a.games,
             max_moves=a.max_moves, n_sim=a.search_sims,
-            max_nodes=2 * a.search_sims, temperature=a.temperature,
+            temperature=a.temperature,
             sim_chunk=a.chunk or 8, gumbel=a.gumbel,
             m_root=a.m_root, dirichlet_alpha=a.dirichlet_alpha,
             noise_frac=a.noise_frac)

@@ -98,7 +98,7 @@ def _terminal_value(cfg: GoConfig, st: GoState) -> jax.Array:
 def make_device_mcts(cfg: GoConfig, policy_features: tuple,
                      value_features: tuple,
                      policy_apply: Callable, value_apply: Callable,
-                     n_sim: int, max_nodes: int,
+                     n_sim: int, max_nodes: int | None = None,
                      c_puct: float = 5.0):
     """Build the jitted searcher.
 
@@ -108,8 +108,11 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     the mean backed-up value per root action from the root player's
     perspective (0 where unvisited). ``value_features`` must be
     ``policy_features + ("color",)`` (the canonical nested 48/49
-    layout) so one encode serves both nets.
+    layout) so one encode serves both nets. ``max_nodes=None`` sizes
+    the slab to ``2 * n_sim`` (root + every expanded leaf fit).
     """
+    if max_nodes is None:
+        max_nodes = 2 * n_sim
     if tuple(value_features[:-1]) != tuple(policy_features) or \
             value_features[-1] != "color":
         raise ValueError(
@@ -404,6 +407,7 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     search.run_chunked = run_chunked
     search.simulate = simulate          # forced-root hook (Gumbel)
     search.advance_root = advance_root  # subtree reuse across moves
+    search.max_nodes = max_nodes        # the slab size actually built
     return search
 
 
@@ -431,10 +435,22 @@ def _halving_schedule(n_sim: int, m: int) -> list[tuple[int, int]]:
     return sched
 
 
+def gumbel_plan_sims(n_sim: int, m_root: int, num_actions: int) -> int:
+    """Real simulation count of a Gumbel search's halving plan.
+
+    Every halving phase must visit each surviving candidate at least
+    once, so for small ``n_sim`` the plan total exceeds the nominal
+    budget (e.g. n_sim=8, m_root=16 → 30). Slabs sized from nominal
+    ``n_sim`` silently saturate; size them from THIS instead."""
+    m = max(2, min(m_root, num_actions))
+    return sum(k * v for k, v in _halving_schedule(n_sim, m))
+
+
 def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                      value_features: tuple,
                      policy_apply: Callable, value_apply: Callable,
-                     n_sim: int, max_nodes: int, m_root: int = 16,
+                     n_sim: int, max_nodes: int | None = None,
+                     m_root: int = 16,
                      c_visit: float = 50.0, c_scale: float = 0.1,
                      c_puct: float = 5.0):
     """Gumbel root search over the device tree (Danihelka et al. 2022,
@@ -470,12 +486,16 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     simulation count can exceed ``n_sim`` — every phase must visit
     each survivor once to have a score to halve on.
     """
-    base = make_device_mcts(cfg, policy_features, value_features,
-                            policy_apply, value_apply, n_sim=n_sim,
-                            max_nodes=max_nodes, c_puct=c_puct)
     num_actions = cfg.num_points + 1
     m = max(2, min(m_root, num_actions))
     schedule = _halving_schedule(n_sim, m)
+    if max_nodes is None:
+        # the halving plan's REAL simulation count, not nominal n_sim
+        # — a 2*n_sim slab silently saturates small-budget searches
+        max_nodes = 2 * gumbel_plan_sims(n_sim, m_root, num_actions)
+    base = make_device_mcts(cfg, policy_features, value_features,
+                            policy_apply, value_apply, n_sim=n_sim,
+                            max_nodes=max_nodes, c_puct=c_puct)
     neg = jnp.float32(jnp.finfo(jnp.float32).min)
 
     def init(params_p, params_v, roots: GoState, rng):
@@ -604,6 +624,7 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     search.run_chunked = run_chunked
     search.schedule = schedule
     search.m_root = m
+    search.max_nodes = max_nodes        # the slab size actually built
     return search
 
 
@@ -639,7 +660,11 @@ class DeviceMCTSPlayer:
         self._cfg = policy_net.cfg
         self._chunk = sim_chunk
         self._n_sim = n_sim
-        self._max_nodes = max_nodes or 2 * n_sim
+        # None → the factory's own default (2*n_sim for PUCT, 2× the
+        # halving plan's real sim count for gumbel — advisor r3);
+        # read back from the built searcher below so the reuse
+        # check's capacity bound always matches the real slab
+        self._max_nodes = max_nodes
         self._c_puct = c_puct
         self._gumbel = gumbel
         self._m_root = m_root
@@ -660,7 +685,8 @@ class DeviceMCTSPlayer:
         # build the default-komi searcher NOW: feature-layout
         # validation must fail at construction (like build_player's
         # missing-value guard), not on the first genmove
-        self._searcher_for(self._cfg.komi)
+        self._max_nodes = self._searcher_for(
+            self._cfg.komi)[1].max_nodes
 
     def reset(self) -> None:
         """Forget cross-move search state (new game)."""
@@ -764,7 +790,8 @@ class DeviceMCTSPlayer:
 def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        value_features: tuple, policy_apply: Callable,
                        value_apply: Callable, batch: int,
-                       max_moves: int, n_sim: int, max_nodes: int,
+                       max_moves: int, n_sim: int,
+                       max_nodes: int | None = None,
                        c_puct: float = 5.0, temperature: float = 1.0,
                        sim_chunk: int = 8,
                        record_visits: bool = False,

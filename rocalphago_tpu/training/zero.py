@@ -64,7 +64,8 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                         value_features: tuple, policy_apply: Callable,
                         value_apply: Callable, tx_policy, tx_value,
                         batch: int, move_limit: int, n_sim: int,
-                        max_nodes: int, temperature: float = 1.0,
+                        max_nodes: int | None = None,
+                        temperature: float = 1.0,
                         sim_chunk: int = 8, replay_chunk: int = 10,
                         gumbel: bool = False, m_root: int = 16,
                         dirichlet_alpha: float = 0.0,
@@ -322,7 +323,7 @@ def run_training(argv=None) -> dict:
         policy.cfg, policy.feature_list, value.feature_list,
         policy.module.apply, value.module.apply, tx_p, tx_v,
         batch=a.game_batch, move_limit=a.move_limit, n_sim=a.sims,
-        max_nodes=a.max_nodes or 2 * a.sims,
+        max_nodes=a.max_nodes,
         temperature=a.temperature, sim_chunk=a.sim_chunk,
         replay_chunk=a.replay_chunk, gumbel=a.gumbel,
         m_root=a.m_root, dirichlet_alpha=a.dirichlet_alpha,

@@ -82,12 +82,16 @@ def main(argv=None) -> int:
 
     results = []
     for n_sim in a.sims:
-        mk = dict(n_sim=n_sim, max_nodes=2 * n_sim + 2)
+        # each engine sizes its own slab: gumbel's halving plan runs
+        # more sims than nominal n_sim at small budgets, so a shared
+        # 2*n_sim slab would truncate exactly the searches this
+        # script exists to compare
         puct = make_device_mcts(cfg, feats, vfeats, fake_policy,
-                                fake_value, **mk)
+                                fake_value, n_sim=n_sim,
+                                max_nodes=2 * n_sim + 2)
         gmb = make_gumbel_mcts(cfg, feats, vfeats, fake_policy,
-                               fake_value, m_root=min(16, n + 1),
-                               **mk)
+                               fake_value, n_sim=n_sim,
+                               m_root=min(16, n + 1))
         rng = jax.random.key(a.seed + n_sim)
         tally = [0, 0, 0]          # gumbel, puct, draw
         t0 = time.time()

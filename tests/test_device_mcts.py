@@ -206,6 +206,29 @@ def test_halving_schedule_shapes():
     assert sum(k * v for k, v in sched) <= 100
 
 
+def test_gumbel_player_slab_fits_halving_plan():
+    """Advisor r3 repro: a small-budget gumbel player must size its
+    slab from the halving plan's REAL simulation count (30 for
+    n_sim=8/m_root=16), not nominal n_sim — 2*8=16 nodes would
+    silently saturate mid-search."""
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import (
+        DeviceMCTSPlayer,
+        gumbel_plan_sims,
+    )
+
+    pol = CNNPolicy(FEATS, board=SIZE, layers=1, filters_per_layer=4)
+    val = CNNValue(VFEATS, board=SIZE, layers=1, filters_per_layer=4)
+    plan = gumbel_plan_sims(8, 16, SIZE * SIZE + 1)
+    assert plan > 8
+    player = DeviceMCTSPlayer(val, pol, n_sim=8, gumbel=True,
+                              m_root=16, sim_chunk=4)
+    assert player._max_nodes == 2 * plan
+    # PUCT sizing is unchanged
+    puct = DeviceMCTSPlayer(val, pol, n_sim=8, sim_chunk=4)
+    assert puct._max_nodes == 16
+
+
 def test_gumbel_visits_follow_schedule():
     """Constant value net => candidate ranking is fixed by the gumbel
     draw alone, so the visit pattern must equal the halving schedule:

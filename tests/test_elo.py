@@ -114,3 +114,25 @@ def test_bootstrap_default_anchor_is_stable_across_resamples():
     if ci.get("B") is not None:
         lo, hi = ci["B"]
         assert lo > 0, (lo, hi, b_elo)
+
+
+def test_missing_log_is_clean_systemexit(tmp_path):
+    """A typo'd path must exit cleanly, not raise a raw OSError."""
+    import pytest
+
+    with pytest.raises(SystemExit, match="no_such_file"):
+        elo.read_games([str(tmp_path / "no_such_file.jsonl")])
+
+
+def test_bootstrap_sparse_anchor_still_rates_others():
+    """Advisor repro: when the anchor has so few games that most
+    resamples drop it, the null-CI threshold must be measured
+    against COMPLETED resamples, not n_boot — always-rated players
+    keep their intervals."""
+    # anchor Z appears in 1 of 40 games: ~63% of resamples omit Z
+    # entirely and are skipped; A and B appear in every resample.
+    games = [g("A", "B", "A")] * 22 + [g("A", "B", "B")] * 17 \
+        + [g("Z", "A", "A")]
+    ci = elo.bootstrap_ci(games, anchor="Z", n_boot=80, seed=7)
+    assert ci["A"] is not None
+    assert ci["B"] is not None
