@@ -236,7 +236,10 @@ def bootstrap_ci(games, anchor=None, anchor_elo: float = 0.0,
 
     out = {}
     for name, vals in samples.items():
-        if len(vals) < completed / 2:
+        # completed < 10: too few surviving resamples for ANY honest
+        # interval — a "95% CI" from 1-2 points would carry the same
+        # authority as a real one
+        if completed < 10 or len(vals) < completed / 2:
             out[name] = None
         else:
             out[name] = [round(pick(vals, pct[0]), 1),

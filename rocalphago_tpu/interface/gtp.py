@@ -121,6 +121,8 @@ class GTPEngine:
         self.komi = 7.5
         self.state = pygo.GameState(size=self.size, komi=self.komi)
         self._undo_stack: list = []
+        self._time_settings = None    # (main_s, byo_s, byo_stones)
+        self._time_left: dict = {}    # color -> (seconds, stones)
         self._commands = sorted(
             m[4:] for m in dir(self) if m.startswith("cmd_"))
 
@@ -151,6 +153,7 @@ class GTPEngine:
 
         self.state = pygo.GameState(size=self.size, komi=self.komi)
         self._undo_stack.clear()
+        self._time_left = {}      # fresh game, fresh clocks
         reset_player(self.player)
 
     def _player_board(self):
@@ -237,6 +240,11 @@ class GTPEngine:
         prev = self.state.current_player
         self.state.current_player = color
         try:
+            # inside the try: a raising time hook must restore the
+            # side to move like any other genmove failure
+            set_time = getattr(self.player, "set_move_time", None)
+            if set_time is not None:
+                set_time(self._move_budget_s(color))
             move = self.player.get_move(self.state)
             if move is not None and not self.state.is_legal(move):
                 move = None
@@ -278,12 +286,55 @@ class GTPEngine:
         return "0"
 
     # ------------------------------------------------------------- time
+    #
+    # The reference wrapper delegates clock handling to its GTP shim
+    # (SURVEY.md §1 L6); here the engine owns the clock arithmetic
+    # and the player owns the sims-per-second conversion: genmove
+    # hands the moving color's per-move second budget to the player's
+    # ``set_move_time`` hook (when it has one — DeviceMCTSPlayer
+    # shrinks its simulation count proportionally).
 
     def cmd_time_settings(self, args):
+        # GTP-2: main_time byo_yomi_time byo_yomi_stones (canadian)
+        main, byo_t, byo_s = (float(args[0]), float(args[1]),
+                              int(args[2]))
+        if main < 0 or byo_t < 0 or byo_s < 0:
+            raise ValueError("time arguments must be non-negative")
+        self._time_settings = (main, byo_t, byo_s)
+        self._time_left = {}
         return ""
 
     def cmd_time_left(self, args):
+        color = parse_color(args[0])
+        self._time_left[color] = (float(args[1]), int(args[2]))
         return ""
+
+    def _est_moves_left(self) -> float:
+        """Per-player moves still to come: a game runs ~0.75·N² plies
+        total, floored so late-game budgets never spike."""
+        total = 0.75 * self.size * self.size
+        return max(10.0, (total - self.state.turns_played) / 2.0)
+
+    def _move_budget_s(self, color):
+        """Seconds this genmove may spend, or None (no time control).
+
+        Proportional rule: in byo-yomi (``time_left`` with stones>0),
+        the remaining period time splits evenly over the remaining
+        period stones; in main time, the remaining clock splits over
+        the estimated moves left."""
+        left = self._time_left.get(color)
+        if left is not None:
+            t, stones = left
+            if stones > 0:                     # canadian byo-yomi
+                return max(t, 0.0) / stones
+            return max(t, 0.0) / self._est_moves_left()
+        if self._time_settings is not None:
+            main, byo_t, byo_s = self._time_settings
+            if main > 0:
+                return main / self._est_moves_left()
+            if byo_s > 0:
+                return byo_t / byo_s
+        return None
 
     # --------------------------------------------------------- dispatch
 

@@ -41,6 +41,7 @@ params shards the whole search, bit-identically
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, NamedTuple
 
 import jax
@@ -373,13 +374,16 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         return _root_stats(tree)
 
     def run_sims_chunked(params_p, params_v, tree: DeviceTree,
-                         chunk: int) -> DeviceTree:
-        """The one owner of the watchdog chunk schedule: ``n_sim``
+                         chunk: int, n: int | None = None
+                         ) -> DeviceTree:
+        """The one owner of the watchdog chunk schedule: ``n``
+        (default ``n_sim``; a game clock may ask for fewer)
         simulations as ``chunk``-sized compiled programs, tree
         device-resident in between."""
-        for done in range(0, n_sim, chunk):
+        n = n_sim if n is None else n
+        for done in range(0, n, chunk):
             tree = run_sims(params_p, params_v, tree,
-                            k=min(chunk, n_sim - done))
+                            k=min(chunk, n - done))
         return tree
 
     def run_chunked(params_p, params_v, roots: GoState, chunk: int,
@@ -647,6 +651,15 @@ class DeviceMCTSPlayer:
     (handicap stones placed outside the history); ``reuse=False``
     disables, ``.reuses`` counts engagements. Gumbel mode always
     rebuilds (its root draw is per-move by design).
+
+    TIME CONTROL: ``set_move_time(seconds)`` (wired from GTP
+    ``time_settings``/``time_left`` by the engine) caps the next
+    searches' simulation count at ``seconds × measured sims/sec``
+    (EMA over past searches; the first timed move runs the full
+    budget and seeds the estimate). PUCT shrinks to any chunk
+    multiple — only already-compiled chunk programs run; gumbel
+    quantizes to halvings of ``n_sim`` so at most log₂ tiers ever
+    compile. ``last_n_sim`` reports what the last search really ran.
     """
 
     def __init__(self, value_net, policy_net, n_sim: int = 100,
@@ -677,6 +690,10 @@ class DeviceMCTSPlayer:
         self._reuse = reuse and not gumbel
         self._carry = None
         self.reuses = 0     # observability: # of reused searches
+        # GTP time control (see class docstring)
+        self._move_time = None      # seconds/move; None = no clock
+        self._sims_per_sec = None   # EMA of measured search speed
+        self.last_n_sim = None      # sims the last get_move ran
         # searchers are cached PER KOMI: the search's terminal-node
         # evaluations score with its GoConfig's komi, and GTP can set
         # any komi per game — same handling as the host MCTSPlayer's
@@ -692,20 +709,67 @@ class DeviceMCTSPlayer:
         """Forget cross-move search state (new game)."""
         self._carry = None
 
-    def _searcher_for(self, komi: float):
-        if komi not in self._searchers:
+    def set_move_time(self, seconds) -> None:
+        """Per-move wall budget in seconds (None = no clock). The GTP
+        engine calls this before every genmove from the game clock."""
+        self._move_time = (None if seconds is None
+                           else max(float(seconds), 0.0))
+
+    def _note_rate(self, sims: int, wall: float) -> None:
+        if wall <= 0:
+            return
+        r = sims / wall
+        self._sims_per_sec = (r if self._sims_per_sec is None
+                              else 0.5 * self._sims_per_sec + 0.5 * r)
+
+    def _effective_sims(self) -> int:
+        """Simulation budget for the next search under the clock.
+
+        ``move_time × measured sims/sec``, floored at one chunk and
+        capped at nominal ``n_sim``. No clock, or no measurement yet
+        (the very first search — which pays the compiles anyway and
+        seeds the estimate): full budget."""
+        if self._move_time is None or self._sims_per_sec is None:
+            return self._n_sim
+        allowed = int(self._move_time * self._sims_per_sec)
+        if self._gumbel:
+            # halving tiers only: each distinct n_sim compiles its
+            # own phase programs, so at most log2(n_sim) tiers exist.
+            # The plan has a floor (every phase visits each survivor
+            # once) — stop when halving no longer shrinks it, or a
+            # starved clock would burn compiles on identical plans
+            tier = self._n_sim
+            num_actions = self._cfg.num_points + 1
+            plan = gumbel_plan_sims(tier, self._m_root, num_actions)
+            while tier > 2 and plan > allowed:
+                nxt = max(2, tier // 2)
+                nxt_plan = gumbel_plan_sims(nxt, self._m_root,
+                                            num_actions)
+                if nxt_plan >= plan:
+                    break               # plan floor reached
+                tier, plan = nxt, nxt_plan
+            return tier
+        # PUCT shrinks to any chunk multiple: only the already-
+        # compiled chunk-sized program runs, never a new compile
+        return min(self._n_sim,
+                   max(self._chunk,
+                       allowed // self._chunk * self._chunk))
+
+    def _searcher_for(self, komi: float, n_sim: int | None = None):
+        key = (komi, n_sim or self._n_sim)
+        if key not in self._searchers:
             import dataclasses
 
             cfg = dataclasses.replace(self._cfg, komi=komi)
             make = (functools.partial(make_gumbel_mcts,
                                       m_root=self._m_root)
                     if self._gumbel else make_device_mcts)
-            self._searchers[komi] = (cfg, make(
+            self._searchers[key] = (cfg, make(
                 cfg, self.policy.feature_list, self.value.feature_list,
                 self.policy.module.apply, self.value.module.apply,
-                n_sim=self._n_sim, max_nodes=self._max_nodes,
+                n_sim=key[1], max_nodes=self._max_nodes,
                 c_puct=self._c_puct))
-        return self._searchers[komi]
+        return self._searchers[key]
 
     def _reused_tree(self, search, state, komi, bridged):
         """Walk the carried tree's root down the moves actually played
@@ -755,9 +819,12 @@ class DeviceMCTSPlayer:
         from rocalphago_tpu.utils.coords import unflatten_idx
 
         komi = float(state.komi)
-        cfg, search = self._searcher_for(komi)
+        eff = self._effective_sims()
+        cfg, search = self._searcher_for(
+            komi, eff if self._gumbel else None)
         root = _jaxgo.from_pygo(cfg, state)
         roots = jax.tree.map(lambda x: x[None], root)
+        t0 = time.monotonic()
         if self._gumbel:
             self._rng, sub = jax.random.split(self._rng)
             visits, _, best, _ = search.run_chunked(
@@ -765,6 +832,8 @@ class DeviceMCTSPlayer:
                 self._chunk)
             action = int(jax.device_get(best)[0])
             counts = np.asarray(jax.device_get(visits))[0]
+            # a halving plan really runs its schedule total, not eff
+            ran = sum(k * v for k, v in search.schedule)
         else:
             tree = (self._reused_tree(search, state, komi, root)
                     if self._reuse else None)
@@ -773,15 +842,21 @@ class DeviceMCTSPlayer:
             else:
                 tree = search.init(self.policy.params,
                                    self.value.params, roots)
+            # the clock owns the sim count: eff ≤ n_sim simulations
+            # in chunk-sized compiled programs (same programs the
+            # full budget runs — shrinking never recompiles)
             tree = search.run_sims_chunked(
                 self.policy.params, self.value.params, tree,
-                self._chunk)
+                self._chunk, n=eff)
             visits, _ = search.root_stats(tree)
             counts = np.asarray(jax.device_get(visits))[0]
             action = int(counts.argmax())
+            ran = eff
             if self._reuse:
                 self._carry = (komi, state.size, state.turns_played,
                                tree)
+        self._note_rate(ran, time.monotonic() - t0)
+        self.last_n_sim = ran
         if action >= cfg.num_points or counts[action] == 0:
             return None                              # pass
         return unflatten_idx(action, cfg.size)

@@ -221,3 +221,113 @@ def test_gtp_with_real_policy_player():
     text = out.getvalue()
     assert text.count("=") >= 5
     assert "?" not in text.split("showboard")[0]
+
+
+class ClockedPlayer(ScriptedPlayer):
+    """Records the per-move second budget the engine hands over."""
+
+    def __init__(self):
+        super().__init__()
+        self.budgets = []
+
+    def set_move_time(self, seconds):
+        self.budgets.append(seconds)
+
+
+def test_time_budget_proportional_rule():
+    """time_settings/time_left → per-move seconds via the documented
+    proportional rule, handed to the player before every genmove."""
+    eng = GTPEngine(ClockedPlayer())
+    ok(eng, "boardsize 9")
+    ok(eng, "clear_board")
+    # no clock yet: genmove passes None (no time control)
+    ok(eng, "genmove b")
+    assert eng.player.budgets == [None]
+    # main time only: 300s over ~0.75*81/2 ≈ 30 moves (floor 10)
+    ok(eng, "time_settings 300 0 0")
+    ok(eng, "genmove w")
+    est = max(10.0, (0.75 * 81 - eng.state.turns_played + 1) / 2.0)
+    assert eng.player.budgets[-1] == pytest.approx(300.0 / est,
+                                                  rel=1e-6)
+    # canadian byo-yomi report: 30s for 5 stones → 6s/move
+    ok(eng, "time_left b 30 5")
+    ok(eng, "genmove b")
+    assert eng.player.budgets[-1] == pytest.approx(6.0)
+    # main-time report (stones == 0): remaining / est moves left
+    ok(eng, "time_left w 100 0")
+    ok(eng, "genmove w")
+    est = max(10.0, (0.75 * 81 - eng.state.turns_played + 1) / 2.0)
+    assert eng.player.budgets[-1] == pytest.approx(100.0 / est,
+                                                  rel=1e-6)
+    # clear_board wipes per-color clocks but keeps the settings
+    ok(eng, "clear_board")
+    ok(eng, "genmove b")
+    assert eng.player.budgets[-1] == pytest.approx(300.0 / 30.375)
+
+
+def test_low_time_shrinks_device_search(monkeypatch):
+    """VERDICT r3 #10: under a short clock the device player must run
+    fewer simulations — chunk-multiple shrink, no recompile."""
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol = CNNPolicy(("board", "ones"), board=5, layers=1,
+                    filters_per_layer=4)
+    val = CNNValue(("board", "ones", "color"), board=5, layers=1,
+                   filters_per_layer=4)
+    player = DeviceMCTSPlayer(val, pol, n_sim=32, sim_chunk=8,
+                              reuse=False)
+    eng = GTPEngine(player)
+    ok(eng, "boardsize 5")
+    ok(eng, "clear_board")
+    # first move: no rate estimate yet → full budget, seeds the EMA
+    ok(eng, "genmove b")
+    assert player.last_n_sim == 32
+    assert player._sims_per_sec is not None
+    # pin the measured rate so the assertion is deterministic:
+    # 16 sims/s × 1 s budget → 16 sims (a chunk multiple ≤ n_sim)
+    player._sims_per_sec = 16.0
+    monkeypatch.setattr(player, "_note_rate", lambda *a: None)
+    ok(eng, "time_left w 1 1")
+    ok(eng, "genmove w")
+    assert player.last_n_sim == 16
+    # a generous clock restores the full budget
+    ok(eng, "time_left b 10000 1")
+    ok(eng, "genmove b")
+    assert player.last_n_sim == 32
+
+
+def test_gumbel_time_tiers():
+    """Gumbel shrinks by halving n_sim tiers (bounded recompiles);
+    the reported budget is each tier's real halving-plan total."""
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import (
+        DeviceMCTSPlayer,
+        gumbel_plan_sims,
+    )
+
+    pol = CNNPolicy(("board", "ones"), board=5, layers=1,
+                    filters_per_layer=4)
+    val = CNNValue(("board", "ones", "color"), board=5, layers=1,
+                   filters_per_layer=4)
+    player = DeviceMCTSPlayer(val, pol, n_sim=64, gumbel=True,
+                              m_root=4, sim_chunk=8)
+    assert gumbel_plan_sims(64, 4, 26) == 64
+    player._sims_per_sec = 32.0
+    player.set_move_time(1.0)          # allows 32 < plan(64)=64
+    assert player._effective_sims() == 32
+    player.set_move_time(100.0)        # generous → full tier
+    assert player._effective_sims() == 64
+    # starved → stop at the plan floor: plan(4)=plan(2)=6, so
+    # halving below 4 would only compile an identical plan
+    player.set_move_time(0.01)
+    assert player._effective_sims() == 4
+    # non-power-of-two budgets never tier below the plan floor
+    p2 = DeviceMCTSPlayer(val, pol, n_sim=100, gumbel=True,
+                          m_root=16, sim_chunk=8)
+    p2._sims_per_sec = 1.0
+    p2.set_move_time(0.01)
+    floor_tier = p2._effective_sims()
+    assert floor_tier >= 2
+    assert gumbel_plan_sims(floor_tier, 16, 26) == gumbel_plan_sims(
+        max(2, floor_tier // 2), 16, 26)
