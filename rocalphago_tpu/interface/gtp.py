@@ -123,6 +123,7 @@ class GTPEngine:
         self._undo_stack: list = []
         self._time_settings = None    # (main_s, byo_s, byo_stones)
         self._time_left: dict = {}    # color -> (seconds, stones)
+        self._time_spent: dict = {}   # color -> own-genmove seconds
         self._commands = sorted(
             m[4:] for m in dir(self) if m.startswith("cmd_"))
 
@@ -154,6 +155,7 @@ class GTPEngine:
         self.state = pygo.GameState(size=self.size, komi=self.komi)
         self._undo_stack.clear()
         self._time_left = {}      # fresh game, fresh clocks
+        self._time_spent = {}
         reset_player(self.player)
 
     def _player_board(self):
@@ -239,6 +241,9 @@ class GTPEngine:
         color = parse_color(args[0])
         prev = self.state.current_player
         self.state.current_player = color
+        import time as _time
+
+        t0 = _time.monotonic()
         try:
             # inside the try: a raising time hook must restore the
             # side to move like any other genmove failure
@@ -252,6 +257,9 @@ class GTPEngine:
         except Exception:
             self.state.current_player = prev
             raise
+        finally:
+            self._time_spent[color] = (self._time_spent.get(color, 0.0)
+                                       + _time.monotonic() - t0)
         return move_to_vertex(move, self.size)
 
     def cmd_undo(self, args):
@@ -331,7 +339,11 @@ class GTPEngine:
         if self._time_settings is not None:
             main, byo_t, byo_s = self._time_settings
             if main > 0:
-                return main / self._est_moves_left()
+                # no time_left report: the engine must decrement its
+                # OWN clock — budgeting the full main time every move
+                # would plan several times the allotment over a game
+                rem = main - self._time_spent.get(color, 0.0)
+                return max(rem, 0.0) / self._est_moves_left()
             if byo_s > 0:
                 return byo_t / byo_s
         return None

@@ -693,6 +693,9 @@ class DeviceMCTSPlayer:
         # GTP time control (see class docstring)
         self._move_time = None      # seconds/move; None = no clock
         self._sims_per_sec = None   # EMA of measured search speed
+        self._warmed: set = set()   # searcher keys past their first,
+        # compile-bearing run — only warmed runs feed the rate EMA
+        # (a compile-polluted sample would collapse the budget)
         self.last_n_sim = None      # sims the last get_move ran
         # searchers are cached PER KOMI: the search's terminal-node
         # evaluations score with its GoConfig's komi, and GTP can set
@@ -820,6 +823,7 @@ class DeviceMCTSPlayer:
 
         komi = float(state.komi)
         eff = self._effective_sims()
+        skey = (komi, eff if self._gumbel else self._n_sim)
         cfg, search = self._searcher_for(
             komi, eff if self._gumbel else None)
         root = _jaxgo.from_pygo(cfg, state)
@@ -855,7 +859,10 @@ class DeviceMCTSPlayer:
             if self._reuse:
                 self._carry = (komi, state.size, state.turns_played,
                                tree)
-        self._note_rate(ran, time.monotonic() - t0)
+        if skey in self._warmed:        # first run pays the compiles
+            self._note_rate(ran, time.monotonic() - t0)
+        else:
+            self._warmed.add(skey)
         self.last_n_sim = ran
         if action >= cfg.num_points or counts[action] == 0:
             return None                              # pass

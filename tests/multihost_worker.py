@@ -1,0 +1,74 @@
+"""Worker process for the REAL 2-process ``jax.distributed`` test.
+
+Not a test module — ``tests/test_multihost.py`` spawns two of these
+(coordinator + worker) over localhost DCN loopback on the CPU
+backend, each with ONE local device, and checks that a data-parallel
+step runs globally: the batch is sharded across processes, XLA
+inserts the gradient collective, and both processes converge on the
+identical replicated result. The reference has no distributed layer
+at all (SURVEY.md §2c); this is the rebuild's multi-host bring-up
+path actually executing, not the mocked dispatch test above it.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+       <out_dir>
+
+Prints one JSON line with the step result; writes ``result.json``
+into <out_dir> ONLY on the coordinator (artifact-write discipline —
+``mesh.is_coordinator``).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, out_dir = sys.argv[3], sys.argv[4]
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rocalphago_tpu.parallel import mesh as meshlib
+
+    meshlib.distributed_init(coordinator=f"localhost:{port}",
+                             num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    mesh = meshlib.make_mesh()          # all GLOBAL devices
+
+    # deterministic global batch; each process owns its slice
+    gshape = (4 * nproc, 3)
+    global_x = np.arange(np.prod(gshape), dtype=np.float32) \
+        .reshape(gshape) / 10.0
+    local = global_x[pid * 4:(pid + 1) * 4]
+    x = jax.make_array_from_process_local_data(
+        meshlib.data_sharding(mesh, 2), local, global_shape=gshape)
+    w = meshlib.replicate(mesh, jnp.ones((3,), jnp.float32))
+
+    @jax.jit
+    def dp_step(w, x):
+        # data-parallel SGD: per-shard grads, XLA inserts the
+        # cross-process mean reduction (the NCCL-allreduce analogue)
+        loss, g = jax.value_and_grad(
+            lambda w: jnp.mean((x @ w - 1.0) ** 2))(w)
+        return w - 0.1 * g, loss
+
+    w2, loss = dp_step(w, x)
+    # replicated outputs are addressable on every process
+    result = {
+        "process": pid,
+        "coordinator": meshlib.is_coordinator(),
+        "loss": float(jax.device_get(loss)),
+        "w": np.asarray(jax.device_get(w2)).round(6).tolist(),
+        "n_global_devices": len(jax.devices()),
+    }
+    if meshlib.is_coordinator():
+        with open(os.path.join(out_dir, "result.json"), "w") as f:
+            json.dump(result, f)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

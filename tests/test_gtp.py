@@ -280,8 +280,13 @@ def test_low_time_shrinks_device_search(monkeypatch):
     eng = GTPEngine(player)
     ok(eng, "boardsize 5")
     ok(eng, "clear_board")
-    # first move: no rate estimate yet → full budget, seeds the EMA
+    # first move pays the compiles: full budget, and its wall time
+    # must NOT feed the rate EMA (it would collapse later budgets)
     ok(eng, "genmove b")
+    assert player.last_n_sim == 32
+    assert player._sims_per_sec is None
+    # second (warmed) move seeds the honest estimate
+    ok(eng, "genmove w")
     assert player.last_n_sim == 32
     assert player._sims_per_sec is not None
     # pin the measured rate so the assertion is deterministic:
@@ -331,3 +336,22 @@ def test_gumbel_time_tiers():
     assert floor_tier >= 2
     assert gumbel_plan_sims(floor_tier, 16, 26) == gumbel_plan_sims(
         max(2, floor_tier // 2), 16, 26)
+
+
+def test_main_time_self_decrements():
+    """With only time_settings (no time_left reports) the engine must
+    budget from ITS OWN remaining-time estimate — planning the full
+    main time every move would spend a multiple of the clock."""
+    eng = GTPEngine(ClockedPlayer())
+    ok(eng, "boardsize 9")
+    ok(eng, "clear_board")
+    ok(eng, "time_settings 100 0 0")
+    est = max(10.0, 0.75 * 81 / 2.0)
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(100 / est)
+    eng._time_spent[pygo.BLACK] = 90.0
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(10 / est)
+    eng._time_spent[pygo.BLACK] = 200.0       # overspent: floor at 0
+    assert eng._move_budget_s(pygo.BLACK) == 0.0
+    # genmove accounts its own wall time against the mover's clock
+    ok(eng, "genmove w")
+    assert eng._time_spent[pygo.WHITE] > 0.0

@@ -91,3 +91,54 @@ def test_non_coordinator_split_matches_coordinator(corpus, tmp_path,
     np.testing.assert_array_equal(t_coord.test_idx, t_worker.test_idx)
     t_coord.ckpt.close()
     t_worker.ckpt.close()
+
+
+def test_two_process_distributed_dp_step(tmp_path):
+    """REAL 2-process ``jax.distributed`` bring-up (VERDICT r3 #8):
+    localhost coordinator, CPU backend, one local device per process.
+    Both processes must complete one data-parallel step, agree on the
+    replicated result, and only the coordinator may write artifacts."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:          # free loopback port
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "multihost_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""    # never claim the TPU tunnel
+    env.pop("XLA_FLAGS", None)          # 1 real CPU device/process —
+    # the parent's 8-virtual-device flag must not leak into children
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [subprocess.Popen(
+        [_sys.executable, worker, str(i), "2", str(port),
+         str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, (out, err)
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    by_pid = {o["process"]: o for o in outs}
+    assert set(by_pid) == {0, 1}
+    # the DP step saw the GLOBAL device set and agreed on the result
+    assert all(o["n_global_devices"] == 2 for o in outs)
+    assert by_pid[0]["loss"] == pytest.approx(by_pid[1]["loss"])
+    assert by_pid[0]["w"] == by_pid[1]["w"]
+    # coordinator-only artifact discipline held over real processes
+    assert by_pid[0]["coordinator"] is True
+    assert by_pid[1]["coordinator"] is False
+    assert os.path.exists(tmp_path / "result.json")
+    assert os.listdir(tmp_path) == ["result.json"]
