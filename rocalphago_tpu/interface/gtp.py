@@ -310,6 +310,7 @@ class GTPEngine:
             raise ValueError("time arguments must be non-negative")
         self._time_settings = (main, byo_t, byo_s)
         self._time_left = {}
+        self._time_spent = {}     # a re-issued clock starts fresh
         return ""
 
     def cmd_time_left(self, args):

@@ -323,7 +323,7 @@ def run_training(argv=None) -> dict:
         policy.cfg, policy.feature_list, value.feature_list,
         policy.module.apply, value.module.apply, tx_p, tx_v,
         batch=a.game_batch, move_limit=a.move_limit, n_sim=a.sims,
-        max_nodes=a.max_nodes,
+        max_nodes=a.max_nodes or None,   # 0 = auto (CLI convention)
         temperature=a.temperature, sim_chunk=a.sim_chunk,
         replay_chunk=a.replay_chunk, gumbel=a.gumbel,
         m_root=a.m_root, dirichlet_alpha=a.dirichlet_alpha,
