@@ -47,15 +47,17 @@ from rocalphago_tpu.engine.jaxgo import (
 # per-option ladder outcomes, ordered so the chaser minimises
 _CAPTURED, _CONTINUE, _ESCAPED = 0, 1, 2
 
-# two-phase chase schedule (see _compacted_chase): phase 1 reads all
-# slots to _PHASE1_DEPTH rungs lockstep; still-live lanes then finish
-# one at a time at 1/slots the loop width. Most lanes settle within a
-# few rungs (measured, random 19×19 mid-games: CPU encode 2.5× faster
-# at 4 than single-phase); env override for on-chip A/B tuning.
-# floor 1: a while_loop body always runs once for live lanes, so a
-# "depth-0" phase 1 would still play a rung and over-read by one
-_PHASE1_DEPTH = max(1, int(os.environ.get(
-    "ROCALPHAGO_LADDER_PHASE1", "4")))
+def _phase1_depth() -> int:
+    """Two-phase chase schedule knob (see _compacted_chase): phase 1
+    reads all slots to this many rungs lockstep; still-live lanes
+    then finish one at a time at 1/slots the loop width. Most lanes
+    settle within a few rungs (measured, random 19×19 mid-games: CPU
+    encode 2.5× faster at 4 than single-phase). Read from
+    ``$ROCALPHAGO_LADDER_PHASE1`` at TRACE time (same policy as
+    ``_chase_impl``) so on-chip A/B sweeps can flip it per run.
+    Floor 1: a while_loop body always runs once for live lanes, so a
+    "depth-0" phase 1 would still play a rung and over-read by one."""
+    return max(1, int(os.environ.get("ROCALPHAGO_LADDER_PHASE1", "4")))
 
 
 def _place(cfg: GoConfig, board, gd: GroupData, action, color):
@@ -459,7 +461,7 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
         # to full depth (the slots-restore-exactness contract), and
         # the worst case (all slots deep) costs what the single
         # lockstep loop did.
-        d1 = min(_PHASE1_DEPTH, depth)
+        d1 = min(_phase1_depth(), depth)
         prey = prey_pts[safe]
         captured, unres, b_end, lab_end = jax.vmap(
             lambda b, l, p, v: _chase(cfg, b, l, p, d1, enabled=v,

@@ -61,19 +61,25 @@ def load_records(path: str, date: str, platform: str | None):
 
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
-                "vs_baseline"}
+                "vs_baseline", "mfu"}
 
 
 def render_table(records) -> str:
-    lines = ["| metric | value | unit | config |",
-             "|---|---|---|---|"]
+    """MFU gets its own column (VERDICT r3 #3): benches that know
+    their program's XLA-costed flops record ``mfu`` = achieved
+    flops/s ÷ the chip's bf16 peak (see benchmarks/_harness.py);
+    '—' where a record has none (CPU runs, non-flops metrics)."""
+    lines = ["| metric | value | unit | MFU | config |",
+             "|---|---|---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
         extra = ("" if r.get("vs_baseline") in (None, "")
                  else f" (vs_baseline {r['vs_baseline']})")
+        u = r.get("mfu")
+        u = "—" if u in (None, "") else f"{100.0 * float(u):.1f}%"
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
-                     f" | {r.get('unit', '?')} | {cfg} |")
+                     f" | {r.get('unit', '?')} | {u} | {cfg} |")
     return "\n".join(lines)
 
 

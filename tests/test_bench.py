@@ -159,7 +159,7 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
                     "batch": 64, "platform": "tpu",
                     "date": "2026-07-31T02:00:00"}),   # newer wins
         json.dumps({"metric": "m", "value": 9.0, "unit": "u",
-                    "batch": 256, "platform": "tpu",
+                    "batch": 256, "platform": "tpu", "mfu": 0.1234,
                     "date": "2026-07-31T01:30:00"}),   # distinct cfg
         json.dumps({"metric": "m", "value": 5.0, "unit": "u",
                     "batch": 64, "platform": "cpu",
@@ -172,7 +172,9 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
     assert [(r["value"], r.get("batch")) for r in recs] \
         == [(2.0, 64), (9.0, 256)]
     table = bench_report.render_table(recs)
-    assert "| m | 2.0 | u | batch=64 |" in table
+    # MFU column: '—' when a record has none, percent when it does
+    assert "| m | 2.0 | u | — | batch=64 |" in table
+    assert "| m | 9.0 | u | 12.3% | batch=256 |" in table
 
     probe = tmp_path / "probe.log"
     probe.write_text(
