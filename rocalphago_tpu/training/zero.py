@@ -132,15 +132,23 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             v = value_apply(vp, planes)
             mse = (v - z) ** 2
             lp = (wf * ce).sum() / batch
-            lv = (live_t.astype(jnp.float32) * mse).sum() / batch
-            return lp + lv, (lp, lv)
+            livef = live_t.astype(jnp.float32)
+            lv = (livef * mse).sum() / batch
+            # win-prediction accuracy (VERDICT r3 #7): the learning
+            # signal the paper reports — live non-draw plies where
+            # the value head's SIGN matches the game's outcome
+            decided = livef * (z != 0)
+            correct = (decided * ((v > 0) == (z > 0))).sum()
+            return lp + lv, (lp, lv, correct, decided.sum(),
+                             livef.sum())
 
-        (gp, gv), (lp, lv) = jax.grad(
+        (gp, gv), (lp, lv, correct, cnt, live_n) = jax.grad(
             loss_fn, argnums=(0, 1), has_aux=True)(
                 policy_params, value_params)
         grads_p = jax.tree.map(jnp.add, grads_p, gp)
         grads_v = jax.tree.map(jnp.add, grads_v, gv)
-        stats = (stats[0] + lp, stats[1] + lv)
+        stats = (stats[0] + lp, stats[1] + lv, stats[2] + correct,
+                 stats[3] + cnt, stats[4] + live_n)
         # share the ply's one group analysis with the rules step
         return (vstep(states, actions_t, gd), grads_p, grads_v, stats)
 
@@ -166,6 +174,13 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         metrics = {
             "policy_loss": stats[0],
             "value_loss": stats[1],
+            # normalized value diagnostics: mean squared error per
+            # live ply (comparable across batch/move-limit configs —
+            # AlphaGo paper baseline 0.226/0.234; draws count in the
+            # MSE but not the accuracy) and win-prediction sign
+            # accuracy over decided plies (0.5 = uninformative)
+            "value_mse": stats[1] * batch / jnp.maximum(stats[4], 1.0),
+            "value_acc": stats[2] / jnp.maximum(stats[3], 1.0),
             "black_win_rate": (winners > 0).mean(),
             "draw_rate": (winners == 0).mean(),
             "mean_moves": num_moves.astype(jnp.float32).mean(),
@@ -190,7 +205,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             states = meshlib.shard_batch(mesh, states)
         grads_p = jax.tree.map(jnp.zeros_like, state.policy_params)
         grads_v = jax.tree.map(jnp.zeros_like, state.value_params)
-        stats = (jnp.float32(0), jnp.float32(0))
+        stats = (jnp.float32(0),) * 5
         live_f = live.astype(jnp.float32)
         plies = actions.shape[0]
         carry = (states, grads_p, grads_v, stats)

@@ -1,0 +1,171 @@
+"""Bisect the TPU worker crash on the composed self-play program.
+
+Round-2 standing defect (VERDICT r3 weak #2): every COMPONENT bench
+runs on the chip, but the composed self-play program kills the
+worker. This script builds the ply program up in stages and runs each
+as its own chunk-driven scan, so one invocation in a healthy tunnel
+window names the faulting composition:
+
+  engine   — rules step only, uniform-random sensible action
+  encode   — + 48-plane feature encode (consumed into the carry)
+  forward  — + policy conv forward + softmax sampling from its logits
+  full     — the real ``make_selfplay_chunked`` program (color-split
+             two-net forwards, live/freeze bookkeeping, action log)
+
+Kill-safety (memory: a client SIGKILLed mid-device-program wedges the
+tunnel for hours): every stage runs ≤``--chunk``-ply compiled
+segments from a host loop and checks its deadline BETWEEN segments,
+so the process never needs to be killed while a program is in
+flight. Each stage appends one JSON line to ``--log`` immediately
+(a worker crash mid-stage still leaves the earlier verdicts on disk).
+
+Usage (from a healthy window; ~2-4 min with warm compile cache):
+    python scripts/tpu_crash_bisect.py --log benchmarks/bisect.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--board", type=int, default=19)
+    ap.add_argument("--plies", type=int, default=60)
+    ap.add_argument("--chunk", type=int, default=10)
+    ap.add_argument("--budget-s", type=float, default=420.0)
+    ap.add_argument("--log", default="benchmarks/bisect.jsonl")
+    ap.add_argument("--stages", default="engine,encode,forward,full")
+    args = ap.parse_args()
+    deadline = time.time() + args.budget_s
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from benchmarks._harness import enable_compile_cache
+
+    enable_compile_cache()
+
+    from rocalphago_tpu.engine import jaxgo
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.features import DEFAULT_FEATURES
+    from rocalphago_tpu.features.planes import encode
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.search.selfplay import (
+        make_selfplay_chunked,
+        sensible_mask,
+    )
+
+    cfg = GoConfig(size=args.board)
+    platform = jax.devices()[0].platform
+    net = CNNPolicy(board=args.board, layers=12,
+                    filters_per_layer=128)
+
+    def emit(rec):
+        rec.update(platform=platform, batch=args.batch,
+                   board=args.board, chunk=args.chunk,
+                   date=time.strftime("%Y-%m-%dT%H:%M:%S"))
+        with open(args.log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+    any_ok = False
+    # one ply at increasing composition depth; every variant consumes
+    # what it computes (the carry) so XLA cannot dead-code it away
+    vgd = jax.vmap(lambda s: jaxgo.group_data(
+        cfg, s.board, with_member=True,
+        with_zxor=cfg.enforce_superko, labels=s.labels))
+    vsens = jax.vmap(functools.partial(sensible_mask, cfg))
+    vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
+    venc = jax.vmap(lambda s, g: encode(
+        cfg, s, features=DEFAULT_FEATURES, gd=g))
+
+    def ply_fn(stage):
+        n = cfg.num_points
+
+        def ply(carry, _):
+            states, acc, rng = carry
+            rng, sub = jax.random.split(rng)
+            gd = vgd(states)
+            sens = vsens(states, gd)
+            logits = jnp.zeros((args.batch, n), jnp.float32)
+            if stage in ("encode", "forward"):
+                planes = venc(states, gd)
+                acc = acc + planes.sum()
+            if stage == "forward":
+                logits = net.module.apply(
+                    net.params, planes).astype(jnp.float32)
+            neg = jnp.finfo(jnp.float32).min
+            masked = jnp.where(sens, logits, neg)
+            action = jnp.where(
+                sens.any(axis=-1),
+                jax.random.categorical(sub, masked, axis=-1),
+                jnp.int32(n))                      # forced pass
+            return (vstep(states, action.astype(jnp.int32), gd),
+                    acc, rng), None
+
+        @jax.jit
+        def segment(states, acc, rng):
+            (states, acc, rng), _ = lax.scan(
+                ply, (states, acc, rng), None, length=args.chunk)
+            return states, acc, rng
+
+        return segment
+
+    for stage in args.stages.split(","):
+        if time.time() > deadline:
+            emit({"stage": stage, "ok": False,
+                  "error": "bisect budget exhausted before stage"})
+            continue
+        t0 = time.time()
+        try:
+            if stage == "full":
+                run = make_selfplay_chunked(
+                    cfg, DEFAULT_FEATURES, net.module.apply,
+                    net.module.apply, args.batch, args.plies,
+                    chunk=args.chunk, score_on_device=False)
+                res = run(net.params, net.params, jax.random.key(0),
+                          deadline=min(deadline, time.time() + 240))
+                jax.device_get(res.final.board)
+                plies = res.actions.shape[0]
+            else:
+                seg = ply_fn(stage)
+                states = jaxgo.new_states(cfg, args.batch)
+                acc, rng = jnp.float32(0), jax.random.key(0)
+                plies = 0
+                while plies < args.plies:
+                    if plies and time.time() > deadline:
+                        break          # between segments: clean stop
+                    states, acc, rng = seg(states, acc, rng)
+                    jax.device_get(acc)    # force real completion
+                    plies += args.chunk
+            dt = time.time() - t0
+            any_ok = True
+            emit({"stage": stage, "ok": True, "plies": plies,
+                  "secs": round(dt, 1),
+                  "board_plies_per_s": round(
+                      plies * args.batch / max(dt, 1e-6), 1)})
+        except Exception as e:  # noqa: BLE001 — the verdict IS the point
+            emit({"stage": stage, "ok": False,
+                  "secs": round(time.time() - t0, 1),
+                  "error": f"{type(e).__name__}: {e}"[:500]})
+            # a worker crash takes ~15s to self-recover; give it that
+            # before the next stage so one crash doesn't cascade
+            time.sleep(20)
+    # rc 1 when NOTHING ran clean (outage / budget gone): the hunter
+    # must retry the step in a later healthy window, not mark it done
+    return 0 if any_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

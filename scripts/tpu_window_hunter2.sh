@@ -102,9 +102,9 @@ make_specs() {
 make_specs
 
 STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
-preprocess chase_xla chase_pls devmcts9 selfplay16 selfplay64 selfplay256 \
-mcts19 mcts19r rl engine_trace train_trace preprocess_trace tournament \
-headline_sized headline"
+preprocess chase_xla chase_pls devmcts9 devmcts_gumbel selfplay16 \
+selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
+train_trace preprocess_trace tournament headline_sized headline"
 n_steps=$(echo $STEPS | wc -w)
 deadline=$(( $(date +%s) + ${HUNT_BUDGET_S:-36000} ))
 
@@ -138,6 +138,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             chase_xla)   run chase_xla   python benchmarks/bench_chase.py --reps 2 ;;
             chase_pls)   run chase_pls   env ROCALPHAGO_PALLAS_CHASE=1 python benchmarks/bench_chase.py --reps 2 ;;
             devmcts9)    run devmcts9    python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
+            devmcts_gumbel) run devmcts_gumbel python benchmarks/bench_device_mcts.py --board 9 --sims 32 --gumbel --reps 2 ;;
+            bisect)      run bisect      python scripts/tpu_crash_bisect.py --log "$LOG/bisect.jsonl" ;;
             selfplay16)  run selfplay16  python benchmarks/bench_selfplay.py --batch-sweep 16 --reps 2 ;;
             selfplay64)  run selfplay64  python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;
             selfplay256) run selfplay256 python benchmarks/bench_selfplay.py --batch-sweep 256 --reps 2 ;;

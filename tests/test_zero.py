@@ -44,8 +44,9 @@ def test_zero_iteration_trains_both_nets(nets):
     new, metrics = iteration(state)
     assert int(jax.device_get(new.iteration)) == 1
     for key in ("policy_loss", "value_loss", "black_win_rate",
-                "draw_rate", "mean_moves"):
+                "draw_rate", "mean_moves", "value_mse", "value_acc"):
         assert np.isfinite(float(jax.device_get(metrics[key]))), key
+    assert 0.0 <= float(jax.device_get(metrics["value_acc"])) <= 1.0
 
     def delta(a, b):
         fa, _ = jax.flatten_util.ravel_pytree(jax.device_get(a))
