@@ -79,7 +79,30 @@ def main() -> int:
             f.write(json.dumps(rec) + "\n")
         print(json.dumps(rec), flush=True)
 
-    any_ok = False
+    def tunnel_healthy() -> bool:
+        """Post-failure reprobe: was that a worker CRASH (tunnel still
+        answers) or an OUTAGE (window closed — the failure says
+        nothing about the composition)? Same kill-safe probe the
+        hunter gates on. Off-TPU there is no tunnel to lose."""
+        if platform != "tpu":
+            return True
+        import subprocess
+        probe = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tpu_probe.py")
+        try:
+            rc = subprocess.run(
+                [sys.executable, probe], capture_output=True,
+                timeout=90).returncode
+        except subprocess.TimeoutExpired:
+            return False
+        return rc in (0, 3)
+
+    # a stage yields a usable VERDICT when it either ran its full
+    # plies clean (exoneration needs sustained execution — the known
+    # crash mode appears only past ~30-40s) or failed while the
+    # tunnel still answered (a genuine crash, not an outage)
+    verdicts = 0
+    n_stages = 0
     # one ply at increasing composition depth; every variant consumes
     # what it computes (the carry) so XLA cannot dead-code it away
     vgd = jax.vmap(lambda s: jaxgo.group_data(
@@ -123,8 +146,9 @@ def main() -> int:
         return segment
 
     for stage in args.stages.split(","):
+        n_stages += 1
         if time.time() > deadline:
-            emit({"stage": stage, "ok": False,
+            emit({"stage": stage, "ok": False, "outage": True,
                   "error": "bisect budget exhausted before stage"})
             continue
         t0 = time.time()
@@ -150,21 +174,29 @@ def main() -> int:
                     jax.device_get(acc)    # force real completion
                     plies += args.chunk
             dt = time.time() - t0
-            any_ok = True
-            emit({"stage": stage, "ok": True, "plies": plies,
+            full = plies >= args.plies
+            if full:
+                verdicts += 1
+            emit({"stage": stage, "ok": full, "plies": plies,
                   "secs": round(dt, 1),
+                  **({} if full else {"truncated": True}),
                   "board_plies_per_s": round(
                       plies * args.batch / max(dt, 1e-6), 1)})
         except Exception as e:  # noqa: BLE001 — the verdict IS the point
-            emit({"stage": stage, "ok": False,
+            healthy = tunnel_healthy()
+            if healthy:
+                verdicts += 1        # a GENUINE crash verdict
+            emit({"stage": stage, "ok": False, "outage": not healthy,
                   "secs": round(time.time() - t0, 1),
                   "error": f"{type(e).__name__}: {e}"[:500]})
             # a worker crash takes ~15s to self-recover; give it that
             # before the next stage so one crash doesn't cascade
             time.sleep(20)
-    # rc 1 when NOTHING ran clean (outage / budget gone): the hunter
-    # must retry the step in a later healthy window, not mark it done
-    return 0 if any_ok else 1
+    # rc 0 ONLY when every requested stage produced a usable verdict
+    # (clean full run, or a crash with the tunnel still answering) —
+    # anything less and the hunter must retry in a later window
+    # rather than bank a partial/outage-polluted bisect as done
+    return 0 if verdicts == n_stages else 1
 
 
 if __name__ == "__main__":
