@@ -1,0 +1,55 @@
+"""Shared GTP move-clock: seconds budget → search-unit budget.
+
+Both searchers (the host-tree :class:`~rocalphago_tpu.search.mcts.
+MCTSPlayer` and the on-device :class:`~rocalphago_tpu.search.
+device_mcts.DeviceMCTSPlayer`) convert the per-move second budget the
+GTP engine hands them (``set_move_time``) into their own unit —
+playouts or simulations — via a measured units/sec estimate. One
+implementation serves both so the two players cannot drift apart
+(the reference's time handling lives in its GTP wrapper; SURVEY.md
+§1 L6 — here the wrapper owns the clock arithmetic and THIS owns the
+rate conversion).
+
+Rate hygiene: a sample is folded into the EMA only when its ``key``
+(whatever granularity the caller compiles programs at — per-komi,
+per-simulation-tier) has run before. A key's FIRST run pays the XLA
+compiles; folding its wall time in would collapse subsequent budgets
+far below what the clock affords.
+"""
+
+from __future__ import annotations
+
+
+class MoveClock:
+    """Per-move wall budget + warmed-keyed units/sec EMA."""
+
+    def __init__(self) -> None:
+        self.move_time: float | None = None   # seconds; None = off
+        self.rate: float | None = None        # units/sec EMA
+        self._warmed: set = set()
+
+    def set_move_time(self, seconds) -> None:
+        """Per-move wall budget in seconds (None = no clock). The GTP
+        engine calls this before every genmove from the game clock."""
+        self.move_time = (None if seconds is None
+                          else max(float(seconds), 0.0))
+
+    def allowed_units(self) -> int | None:
+        """Units the budget affords, or None (no clock / no estimate
+        yet — callers run their full configured budget, which also
+        seeds the estimate)."""
+        if self.move_time is None or self.rate is None:
+            return None
+        return int(self.move_time * self.rate)
+
+    def note(self, key, units: int, wall: float) -> None:
+        """Record a finished search: ``units`` ran in ``wall`` secs
+        under ``key``'s compiled programs. First run per key only
+        warms the key (compile-bearing — never sampled)."""
+        if key not in self._warmed:
+            self._warmed.add(key)
+            return
+        if wall <= 0:
+            return
+        r = units / wall
+        self.rate = r if self.rate is None else 0.5 * self.rate + 0.5 * r

@@ -58,6 +58,7 @@ from rocalphago_tpu.engine.jaxgo import (
 )
 from rocalphago_tpu.features.planes import encode, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
+from rocalphago_tpu.search.clock import MoveClock
 from rocalphago_tpu.search.selfplay import sensible_mask
 
 
@@ -690,12 +691,10 @@ class DeviceMCTSPlayer:
         self._reuse = reuse and not gumbel
         self._carry = None
         self.reuses = 0     # observability: # of reused searches
-        # GTP time control (see class docstring)
-        self._move_time = None      # seconds/move; None = no clock
-        self._sims_per_sec = None   # EMA of measured search speed
-        self._warmed: set = set()   # searcher keys past their first,
-        # compile-bearing run — only warmed runs feed the rate EMA
-        # (a compile-polluted sample would collapse the budget)
+        # GTP time control (see class docstring): shared clock, rate
+        # samples keyed per searcher so each key's compile-bearing
+        # first run never pollutes the sims/sec EMA
+        self._clock = MoveClock()
         self.last_n_sim = None      # sims the last get_move ran
         # searchers are cached PER KOMI: the search's terminal-node
         # evaluations score with its GoConfig's komi, and GTP can set
@@ -715,15 +714,7 @@ class DeviceMCTSPlayer:
     def set_move_time(self, seconds) -> None:
         """Per-move wall budget in seconds (None = no clock). The GTP
         engine calls this before every genmove from the game clock."""
-        self._move_time = (None if seconds is None
-                           else max(float(seconds), 0.0))
-
-    def _note_rate(self, sims: int, wall: float) -> None:
-        if wall <= 0:
-            return
-        r = sims / wall
-        self._sims_per_sec = (r if self._sims_per_sec is None
-                              else 0.5 * self._sims_per_sec + 0.5 * r)
+        self._clock.set_move_time(seconds)
 
     def _effective_sims(self) -> int:
         """Simulation budget for the next search under the clock.
@@ -732,9 +723,9 @@ class DeviceMCTSPlayer:
         capped at nominal ``n_sim``. No clock, or no measurement yet
         (the very first search — which pays the compiles anyway and
         seeds the estimate): full budget."""
-        if self._move_time is None or self._sims_per_sec is None:
+        allowed = self._clock.allowed_units()
+        if allowed is None:
             return self._n_sim
-        allowed = int(self._move_time * self._sims_per_sec)
         if self._gumbel:
             # halving tiers only: each distinct n_sim compiles its
             # own phase programs, so at most log2(n_sim) tiers exist.
@@ -859,10 +850,7 @@ class DeviceMCTSPlayer:
             if self._reuse:
                 self._carry = (komi, state.size, state.turns_played,
                                tree)
-        if skey in self._warmed:        # first run pays the compiles
-            self._note_rate(ran, time.monotonic() - t0)
-        else:
-            self._warmed.add(skey)
+        self._clock.note(skey, ran, time.monotonic() - t0)
         self.last_n_sim = ran
         if action >= cfg.num_points or counts[action] == 0:
             return None                              # pass

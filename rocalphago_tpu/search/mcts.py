@@ -26,6 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.search.clock import MoveClock
 
 PASS_MOVE = pygo.PASS_MOVE
 
@@ -189,10 +190,13 @@ class MCTS:
 
     # ------------------------------------------------------------ driving
 
-    def get_move(self, state):
+    def get_move(self, state, n_playout: int | None = None):
         """Run playouts from ``state`` and return the most-visited
-        move (``None`` = pass when the tree has no children)."""
-        for _ in range(self._n_playout):
+        move (``None`` = pass when the tree has no children).
+        ``n_playout`` overrides the configured budget (a game clock
+        may ask for fewer)."""
+        for _ in range(n_playout if n_playout is not None
+                       else self._n_playout):
             self._playout(state.copy())
         if self._root.is_leaf():
             return PASS_MOVE
@@ -240,8 +244,9 @@ class ParallelMCTS(MCTS):
         # (priors list, values) off ONE shared encode per wave
         self._pv = batch_policy_value_fn
 
-    def get_move(self, state):
-        waves, rem = divmod(self._n_playout, self._leaf_batch)
+    def get_move(self, state, n_playout: int | None = None):
+        n = self._n_playout if n_playout is None else n_playout
+        waves, rem = divmod(n, self._leaf_batch)
         for _ in range(waves):
             self._wave(state, self._leaf_batch)
         if rem:
@@ -487,6 +492,14 @@ class MCTSPlayer:
     move when the incoming state extends it by exactly one ply, and
     otherwise resets the tree — so a stale tree can never desync from
     the position being searched.
+
+    TIME CONTROL mirrors :class:`~rocalphago_tpu.search.device_mcts.
+    DeviceMCTSPlayer`: ``set_move_time(seconds)`` (wired from GTP by
+    the engine) caps the next search at ``seconds × measured
+    playouts/sec`` (shared :class:`~rocalphago_tpu.search.clock.
+    MoveClock`; samples keyed per komi so each komi's compile-
+    bearing first search is excluded), floored at one leaf wave.
+    ``last_n_playout`` reports what the last search really ran.
     """
 
     def __init__(self, value, policy, rollout=None, lmbda: float = 0.5,
@@ -508,6 +521,24 @@ class MCTSPlayer:
                                  leaf_batch=leaf_batch, rng=rng,
                                  batch_policy_value_fn=bpv)
         self._tree_history: list | None = None
+        # GTP time control (see class docstring): shared clock, rate
+        # samples keyed per komi — net_backends compiles one program
+        # per distinct komi, and that first run must not feed the EMA
+        self._clock = MoveClock()
+        self.last_n_playout = None
+
+    def set_move_time(self, seconds) -> None:
+        """Per-move wall budget in seconds (None = no clock). The GTP
+        engine calls this before every genmove from the game clock."""
+        self._clock.set_move_time(seconds)
+
+    def _effective_playouts(self) -> int:
+        allowed = self._clock.allowed_units()
+        if allowed is None:
+            return self.mcts._n_playout
+        wave = self.mcts._leaf_batch
+        return min(self.mcts._n_playout,
+                   max(wave, allowed // wave * wave))
 
     def _sync_tree(self, history: list) -> None:
         if self._tree_history is None or history == self._tree_history:
@@ -526,7 +557,14 @@ class MCTSPlayer:
             self._tree_history = None
             self.mcts.reset()
             return PASS_MOVE
-        move = self.mcts.get_move(state)
+        import time as _time
+
+        eff = self._effective_playouts()
+        t0 = _time.monotonic()
+        move = self.mcts.get_move(state, n_playout=eff)
+        self._clock.note(float(state.komi), eff,
+                         _time.monotonic() - t0)
+        self.last_n_playout = eff
         self.mcts.update_with_move(move)
         self._tree_history = history + [move]
         return move

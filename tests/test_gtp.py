@@ -284,15 +284,15 @@ def test_low_time_shrinks_device_search(monkeypatch):
     # must NOT feed the rate EMA (it would collapse later budgets)
     ok(eng, "genmove b")
     assert player.last_n_sim == 32
-    assert player._sims_per_sec is None
+    assert player._clock.rate is None
     # second (warmed) move seeds the honest estimate
     ok(eng, "genmove w")
     assert player.last_n_sim == 32
-    assert player._sims_per_sec is not None
+    assert player._clock.rate is not None
     # pin the measured rate so the assertion is deterministic:
     # 16 sims/s × 1 s budget → 16 sims (a chunk multiple ≤ n_sim)
-    player._sims_per_sec = 16.0
-    monkeypatch.setattr(player, "_note_rate", lambda *a: None)
+    player._clock.rate = 16.0
+    monkeypatch.setattr(player._clock, "note", lambda *a: None)
     ok(eng, "time_left w 1 1")
     ok(eng, "genmove w")
     assert player.last_n_sim == 16
@@ -318,7 +318,7 @@ def test_gumbel_time_tiers():
     player = DeviceMCTSPlayer(val, pol, n_sim=64, gumbel=True,
                               m_root=4, sim_chunk=8)
     assert gumbel_plan_sims(64, 4, 26) == 64
-    player._sims_per_sec = 32.0
+    player._clock.rate = 32.0
     player.set_move_time(1.0)          # allows 32 < plan(64)=64
     assert player._effective_sims() == 32
     player.set_move_time(100.0)        # generous → full tier
@@ -330,7 +330,7 @@ def test_gumbel_time_tiers():
     # non-power-of-two budgets never tier below the plan floor
     p2 = DeviceMCTSPlayer(val, pol, n_sim=100, gumbel=True,
                           m_root=16, sim_chunk=8)
-    p2._sims_per_sec = 1.0
+    p2._clock.rate = 1.0
     p2.set_move_time(0.01)
     floor_tier = p2._effective_sims()
     assert floor_tier >= 2

@@ -304,3 +304,33 @@ def test_mcts_player_alternating_game_stays_synced():
                       if moves else pygo.PASS_MOVE)
         if state.is_end_of_game:
             break
+
+
+def test_mcts_player_time_shrinks_playouts():
+    """Host-tree parity with DeviceMCTSPlayer's clock behavior
+    (VERDICT r3 #10): under a short budget the player runs fewer
+    playouts (leaf-wave multiples), and the first, compile-bearing
+    search never feeds the rate estimate."""
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.mcts import MCTSPlayer
+
+    pol = CNNPolicy(("board", "ones"), board=5, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(("board", "ones", "color"), board=5, layers=1,
+                   filters_per_layer=2)
+    player = MCTSPlayer(val, pol, lmbda=1.0, n_playout=16,
+                        leaf_batch=4, seed=0)
+    st = pygo.GameState(size=5)
+    player.set_move_time(5.0)        # clock set, but no rate yet
+    player.get_move(st)
+    assert player.last_n_playout == 16   # full budget, seeds nothing
+    assert player._clock.rate is None    # first search excluded
+    player._clock.rate = 8.0             # pin: 8 playouts/sec
+    player.set_move_time(1.0)            # → 8 playouts = 2 waves
+    st.do_move((2, 2))
+    player.get_move(st)
+    assert player.last_n_playout == 8
+    player.set_move_time(1000.0)         # generous → full budget
+    st.do_move((1, 1))
+    player.get_move(st)
+    assert player.last_n_playout == 16
