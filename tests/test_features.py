@@ -388,3 +388,48 @@ class TestAPI:
         la = t[:, :, sl["liberties_after"]]
         assert la[0, 0, 1] == 1.0   # corner stone: 2 libs
         assert la[2, 2, 3] == 1.0   # center stone: 4 libs
+
+
+class TestTwoPhaseChaseEquivalence:
+    """The two-phase chase schedule (round 4) must be BIT-IDENTICAL
+    to the single lockstep chase: phase 2 resumes each capped lane
+    from its frozen exit state, so splitting the read cannot change
+    any outcome. ``ROCALPHAGO_LADDER_PHASE1=<depth>`` recovers the
+    single-phase program exactly (d1 = min(knob, depth) = depth →
+    no deep tail), giving a direct differential."""
+
+    @staticmethod
+    def _positions():
+        """Random mid-games PLUS the constructed 6-ladder overflow
+        board — its chases cross the whole 19×19 board, so lanes
+        provably survive past phase 1 and the resume path does real
+        work (not just the all-lanes-settled trivial case)."""
+        rng = np.random.default_rng(20260731)
+        for size, plies in ((9, 40), (19, 160)):
+            st = pygo.GameState(size=size, komi=5.5)
+            for _ in range(plies):
+                legal = st.get_legal_moves(include_eyes=False)
+                if not legal or st.is_end_of_game:
+                    break
+                st.do_move(legal[rng.integers(len(legal))])
+            yield size, st
+        deep = TestLadderOverflow()._board()
+        yield 19, deep
+
+    def test_two_phase_equals_single_phase(self, monkeypatch):
+        for size, st in self._positions():
+            cfg = GoConfig(size=size, komi=7.5)
+            st.komi = 7.5
+            jst = jaxgo.from_pygo(cfg, st)
+
+            monkeypatch.setenv("ROCALPHAGO_LADDER_PHASE1", "4")
+            two = np.asarray(Preprocess(
+                ("ladder_capture", "ladder_escape"), cfg=cfg,
+                ladder_depth=40).state_to_tensor(jst))[0]
+            # a huge knob forces d1 = min(knob, depth) = depth: the
+            # exact single-phase program, whatever the default depth
+            monkeypatch.setenv("ROCALPHAGO_LADDER_PHASE1", "100000")
+            one = np.asarray(Preprocess(
+                ("ladder_capture", "ladder_escape"), cfg=cfg,
+                ladder_depth=40).state_to_tensor(jst))[0]
+            np.testing.assert_array_equal(two, one)
