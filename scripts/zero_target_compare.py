@@ -1,0 +1,121 @@
+"""Paired zero-trainer runs: PUCT visit targets vs Gumbel π′ targets.
+
+Round-3 finding (results/zero_demo/zero_target_comparison.json): from
+RANDOM nets, π′ = softmax(logits + σ(q̂)) is noise — σ ranks by the
+VALUE net, and an untrained value net makes the target unlearnable
+while PUCT's visit counts (prior-dominated) still teach. The round-3
+conclusion predicted π′ becomes informative exactly when the value
+net does. This script is the ABOVE-THE-NOISE-FLOOR rerun (VERDICT r3
+#7): warm-start BOTH runs from the same trained policy/value pair
+(e.g. the round-4 zero run's exports, value_acc ≈ 0.7+) and compare
+policy-CE trajectories under identical configs/seeds.
+
+Usage:
+    python scripts/zero_target_compare.py POLICY.json VALUE.json \
+        OUT_DIR [--iterations 10] [--game-batch 16] [--sims 16] \
+        [--move-limit 80] [--seed 11]
+
+Writes OUT_DIR/{puct,gumbel}/ (full trainer artifacts) and
+OUT_DIR/comparison.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def run_one(mode: str, a, out_dir: str) -> list[dict]:
+    # the trainer's metrics logger APPENDS: rerunning into a used
+    # out_dir would silently mix stale rows from a differently
+    # configured run into the comparison
+    stale = os.path.join(out_dir, "metrics.jsonl")
+    if os.path.exists(stale):
+        raise SystemExit(
+            f"{stale} already exists — pick a fresh OUT_DIR (the "
+            "trainer appends, and mixed runs would corrupt the "
+            "comparison)")
+    args = [sys.executable, "-m", "rocalphago_tpu.training.zero",
+            a.policy_json, a.value_json, out_dir,
+            "--iterations", str(a.iterations),
+            "--game-batch", str(a.game_batch),
+            "--sims", str(a.sims),
+            "--move-limit", str(a.move_limit),
+            "--seed", str(a.seed),
+            "--save-every", str(max(a.iterations, 1))]
+    if mode == "gumbel":
+        args += ["--gumbel", "--m-root", str(a.m_root)]
+    else:
+        args += ["--dirichlet-alpha", str(a.dirichlet_alpha)]
+    t0 = time.time()
+    proc = subprocess.run(args, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise SystemExit(
+            f"{mode} run failed rc={proc.returncode}:\n"
+            + proc.stderr[-2000:])
+    print(f"{mode}: {a.iterations} iterations in "
+          f"{time.time() - t0:.0f}s", flush=True)
+    rows = []
+    with open(os.path.join(out_dir, "metrics.jsonl")) as f:
+        for line in f:
+            r = json.loads(line)
+            if r.get("event") == "iteration":
+                rows.append({k: round(float(r[k]), 4) for k in (
+                    "policy_loss", "value_loss", "value_acc",
+                    "value_mse") if k in r})
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("policy_json")
+    ap.add_argument("value_json")
+    ap.add_argument("out_dir")
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--game-batch", type=int, default=16)
+    ap.add_argument("--sims", type=int, default=16)
+    ap.add_argument("--move-limit", type=int, default=80)
+    ap.add_argument("--m-root", type=int, default=8)
+    ap.add_argument("--dirichlet-alpha", type=float, default=0.15)
+    ap.add_argument("--seed", type=int, default=11)
+    a = ap.parse_args(argv)
+
+    os.makedirs(a.out_dir, exist_ok=True)
+    results = {}
+    for mode in ("puct", "gumbel"):
+        results[mode] = run_one(mode, a,
+                                os.path.join(a.out_dir, mode))
+
+    def ce_first_last(rows):
+        ce = [r["policy_loss"] for r in rows]
+        if not ce:
+            raise SystemExit(
+                "a trainer run exited clean but logged no iteration "
+                "rows — nothing to compare (check --iterations)")
+        return {"first": ce[0], "last": ce[-1],
+                "delta": round(ce[-1] - ce[0], 4)}
+
+    comparison = {
+        "config": {k: getattr(a, k) for k in (
+            "policy_json", "value_json", "iterations", "game_batch",
+            "sims", "move_limit", "m_root", "dirichlet_alpha",
+            "seed")},
+        "puct": results["puct"],
+        "gumbel": results["gumbel"],
+        "policy_ce": {m: ce_first_last(results[m])
+                      for m in ("puct", "gumbel")},
+    }
+    path = os.path.join(a.out_dir, "comparison.json")
+    with open(path, "w") as f:
+        json.dump(comparison, f, indent=2)
+    print(json.dumps(comparison["policy_ce"], indent=2))
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
