@@ -203,3 +203,45 @@ def test_probe_stats_midnight_and_file_boundaries(tmp_path, monkeypatch):
     assert s["windows"] == 2
     assert s["window_spans_s"] == [1800, 0]
     assert s["probes"] == 3 and s["up"] == 3
+
+
+def test_zero_curve_summary(tmp_path, monkeypatch):
+    """scripts/zero_curve.py: curve extraction, config echo, and the
+    flat-vs-learning verdict thresholds."""
+    monkeypatch.syspath_prepend(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import zero_curve
+
+    run = tmp_path / "run"
+    run.mkdir()
+    (run / "metadata.json").write_text(json.dumps(
+        {"config": {"game_batch": 4, "sims": 8}}))
+    rows = [{"event": "iteration", "iteration": i,
+             "value_acc": 0.5 + 0.04 * i, "value_mse": 1.0 - 0.05 * i,
+             "policy_loss": 100.0 - i} for i in range(10)]
+    (run / "metrics.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n")
+
+    out = tmp_path / "s.json"
+    zero_curve.main([str(run), "--window", "3", "--out", str(out)])
+    s = json.loads(out.read_text())
+    assert s["iterations"] == 10 and s["games"] == 40
+    acc = s["curves"]["value_acc"]
+    assert acc["first"] == 0.5 and acc["last"] == pytest.approx(0.86)
+    assert s["value_head_verdict"] == "learning"
+
+    # flat curve -> flat verdict
+    flat = [dict(r, value_acc=0.5) for r in rows]
+    (run / "metrics.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in flat) + "\n")
+    zero_curve.main([str(run), "--out", str(out)])
+    assert json.loads(out.read_text())["value_head_verdict"] == "flat"
+
+    # rising but still ~chance (tail below the 0.55 floor) is NOT
+    # "learning" — the verdict needs level, not just slope
+    low = [dict(r, value_acc=0.30 + 0.02 * r["iteration"])
+           for r in rows]
+    (run / "metrics.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in low) + "\n")
+    zero_curve.main([str(run), "--out", str(out)])
+    assert json.loads(out.read_text())["value_head_verdict"] == "flat"
