@@ -62,6 +62,18 @@ class GoConfig:
         return self.size * self.size
 
 
+def default_komi(size: int) -> float:
+    """Standard area-scoring komi per board size: 7.5 for 13×13 and
+    up (the reference's and the zero papers' 19×19 value), 7.0 below
+    (the CGOS 9×9 convention). Round-4 evidence for why this must be
+    size-aware: a 9×9 zero run under the 19×19 default showed an 86%
+    white win rate (``results/zero_scale_r4``) — most of that was the
+    80-ply move cap truncating every game, but the komi default was
+    the other half of the diagnosis (VERDICT r4 §weak 2;
+    ``scripts/zero_balance.py`` measures both effects)."""
+    return 7.5 if size >= 13 else 7.0
+
+
 class GoState(NamedTuple):
     """One game. Batch by ``vmap``-ing the engine functions.
 

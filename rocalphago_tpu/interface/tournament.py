@@ -103,7 +103,9 @@ def main(argv=None):
     ap.add_argument("player_b", help="kind:policy.json[:value.json]")
     ap.add_argument("--games", type=int, default=20)
     ap.add_argument("--board", type=int, default=19)
-    ap.add_argument("--komi", type=float, default=7.5)
+    ap.add_argument("--komi", type=float, default=None,
+                    help="area-scoring komi (default: the board "
+                         "size's standard — 7.5 at 13x13+, 7.0 below)")
     ap.add_argument("--move-limit", type=int, default=722)
     ap.add_argument("--temperature", type=float, default=0.67)
     ap.add_argument("--playouts", type=int, default=100)
@@ -112,6 +114,10 @@ def main(argv=None):
                          "wave instead of host rules")
     ap.add_argument("--log", default=None, help="JSONL game log path")
     a = ap.parse_args(argv)
+    if a.komi is None:
+        from rocalphago_tpu.engine.jaxgo import default_komi
+
+        a.komi = default_komi(a.board)
     pa = _build_player(a.player_a, a.temperature, a.playouts,
                        device_rollout=a.device_rollout, board=a.board)
     pb = _build_player(a.player_b, a.temperature, a.playouts,

@@ -78,6 +78,8 @@ class RLConfig:
     num_devices: int | None = None
     chunk: int = 0    # >0: plies per compiled segment (watchdog-safe
     #                   chunked iteration; 0 = one monolithic program)
+    komi: float | None = None   # None = board size's standard
+    #                   (engine.jaxgo.default_komi; VERDICT r4 weak 2)
 
 
 class RLState(NamedTuple):
@@ -348,17 +350,23 @@ class RLTrainer:
 
         tx = optax.sgd(cfg.learning_rate)
         rep = meshlib.replicated(self.mesh)
+        # scoring komi: per-board-size default unless overridden (the
+        # net spec's GoConfig always carries the 19x19 value)
+        game_cfg = dataclasses.replace(
+            self.net.cfg, komi=cfg.komi if cfg.komi is not None
+            else jaxgo.default_komi(self.net.cfg.size))
+        cfg.komi = game_cfg.komi    # metadata records the resolved value
         if cfg.chunk:
             # host-driven segmented iteration (not itself jittable —
             # its internal segment programs are the jit units)
             self._iteration = make_rl_iteration_chunked(
-                self.net.cfg, self.net.feature_list,
+                game_cfg, self.net.feature_list,
                 self.net.module.apply, tx, cfg.game_batch,
                 cfg.move_limit, cfg.policy_temp, chunk=cfg.chunk,
                 mesh=self.mesh)
         else:
             iteration = make_rl_iteration(
-                self.net.cfg, self.net.feature_list,
+                game_cfg, self.net.feature_list,
                 self.net.module.apply, tx, cfg.game_batch,
                 cfg.move_limit, cfg.policy_temp, mesh=self.mesh)
             self._iteration = jax.jit(iteration, donate_argnums=(0,),
@@ -454,13 +462,17 @@ def run_training(argv=None) -> dict:
                     help="plies per compiled segment (0 = monolithic; "
                          "use e.g. 10-60 on backends that kill long "
                          "device programs)")
+    ap.add_argument("--komi", type=float, default=None,
+                    help="area-scoring komi (default: the board "
+                         "size's standard; engine.jaxgo.default_komi)")
     a = ap.parse_args(argv)
     cfg = RLConfig(
         model_json=a.model_json, out_dir=a.out_dir,
         learning_rate=a.learning_rate, game_batch=a.game_batch,
         iterations=a.iterations, save_every=a.save_every,
         policy_temp=a.policy_temp, move_limit=a.move_limit,
-        seed=a.seed, num_devices=a.num_devices, chunk=a.chunk)
+        seed=a.seed, num_devices=a.num_devices, chunk=a.chunk,
+        komi=a.komi)
     return RLTrainer(cfg).run()
 
 

@@ -200,11 +200,18 @@ class ValueDataGenerator:
     def __init__(self, sl_net: NeuralNetBase, rl_net: NeuralNetBase,
                  value_features: tuple, batch: int = 64,
                  max_moves: int = 500, temperature: float = 1.0,
-                 u_max: int | None = None, chunk: int = 0):
+                 u_max: int | None = None, chunk: int = 0,
+                 komi: float | None = None):
         if sl_net.feature_list != rl_net.feature_list or \
                 sl_net.board != rl_net.board:
             raise ValueError("SL and RL nets must share features/board")
-        self.cfg = sl_net.cfg
+        import dataclasses
+
+        # scoring komi: per-board-size standard unless overridden
+        # (the net spec's GoConfig always carries the 19x19 value)
+        self.cfg = dataclasses.replace(
+            sl_net.cfg, komi=komi if komi is not None
+            else jaxgo.default_komi(sl_net.cfg.size))
         self.sl = sl_net
         self.rl = rl_net
         self.pre = Preprocess(value_features, cfg=self.cfg)
@@ -278,6 +285,7 @@ class ValueDataGenerator:
 
         manifest = {
             "board_size": self.cfg.size,
+            "komi": self.cfg.komi,
             "planes": self.pre.output_dim,
             "feature_list": list(self.pre.feature_list),
             "targets": "outcome",
@@ -311,6 +319,9 @@ def run_generator(argv=None) -> dict:
                          "scan; use e.g. 10-60 on backends that kill "
                          "long device programs) — with early exit "
                          "once every game in the batch has ended")
+    ap.add_argument("--komi", type=float, default=None,
+                    help="area-scoring komi (default: the board "
+                         "size's standard; engine.jaxgo.default_komi)")
     a = ap.parse_args(argv)
     sl = NeuralNetBase.load_model(a.sl_model_json)
     rl = NeuralNetBase.load_model(a.rl_model_json)
@@ -322,7 +333,8 @@ def run_generator(argv=None) -> dict:
         features = sl.feature_list + ("color",)
     gen = ValueDataGenerator(sl, rl, features, batch=a.batch,
                              max_moves=a.max_moves,
-                             temperature=a.temperature, chunk=a.chunk)
+                             temperature=a.temperature, chunk=a.chunk,
+                             komi=a.komi)
     manifest = gen.generate(a.n_positions, a.out_prefix, seed=a.seed)
     print(json.dumps({k: manifest[k] for k in
                       ("num_positions", "planes", "board_size")}))

@@ -93,7 +93,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
 
-    def ply(policy_params, value_params, winners, carry, xs):
+    def ply(policy_params, value_params, winners, finished, carry, xs):
         states, grads_p, grads_v, stats = carry
         actions_t, live_t, visits_t = xs
         if mesh is not None:
@@ -132,7 +132,13 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             v = value_apply(vp, planes)
             mse = (v - z) ** 2
             lp = (wf * ce).sum() / batch
-            livef = live_t.astype(jnp.float32)
+            # value targets only from games that actually ENDED (two
+            # passes): a move-capped game's area score labels a
+            # half-played board (the round-4 run trained 267
+            # iterations of value net exclusively on such labels —
+            # VERDICT r4 weak #2). Policy targets stay per-ply (the
+            # visit distribution is valid however the game ends).
+            livef = live_t.astype(jnp.float32) * finished
             lv = (livef * mse).sum() / batch
             # win-prediction accuracy (VERDICT r3 #7): the learning
             # signal the paper reports — live non-draw plies where
@@ -153,20 +159,20 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         return (vstep(states, actions_t, gd), grads_p, grads_v, stats)
 
     @jax.jit
-    def replay_segment(policy_params, value_params, winners, carry,
-                       actions, live, visits):
+    def replay_segment(policy_params, value_params, winners, finished,
+                       carry, actions, live, visits):
         # segment length rides the xs shapes (one compile per distinct
         # segment length — the fixed chunk plus at most one remainder)
         def body(c, xs):
-            return ply(policy_params, value_params, winners, c,
-                       xs), None
+            return ply(policy_params, value_params, winners, finished,
+                       c, xs), None
 
         carry, _ = lax.scan(body, carry, (actions, live, visits))
         return carry
 
     @jax.jit
     def apply_updates(state: ZeroState, grads_p, grads_v, stats,
-                      winners, num_moves, key):
+                      winners, finished, num_moves, key):
         up, opt_p = tx_policy.update(grads_p, state.opt_policy,
                                      state.policy_params)
         uv, opt_v = tx_value.update(grads_v, state.opt_value,
@@ -184,21 +190,36 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             "black_win_rate": (winners > 0).mean(),
             "draw_rate": (winners == 0).mean(),
             "mean_moves": num_moves.astype(jnp.float32).mean(),
+            # fraction of games that ended by two passes within the
+            # move limit; a low value means the move limit is starving
+            # the value net (its loss is masked to finished games)
+            "finished_rate": finished.mean(),
         }
         return ZeroState(
             optax.apply_updates(state.policy_params, up),
             optax.apply_updates(state.value_params, uv),
             opt_p, opt_v, state.iteration + 1, pack_rng(key)), metrics
 
-    def iteration(state: ZeroState):
+    def iteration(state: ZeroState, sp_policy_params=None,
+                  sp_value_params=None):
+        """One iteration. ``sp_*_params`` override which nets PLAY the
+        self-play games (the gated "best"/incumbent pair — AlphaGo's
+        evaluator discipline: the data generator only changes when a
+        candidate demonstrably beats it); gradients always update
+        ``state``'s candidate nets. Default: state's own nets play
+        (ungated self-play)."""
         key = unpack_rng(state.rng)
         key, game_key = jax.random.split(key)
 
         final, actions, live, visits = selfplay(
-            state.policy_params, state.value_params, game_key)
+            state.policy_params if sp_policy_params is None
+            else sp_policy_params,
+            state.value_params if sp_value_params is None
+            else sp_value_params, game_key)
         winners = jax.vmap(
             functools.partial(jaxgo.winner, cfg))(final)
         wf = winners.astype(jnp.float32)
+        finished = final.done.astype(jnp.float32)
 
         states = jaxgo.new_states(cfg, batch)
         if mesh is not None:
@@ -212,13 +233,13 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         for offset in range(0, plies, replay_chunk):
             sl = slice(offset, offset + replay_chunk)
             carry = replay_segment(
-                state.policy_params, state.value_params, wf, carry,
-                actions[sl], live_f[sl], visits[sl])
+                state.policy_params, state.value_params, wf, finished,
+                carry, actions[sl], live_f[sl], visits[sl])
         _, grads_p, grads_v, stats = carry
 
         num_moves = live.sum(axis=0, dtype=jnp.int32)
         return apply_updates(state, grads_p, grads_v, stats, winners,
-                             num_moves, key)
+                             finished, num_moves, key)
 
     return iteration
 
@@ -229,6 +250,136 @@ def init_zero_state(policy_params, value_params, tx_policy, tx_value,
                      tx_policy.init(policy_params),
                      tx_value.init(value_params),
                      jnp.int32(0), pack_rng(jax.random.key(seed)))
+
+
+class ZeroGate:
+    """Evaluator gating + best-pair pool for the zero loop.
+
+    Round-4 measured WHY this exists: ungated zero self-play cycles —
+    iteration 260 of the 267-iteration 9×9 run LOSES to iteration 80
+    raw 25–75 (``results/zero_scale_r4/strength_*.jsonl``; VERDICT r4
+    missing #5). The fix is the reference pipeline's own discipline
+    (AlphaGo's evaluator; the same past-self mechanism as
+    :class:`rocalphago_tpu.training.rl.OpponentPool`): self-play data
+    comes from the gated "best" pair, and a training candidate is
+    promoted to best only after beating the incumbent in an N-game
+    raw-policy match. Promoted pairs snapshot to ``out_dir/pool`` so
+    a resumed run keeps its incumbent and a strength ladder can be
+    replayed offline.
+
+    Matches are raw-policy (no search): cheap — a gate costs about
+    one search-free self-play batch — and it targets exactly the
+    regression round 4 measured, which was in *raw* strength (the
+    search-backed 260-vs-80 match was level at 4–4).
+
+    Multi-host: ``pool_dir`` must live on a filesystem shared by all
+    processes (the same requirement ``rl.OpponentPool`` documents).
+    Snapshots are written by the coordinator only (``write``); every
+    process replays identical match programs with identical keys, so
+    gate/promotion decisions agree — but resume and ladder sampling
+    READ the pool listing, which must therefore be the same
+    everywhere.
+    """
+
+    def __init__(self, cfg: jaxgo.GoConfig, features: tuple,
+                 policy_apply: Callable, pool_dir: str, games: int,
+                 threshold: float, temperature: float,
+                 move_limit: int, chunk: int = 20, write: bool = True):
+        from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+        if games % 2:
+            raise ValueError(f"gate games must be even, got {games}")
+        self.pool_dir = pool_dir
+        self.games = games
+        self.threshold = threshold
+        self.write = write
+        self._runner = make_selfplay_chunked(
+            cfg, features, policy_apply, policy_apply, games,
+            max_moves=move_limit, chunk=chunk,
+            temperature=temperature)
+
+    def match(self, params_a, params_b, key) -> dict:
+        """N games of A vs B (colors split half/half by the runner);
+        returns A's win rate over decided games plus the tally."""
+        import numpy as np
+
+        res = self._runner(params_a, params_b, key,
+                           stop_when_done=True)
+        w = np.asarray(jax.device_get(res.winners))
+        half = self.games // 2
+        wins_a = int((w[:half] > 0).sum() + (w[half:] < 0).sum())
+        draws = int((w == 0).sum())
+        decided = self.games - draws
+        return {"wins_a": wins_a, "wins_b": decided - wins_a,
+                "draws": draws,
+                "win_rate_a": wins_a / max(decided, 1)}
+
+    # ---- best-pair snapshots ------------------------------------
+
+    def _paths(self, iteration: int) -> tuple:
+        import os
+
+        return tuple(os.path.join(
+            self.pool_dir, f"best.{iteration:05d}.{kind}.msgpack")
+            for kind in ("policy", "value"))
+
+    def snapshots(self) -> list:
+        """Sorted ``(iteration, policy_path, value_path)`` triples."""
+        import glob
+        import os
+        import re
+
+        out = []
+        for p in sorted(glob.glob(os.path.join(
+                self.pool_dir, "best.*.policy.msgpack"))):
+            m = re.search(r"best\.(\d+)\.policy\.msgpack$", p)
+            v = p.replace(".policy.", ".value.")
+            if m and os.path.exists(v):
+                out.append((int(m.group(1)), p, v))
+        return out
+
+    def promote(self, policy_params, value_params,
+                iteration: int) -> None:
+        import os
+
+        if not self.write:
+            return
+        from flax import serialization
+
+        os.makedirs(self.pool_dir, exist_ok=True)
+        for path, params in zip(self._paths(iteration),
+                                (policy_params, value_params)):
+            with open(path, "wb") as f:
+                f.write(serialization.to_bytes(
+                    jax.device_get(params)))
+
+    def load(self, entry, policy_template, value_template) -> tuple:
+        from flax import serialization
+
+        _, ppath, vpath = entry
+        out = []
+        for path, template in ((ppath, policy_template),
+                               (vpath, value_template)):
+            with open(path, "rb") as f:
+                out.append(serialization.from_bytes(
+                    template, f.read()))
+        return tuple(out)
+
+    def sample(self, seed: int, iteration: int):
+        """Stateless uniform draw over the pool for ladder matches
+        (same (seed, iteration) discipline as ``OpponentPool``). The
+        LATEST snapshot — the current incumbent — is excluded: a
+        ladder probe exists to compare the incumbent against its
+        *past* selves, and best-vs-best is 64 games of noise. Returns
+        ``None`` until the pool has a past entry."""
+        import numpy as np
+
+        snaps = self.snapshots()[:-1]
+        if not snaps:
+            return None
+        rng = np.random.default_rng(
+            np.random.SeedSequence([seed, iteration]))
+        return snaps[rng.integers(len(snaps))]
 
 
 def run_training(argv=None) -> dict:
@@ -246,6 +397,7 @@ def run_training(argv=None) -> dict:
     coordinator-only artifact writes (Orbax saves participate on
     every process)."""
     import argparse
+    import dataclasses
     import json
     import os
     import sys
@@ -297,6 +449,26 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--num-devices", type=int, default=None,
                     help="mesh width (default: every device whose "
                          "count divides --game-batch)")
+    ap.add_argument("--komi", type=float, default=None,
+                    help="area-scoring komi (default: the board "
+                         "size's standard — 7.5 at 13x13+, 7.0 below;"
+                         " engine.jaxgo.default_komi)")
+    ap.add_argument("--no-gating", action="store_true",
+                    help="train WITHOUT the evaluator gate (round-4 "
+                         "evidence says this cycles: iter-260 lost "
+                         "25-75 raw to iter-80)")
+    ap.add_argument("--gate-every", type=int, default=0,
+                    help="iterations between candidate-vs-best gate "
+                         "matches (0 = --save-every)")
+    ap.add_argument("--gate-games", type=int, default=64,
+                    help="games per gate match (raw policy, colors "
+                         "split)")
+    ap.add_argument("--gate-threshold", type=float, default=0.55,
+                    help="decided-game win rate the candidate needs "
+                         "to be promoted to self-play duty")
+    ap.add_argument("--gate-temperature", type=float, default=1.0,
+                    help="sampling temperature for gate/ladder match "
+                         "play")
     a = ap.parse_args(argv)
     if a.gumbel and a.dirichlet_alpha > 0:
         raise SystemExit("--dirichlet-alpha is PUCT-mode root noise; "
@@ -313,6 +485,12 @@ def run_training(argv=None) -> dict:
             f"policy is {policy.board}x{policy.board} but value is "
             f"{value.board}x{value.board} — the nets must share a "
             "board size")
+    # scoring komi: per-board-size default (VERDICT r4 weak #2 — the
+    # nets' own GoConfig carries the 19x19 value whatever the board)
+    game_cfg = dataclasses.replace(
+        policy.cfg, komi=a.komi if a.komi is not None
+        else jaxgo.default_komi(policy.board))
+    a.komi = game_cfg.komi      # metadata records the resolved value
     # multi-host/multi-chip bring-up, same wiring as the sibling
     # trainers: DCN init (no-op single-process), a (data, model)
     # mesh, the game batch sharded over data, state replicated,
@@ -335,7 +513,7 @@ def run_training(argv=None) -> dict:
     tx_p = optax.sgd(a.learning_rate)
     tx_v = optax.sgd(a.learning_rate)
     iteration = make_zero_iteration(
-        policy.cfg, policy.feature_list, value.feature_list,
+        game_cfg, policy.feature_list, value.feature_list,
         policy.module.apply, value.module.apply, tx_p, tx_v,
         batch=a.game_batch, move_limit=a.move_limit, n_sim=a.sims,
         max_nodes=a.max_nodes or None,   # 0 = auto (CLI convention)
@@ -366,6 +544,35 @@ def run_training(argv=None) -> dict:
         metrics.log("resume", iteration=start)
     final = {}
 
+    # evaluator gating (VERDICT r4 missing #5): self-play data comes
+    # from the gated BEST pair; the trained candidate must beat it in
+    # a raw match to take over self-play duty
+    gate = None
+    best_p = best_v = None
+    gate_every = a.gate_every or a.save_every
+    if not a.no_gating:
+        gate = ZeroGate(
+            game_cfg, policy.feature_list, policy.module.apply,
+            os.path.join(a.out_dir, "pool"), games=a.gate_games,
+            threshold=a.gate_threshold,
+            temperature=a.gate_temperature, move_limit=a.move_limit,
+            write=coord)
+        snaps = gate.snapshots()
+        if restored is not None and snaps:
+            # a resumed run keeps its incumbent (the candidate in the
+            # checkpoint may be mid-losing-streak)
+            bp, bv = gate.load(snaps[-1], jax.device_get(
+                state.policy_params), jax.device_get(
+                state.value_params))
+            best_p = meshlib.replicate(mesh, bp)
+            best_v = meshlib.replicate(mesh, bv)
+            metrics.log("gate_resume", incumbent=snaps[-1][0])
+        else:
+            best_p, best_v = state.policy_params, state.value_params
+            if not snaps:
+                gate.promote(best_p, best_v, start)
+    gate_root = jax.random.key(a.seed ^ 0x9A7E)
+
     def export(it):
         if not coord:
             return
@@ -381,7 +588,7 @@ def run_training(argv=None) -> dict:
 
     for it in range(start, a.iterations):
         t0 = time.time()
-        state, m = iteration(state)
+        state, m = iteration(state, best_p, best_v)
         entry = {"iteration": it,
                  **{k: float(jax.device_get(v)) for k, v in m.items()},
                  "games_per_min": a.game_batch * 60.0
@@ -389,6 +596,28 @@ def run_training(argv=None) -> dict:
         metrics.log("iteration", **entry)
         meta.record_epoch(entry)
         final = entry
+        if gate and ((it + 1) % gate_every == 0
+                     or it + 1 == a.iterations):
+            gkey, lkey = jax.random.split(
+                jax.random.fold_in(gate_root, it))
+            r = gate.match(state.policy_params, best_p, gkey)
+            promoted = r["win_rate_a"] >= gate.threshold
+            if promoted:
+                best_p, best_v = (state.policy_params,
+                                  state.value_params)
+                gate.promote(best_p, best_v, it + 1)
+            metrics.log("gate", iteration=it, promoted=promoted, **r)
+            # ladder probe: the (possibly new) incumbent vs a sampled
+            # past best — the monotonicity evidence round 4 lacked
+            snap = gate.sample(a.seed, it)
+            if snap is not None:
+                lp, _ = gate.load(snap, jax.device_get(
+                    state.policy_params), jax.device_get(
+                    state.value_params))
+                lr = gate.match(best_p, meshlib.replicate(mesh, lp),
+                                lkey)
+                metrics.log("ladder", iteration=it,
+                            opponent=snap[0], **lr)
         if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
             ckpt.save(it + 1, jax.device_get(state))
             export(it + 1)

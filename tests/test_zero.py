@@ -35,18 +35,26 @@ def test_zero_iteration_trains_both_nets(nets):
     pol, val = nets
     cfg = GoConfig(size=SIZE)
     tx_p, tx_v = optax.sgd(0.01), optax.sgd(0.01)
+    # move_limit must cover natural 5x5 game length (~47 plies): the
+    # value loss is masked to games that END by two passes, so a
+    # too-small cap leaves the value net untrained (by design —
+    # capped-game area scores label half-played boards)
     iteration = make_zero_iteration(
         cfg, FEATS, VFEATS, pol.module.apply, val.module.apply,
-        tx_p, tx_v, batch=2, move_limit=40, n_sim=8, max_nodes=16,
+        tx_p, tx_v, batch=2, move_limit=60, n_sim=8, max_nodes=16,
         sim_chunk=4, replay_chunk=7)
     state = init_zero_state(pol.params, val.params, tx_p, tx_v, seed=3)
 
     new, metrics = iteration(state)
     assert int(jax.device_get(new.iteration)) == 1
     for key in ("policy_loss", "value_loss", "black_win_rate",
-                "draw_rate", "mean_moves", "value_mse", "value_acc"):
+                "draw_rate", "mean_moves", "value_mse", "value_acc",
+                "finished_rate"):
         assert np.isfinite(float(jax.device_get(metrics[key]))), key
     assert 0.0 <= float(jax.device_get(metrics["value_acc"])) <= 1.0
+    # 60 plies cover natural 5x5 endings — games must actually end
+    # (otherwise the masked value loss trains on nothing)
+    assert float(jax.device_get(metrics["finished_rate"])) > 0
 
     def delta(a, b):
         fa, _ = jax.flatten_util.ravel_pytree(jax.device_get(a))
@@ -96,6 +104,43 @@ def test_zero_cli_trains_saves_and_resumes(tmp_path, nets):
              .splitlines()]
     assert any(e["event"] == "resume" and e["iteration"] == 1
                for e in lines)
+    # evaluator gating ran (default-on): a gate match was logged and
+    # the pool holds the iteration-0 incumbent snapshot
+    gates = [e for e in lines if e["event"] == "gate"]
+    assert gates and all(0.0 <= g["win_rate_a"] <= 1.0 for g in gates)
+    assert (tmp_path / "out" / "pool"
+            / "best.00000.policy.msgpack").exists()
+
+
+def test_zero_gate_match_and_promotion(tmp_path, nets):
+    """ZeroGate mechanics: an even match reports a sane tally; a
+    promotion writes a loadable best-pair snapshot; sample() draws
+    from the pool statelessly."""
+    from rocalphago_tpu.training.zero import ZeroGate
+
+    pol, val = nets
+    cfg = GoConfig(size=SIZE, komi=7.0)
+    gate = ZeroGate(cfg, FEATS, pol.module.apply,
+                    str(tmp_path / "pool"), games=8, threshold=0.55,
+                    temperature=1.0, move_limit=60, chunk=20)
+    r = gate.match(pol.params, pol.params, jax.random.key(0))
+    assert r["wins_a"] + r["wins_b"] + r["draws"] == 8
+    assert 0.0 <= r["win_rate_a"] <= 1.0
+
+    gate.promote(pol.params, val.params, 3)
+    snaps = gate.snapshots()
+    assert [s[0] for s in snaps] == [3]
+    lp, lv = gate.load(snaps[0], pol.params, val.params)
+    flat0, _ = jax.flatten_util.ravel_pytree(pol.params)
+    flat1, _ = jax.flatten_util.ravel_pytree(lp)
+    np.testing.assert_array_equal(np.asarray(flat0),
+                                  np.asarray(flat1))
+    # the sole snapshot IS the incumbent — nothing past to ladder
+    assert gate.sample(7, 11) is None
+    gate.promote(pol.params, val.params, 5)
+    # with a past entry the draw is stateless and never the incumbent
+    assert gate.sample(7, 11) == gate.sample(7, 11)
+    assert gate.sample(7, 11)[0] == 3
 
 
 @pytest.mark.slow
@@ -108,7 +153,7 @@ def test_zero_iteration_gumbel_targets(nets):
     tx_p, tx_v = optax.sgd(0.01), optax.sgd(0.01)
     iteration = make_zero_iteration(
         cfg, FEATS, VFEATS, pol.module.apply, val.module.apply,
-        tx_p, tx_v, batch=2, move_limit=30, n_sim=8, max_nodes=16,
+        tx_p, tx_v, batch=2, move_limit=60, n_sim=8, max_nodes=16,
         sim_chunk=4, replay_chunk=8, gumbel=True)
     state = init_zero_state(pol.params, val.params, tx_p, tx_v,
                             seed=3)
