@@ -235,11 +235,16 @@ def bootstrap_ci(games, anchor=None, anchor_elo: float = 0.0,
         return vals[lo] + (vals[hi] - vals[lo]) * (i - lo)
 
     out = {}
+    # the honest-interval floor scales down with the REQUEST
+    # (ADVICE r4): a smoke-test n_boot=5 where all 5 resamples
+    # complete should yield (noisy) bounds, not silent nulls — the
+    # floor only nulls when resamples were LOST to anchor dropout
+    floor = min(10, n_boot)
     for name, vals in samples.items():
-        # completed < 10: too few surviving resamples for ANY honest
+        # below the floor: too few surviving resamples for ANY honest
         # interval — a "95% CI" from 1-2 points would carry the same
         # authority as a real one
-        if completed < 10 or len(vals) < completed / 2:
+        if completed < floor or len(vals) < completed / 2:
             out[name] = None
         else:
             out[name] = [round(pick(vals, pct[0]), 1),

@@ -122,8 +122,13 @@ class GTPEngine:
         self.state = pygo.GameState(size=self.size, komi=self.komi)
         self._undo_stack: list = []
         self._time_settings = None    # (main_s, byo_s, byo_stones)
-        self._time_left: dict = {}    # color -> (seconds, stones)
+        # color -> (seconds, stones, spent-at-report, genmoves-at-
+        # report): the trailing pair ages the report (ADVICE r4 —
+        # GTP does not require per-move time_left, so a one-shot
+        # report must decay as the engine spends its own time)
+        self._time_left: dict = {}
         self._time_spent: dict = {}   # color -> own-genmove seconds
+        self._genmoves: dict = {}     # color -> genmove count
         self._commands = sorted(
             m[4:] for m in dir(self) if m.startswith("cmd_"))
 
@@ -156,6 +161,7 @@ class GTPEngine:
         self._undo_stack.clear()
         self._time_left = {}      # fresh game, fresh clocks
         self._time_spent = {}
+        self._genmoves = {}
         reset_player(self.player)
 
     def _player_board(self):
@@ -260,6 +266,7 @@ class GTPEngine:
         finally:
             self._time_spent[color] = (self._time_spent.get(color, 0.0)
                                        + _time.monotonic() - t0)
+            self._genmoves[color] = self._genmoves.get(color, 0) + 1
         return move_to_vertex(move, self.size)
 
     def cmd_undo(self, args):
@@ -311,11 +318,18 @@ class GTPEngine:
         self._time_settings = (main, byo_t, byo_s)
         self._time_left = {}
         self._time_spent = {}     # a re-issued clock starts fresh
+        self._genmoves = {}
         return ""
 
     def cmd_time_left(self, args):
         color = parse_color(args[0])
-        self._time_left[color] = (float(args[1]), int(args[2]))
+        # snapshot our own spend/move counters so the report can be
+        # aged: a controller that reports once must not yield a
+        # frozen budget for the rest of the game (ADVICE r4)
+        self._time_left[color] = (
+            float(args[1]), int(args[2]),
+            self._time_spent.get(color, 0.0),
+            self._genmoves.get(color, 0))
         return ""
 
     def _est_moves_left(self) -> float:
@@ -331,20 +345,44 @@ class GTPEngine:
         the remaining period time splits evenly over the remaining
         period stones; in main time, the remaining clock splits over
         the estimated moves left."""
+        settings = self._time_settings
         left = self._time_left.get(color)
         if left is not None:
-            t, stones = left
+            t, stones, spent0, moves0 = left
+            # age the report by our own spend since it arrived
+            rem = t - (self._time_spent.get(color, 0.0) - spent0)
             if stones > 0:                     # canadian byo-yomi
-                return max(t, 0.0) / stones
-            return max(t, 0.0) / self._est_moves_left()
-        if self._time_settings is not None:
-            main, byo_t, byo_s = self._time_settings
+                # period stones also shrink by the moves we've made
+                # since the report; once the reported period is
+                # consumed (time or stones), the NEXT period refills
+                # at the settings rate — not a frozen 0.0 budget
+                made = self._genmoves.get(color, 0) - moves0
+                if rem > 0 and made < stones:
+                    return rem / (stones - made)
+                if settings is not None and settings[2] > 0:
+                    return settings[1] / settings[2]
+                return 0.0
+            if rem > 0:
+                return rem / self._est_moves_left()
+            # reported main time is exhausted: fall into byo-yomi if
+            # the settings define one
+            if settings is not None and settings[2] > 0:
+                return settings[1] / settings[2]
+            return 0.0
+        if settings is not None:
+            main, byo_t, byo_s = settings
             if main > 0:
                 # no time_left report: the engine must decrement its
                 # OWN clock — budgeting the full main time every move
                 # would plan several times the allotment over a game
                 rem = main - self._time_spent.get(color, 0.0)
-                return max(rem, 0.0) / self._est_moves_left()
+                if rem > 0:
+                    return rem / self._est_moves_left()
+                # main time self-exhausted (ADVICE r4): byo-yomi
+                # periods remain playable forever, not budget 0.0
+                if byo_s > 0:
+                    return byo_t / byo_s
+                return 0.0
             if byo_s > 0:
                 return byo_t / byo_s
         return None

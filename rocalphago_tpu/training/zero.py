@@ -68,6 +68,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                         temperature: float = 1.0,
                         sim_chunk: int = 8, replay_chunk: int = 10,
                         gumbel: bool = False, m_root: int = 16,
+                        gumbel_sample: bool = False,
                         dirichlet_alpha: float = 0.0,
                         noise_frac: float = 0.25, mesh=None):
     """``(ZeroState) -> (ZeroState, metrics)`` — one full iteration:
@@ -81,6 +82,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         value_apply, batch, move_limit, n_sim, max_nodes,
         temperature=temperature, sim_chunk=sim_chunk,
         record_visits=True, gumbel=gumbel, m_root=m_root,
+        gumbel_sample=gumbel_sample,
         dirichlet_alpha=dirichlet_alpha, noise_frac=noise_frac,
         mesh=mesh)
 
@@ -440,6 +442,12 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--m-root", type=int, default=16,
                     help="gumbel root candidate count (top-k of the "
                          "gumbel-perturbed logits)")
+    ap.add_argument("--gumbel-sample-moves", action="store_true",
+                    help="with --gumbel: SAMPLE each move from the "
+                         "improved policy (temperature applies) "
+                         "instead of playing the halving winner — "
+                         "decouples the pi' target from the play "
+                         "distribution (VERDICT r4 #9 experiment)")
     ap.add_argument("--dirichlet-alpha", type=float, default=0.0,
                     help="AlphaZero root-noise Dir(α) for PUCT "
                          "self-play (0 = off; paper: 0.03 on 19x19; "
@@ -473,9 +481,12 @@ def run_training(argv=None) -> dict:
     if a.gumbel and a.dirichlet_alpha > 0:
         raise SystemExit("--dirichlet-alpha is PUCT-mode root noise; "
                          "--gumbel explores via the gumbel draw")
-    if a.gumbel and a.temperature != 1.0:
+    if a.gumbel_sample_moves and not a.gumbel:
+        raise SystemExit("--gumbel-sample-moves requires --gumbel")
+    if a.gumbel and a.temperature != 1.0 and not a.gumbel_sample_moves:
         print("zero: --temperature is ignored with --gumbel (the "
-              "per-ply gumbel draw is the exploration)",
+              "per-ply gumbel draw is the exploration; with "
+              "--gumbel-sample-moves it applies to the pi' draw)",
               file=sys.stderr)
 
     policy = NeuralNetBase.load_model(a.policy_json)
@@ -519,7 +530,8 @@ def run_training(argv=None) -> dict:
         max_nodes=a.max_nodes or None,   # 0 = auto (CLI convention)
         temperature=a.temperature, sim_chunk=a.sim_chunk,
         replay_chunk=a.replay_chunk, gumbel=a.gumbel,
-        m_root=a.m_root, dirichlet_alpha=a.dirichlet_alpha,
+        m_root=a.m_root, gumbel_sample=a.gumbel_sample_moves,
+        dirichlet_alpha=a.dirichlet_alpha,
         noise_frac=a.noise_frac, mesh=mesh)
     state = meshlib.replicate(mesh, init_zero_state(
         policy.params, value.params, tx_p, tx_v, seed=a.seed))

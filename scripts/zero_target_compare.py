@@ -49,10 +49,26 @@ def run_one(mode: str, a, out_dir: str) -> list[dict]:
             "--save-every", str(max(a.iterations, 1))]
     if mode == "gumbel":
         args += ["--gumbel", "--m-root", str(a.m_root)]
+    elif mode == "gumbel_sample":
+        # VERDICT r4 #9: pi' targets with the play distribution
+        # decoupled from the halving winner (moves sampled from pi')
+        args += ["--gumbel", "--m-root", str(a.m_root),
+                 "--gumbel-sample-moves"]
     else:
         args += ["--dirichlet-alpha", str(a.dirichlet_alpha)]
     t0 = time.time()
-    proc = subprocess.run(args, capture_output=True, text=True)
+    # bound the wait (ADVICE r4): a wedged trainer (device hang) must
+    # not block the paired comparison forever. Budget generously from
+    # the requested work — 90s per iteration covers the slowest
+    # observed CPU iteration several times over — plus compile slack.
+    timeout_s = 600 + 90 * a.iterations
+    try:
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"{mode} run exceeded {timeout_s}s — trainer wedged? "
+            f"Partial metrics (if any) are in {out_dir}")
     if proc.returncode != 0:
         raise SystemExit(
             f"{mode} run failed rc={proc.returncode}:\n"
@@ -82,13 +98,30 @@ def main(argv=None) -> int:
     ap.add_argument("--m-root", type=int, default=8)
     ap.add_argument("--dirichlet-alpha", type=float, default=0.15)
     ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--modes", nargs="+",
+                    default=["puct", "gumbel"],
+                    choices=["puct", "gumbel", "gumbel_sample"],
+                    help="trainer modes to pair (gumbel_sample = "
+                         "pi' targets + moves sampled from pi'; "
+                         "VERDICT r4 #9)")
     a = ap.parse_args(argv)
 
     os.makedirs(a.out_dir, exist_ok=True)
     results = {}
-    for mode in ("puct", "gumbel"):
-        results[mode] = run_one(mode, a,
-                                os.path.join(a.out_dir, mode))
+    for mode in a.modes:
+        try:
+            results[mode] = run_one(mode, a,
+                                    os.path.join(a.out_dir, mode))
+        except SystemExit:
+            # emit whatever the OTHER mode already banked before
+            # dying — a half comparison beats none (ADVICE r4)
+            if results:
+                partial = os.path.join(a.out_dir, "partial.json")
+                with open(partial, "w") as f:
+                    json.dump(results, f, indent=2)
+                print(f"wrote {partial} (completed modes only)",
+                      file=sys.stderr)
+            raise
 
     def ce_first_last(rows):
         ce = [r["policy_loss"] for r in rows]
@@ -103,11 +136,10 @@ def main(argv=None) -> int:
         "config": {k: getattr(a, k) for k in (
             "policy_json", "value_json", "iterations", "game_batch",
             "sims", "move_limit", "m_root", "dirichlet_alpha",
-            "seed")},
-        "puct": results["puct"],
-        "gumbel": results["gumbel"],
+            "seed", "modes")},
+        **results,
         "policy_ce": {m: ce_first_last(results[m])
-                      for m in ("puct", "gumbel")},
+                      for m in a.modes},
     }
     path = os.path.join(a.out_dir, "comparison.json")
     with open(path, "w") as f:

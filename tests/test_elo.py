@@ -90,6 +90,17 @@ def test_bootstrap_ci_brackets_the_point_estimate():
     assert ci["B"] == [0.0, 0.0]         # the anchor is pinned
 
 
+def test_bootstrap_small_n_boot_still_brackets():
+    """ADVICE r4: a smoke-test n_boot below the old hardcoded floor
+    of 10 must yield (noisy) bounds when every resample completes,
+    not silent nulls."""
+    games = [g("A", "B", "A")] * 12 + [g("B", "A", "B")] * 4
+    ci = elo.bootstrap_ci(games, anchor="B", n_boot=5, seed=2)
+    assert ci["A"] is not None
+    lo, hi = ci["A"]
+    assert lo <= hi
+
+
 def test_bootstrap_cli_flag(tmp_path, capsys):
     log = tmp_path / "t.jsonl"
     log.write_text("\n".join(

@@ -355,3 +355,60 @@ def test_main_time_self_decrements():
     # genmove accounts its own wall time against the mover's clock
     ok(eng, "genmove w")
     assert eng._time_spent[pygo.WHITE] > 0.0
+
+
+def test_exhausted_main_falls_into_byo_yomi():
+    """ADVICE r4: once the self-decrementing main-time ledger runs
+    out, remaining byo-yomi periods must set the budget — not a
+    permanent 0.0 (minimum-strength searches forever)."""
+    eng = GTPEngine(ClockedPlayer())
+    ok(eng, "boardsize 9")
+    ok(eng, "clear_board")
+    ok(eng, "time_settings 100 30 5")        # canadian: 30s/5 stones
+    eng._time_spent[pygo.BLACK] = 150.0       # main exhausted
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(6.0)
+    # absolute time (no byo periods) still floors at 0
+    ok(eng, "time_settings 100 0 0")
+    eng._time_spent[pygo.BLACK] = 150.0
+    assert eng._move_budget_s(pygo.BLACK) == 0.0
+    # a reported-exhausted main (time_left ... 0 stones=0) falls into
+    # byo-yomi from the report path too
+    ok(eng, "time_settings 100 30 5")
+    ok(eng, "time_left b 0 0")
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(6.0)
+
+
+def test_time_left_report_ages():
+    """ADVICE r4: a one-shot time_left report must decay as the
+    engine spends its own time — not freeze the budget for the rest
+    of the game."""
+    eng = GTPEngine(ClockedPlayer())
+    ok(eng, "boardsize 9")
+    ok(eng, "clear_board")
+    ok(eng, "time_settings 300 0 0")
+    # canadian report: 30s / 5 stones → 6s now
+    ok(eng, "time_left w 30 5")
+    assert eng._move_budget_s(pygo.WHITE) == pytest.approx(6.0)
+    # the engine then spends 12s over 2 of those moves: the report
+    # ages to 18s / 3 stones
+    eng._time_spent[pygo.WHITE] = (
+        eng._time_spent.get(pygo.WHITE, 0.0) + 12.0)
+    eng._genmoves[pygo.WHITE] = eng._genmoves.get(pygo.WHITE, 0) + 2
+    assert eng._move_budget_s(pygo.WHITE) == pytest.approx(18.0 / 3)
+    # consuming the reported period (stones OR time) rolls into a
+    # fresh settings-rate period, not a frozen 0.0 budget
+    ok(eng, "time_settings 300 30 5")
+    ok(eng, "time_left w 30 5")
+    eng._genmoves[pygo.WHITE] = (             # period stones played
+        eng._genmoves.get(pygo.WHITE, 0) + 5)
+    assert eng._move_budget_s(pygo.WHITE) == pytest.approx(6.0)
+    ok(eng, "time_left w 30 5")
+    eng._time_spent[pygo.WHITE] = (           # period time spent
+        eng._time_spent.get(pygo.WHITE, 0.0) + 30.0)
+    assert eng._move_budget_s(pygo.WHITE) == pytest.approx(6.0)
+    # main-time report ages the same way
+    ok(eng, "time_left b 100 0")
+    eng._time_spent[pygo.BLACK] = (
+        eng._time_spent.get(pygo.BLACK, 0.0) + 40.0)
+    est = max(10.0, (0.75 * 81 - eng.state.turns_played) / 2.0)
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(60.0 / est)
