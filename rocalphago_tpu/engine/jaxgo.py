@@ -169,11 +169,12 @@ def _dense_engine() -> bool:
 
     On TPU, scatter-adds with colliding indices and `[N,4]` index
     gathers serialize, while broadcast compares, 2-D grid shifts and
-    small matmuls run at full vector/MXU width — measured round 3: the
-    scatter engine's batch-1024 TPU rate (8.3k steps/s) barely beat the
-    CPU backend (6.1k), the signature of a scatter-bound program. On
-    CPU the scatter path wins (1444 cheap serial updates beat 131k-cell
-    dense compares), so the default follows the backend platform.
+    small matmuls run at full vector/MXU width — measured round 5's
+    on-chip A/B (batch 1024, 19x19, `benchmarks/tpu_hunt2_r5`): dense
+    17,762 steps/s vs scatter 10,558 — dense wins 1.68x, so it is the
+    TPU default by measurement. On CPU the scatter path wins (1444
+    cheap serial updates beat 131k-cell dense compares), so the
+    default follows the backend platform.
 
     Read once per process (trace-time; cached): override with
     ``ROCALPHAGO_ENGINE_DENSE=0/1`` **before the first engine trace**

@@ -353,14 +353,19 @@ class GTPEngine:
             rem = t - (self._time_spent.get(color, 0.0) - spent0)
             if stones > 0:                     # canadian byo-yomi
                 # period stones also shrink by the moves we've made
-                # since the report; once the reported period is
-                # consumed (time or stones), the NEXT period refills
-                # at the settings rate — not a frozen 0.0 budget
+                # since the report
                 made = self._genmoves.get(color, 0) - moves0
                 if rem > 0 and made < stones:
                     return rem / (stones - made)
-                if settings is not None and settings[2] > 0:
-                    return settings[1] / settings[2]
+                if made >= stones:
+                    # all reported stones played: a NEW period began,
+                    # refilled at the settings rate — not a frozen
+                    # 0.0 budget
+                    if settings is not None and settings[2] > 0:
+                        return settings[1] / settings[2]
+                # rem <= 0 with stones still owed: by our own ledger
+                # the period flag has fallen — refilling here would
+                # search on lost time, so play out at minimum budget
                 return 0.0
             if rem > 0:
                 return rem / self._est_moves_left()

@@ -957,21 +957,6 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         return vstep(states, best), best, live
 
     @jax.jit
-    def pick_from_pi(states: GoState, pi, rng):
-        """``gumbel_sample`` move rule (VERDICT r4 #9 experiment):
-        sample the move from the improved policy π' instead of
-        playing the halving winner. Decouples the TRAINING target
-        (still π') from the PLAY distribution — the round-4
-        π'-vs-visits rerun measured play-the-winner narrowing the
-        game distribution off the value manifold
-        (``results/zero_scale_r4/target_compare``); this mode keeps
-        the π' target while restoring PUCT-style stochastic play."""
-        rng, sub = jax.random.split(rng)
-        action = sample_weighted(pi, sub)
-        live = ~states.done
-        return vstep(states, action), rng, action, live
-
-    @jax.jit
     def add_root_noise(tree: DeviceTree, rng):
         """AlphaZero root exploration: mix Dir(α) into the root
         priors over the prior-supported actions."""
@@ -1009,7 +994,15 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                 visits, _, best, pi = search.run_chunked(
                     params_p, params_v, states, sub, sim_chunk)
                 if gumbel_sample:
-                    states, rng, action, live = pick_from_pi(
+                    # ``gumbel_sample`` move rule (VERDICT r4 #9
+                    # experiment): sample the move from the improved
+                    # policy π' instead of playing the halving
+                    # winner — keeps the π' TRAINING target while
+                    # restoring PUCT-style stochastic play (the
+                    # round-4 rerun measured play-the-winner
+                    # narrowing the game distribution off the value
+                    # manifold, results/zero_scale_r4/target_compare)
+                    states, rng, action, live = pick_and_step(
                         states, pi, rng)
                 else:
                     states, action, live = step_best(states, best)

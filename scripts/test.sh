@@ -8,5 +8,17 @@
 # fake-device XLA flag for harnesses that invoke pytest directly.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# Default tier excludes @pytest.mark.slow (multi-minute trainer/e2e
+# tests) to keep the edit-test loop under 5 minutes; `--all` (or any
+# explicit -m) runs the full suite, which CI should do nightly.
+ARGS=()
+TIER=(-m "not slow")
+for a in "$@"; do
+    case "$a" in
+        --all) TIER=() ;;
+        -m)    TIER=(); ARGS+=("$a") ;;
+        *)     ARGS+=("$a") ;;
+    esac
+done
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-    python -m pytest tests/ "$@"
+    python -m pytest tests/ ${TIER[@]+"${TIER[@]}"} ${ARGS[@]+"${ARGS[@]}"}

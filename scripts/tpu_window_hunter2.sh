@@ -102,7 +102,8 @@ make_specs() {
 make_specs
 
 STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
-preprocess chase_xla chase_pls devmcts9 devmcts_gumbel selfplay16 \
+preprocess chase_xla chase_pls ladder1 ladder2 ladder4 ladder8 \
+devmcts9 devmcts_gumbel selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
 train_trace preprocess_trace tournament headline_sized headline"
 n_steps=$(echo $STEPS | wc -w)
@@ -137,6 +138,10 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             preprocess)  run preprocess  python benchmarks/bench_preprocess.py --reps 2 ;;
             chase_xla)   run chase_xla   python benchmarks/bench_chase.py --reps 2 ;;
             chase_pls)   run chase_pls   env ROCALPHAGO_PALLAS_CHASE=1 python benchmarks/bench_chase.py --reps 2 ;;
+            ladder1)     run ladder1     env ROCALPHAGO_LADDER_PHASE1=1 python benchmarks/bench_preprocess.py --reps 2 ;;
+            ladder2)     run ladder2     env ROCALPHAGO_LADDER_PHASE1=2 python benchmarks/bench_preprocess.py --reps 2 ;;
+            ladder4)     run ladder4     env ROCALPHAGO_LADDER_PHASE1=4 python benchmarks/bench_preprocess.py --reps 2 ;;
+            ladder8)     run ladder8     env ROCALPHAGO_LADDER_PHASE1=8 python benchmarks/bench_preprocess.py --reps 2 ;;
             devmcts9)    run devmcts9    python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
             devmcts_gumbel) run devmcts_gumbel python benchmarks/bench_device_mcts.py --board 9 --sims 32 --gumbel --reps 2 ;;
             bisect)      run bisect      python scripts/tpu_crash_bisect.py --log "$LOG/bisect.jsonl" ;;

@@ -43,6 +43,7 @@ def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
     assert rec["vs_baseline"] is None
 
 
+@pytest.mark.slow
 def test_fixed_override_ignored_off_tpu(monkeypatch):
     """_GRAFT_BENCH_FIXED must not leak into a CPU child: a TPU-sized
     batch on host would blow the liveness fallback's budget."""

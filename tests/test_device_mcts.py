@@ -106,6 +106,7 @@ def test_capacity_bound_keeps_searching():
     np.testing.assert_array_equal(visits.sum(axis=1), 24)
 
 
+@pytest.mark.slow
 def test_mcts_selfplay_plays_full_games():
     """Search-driven self-play on 5×5: games end by two passes within
     the move budget, recorded actions are within range, and the live

@@ -289,6 +289,7 @@ class TestLadderDifferential:
             f"dense-board ladder disagreement {rate:.2%} (bound 1%)")
 
 
+@pytest.mark.slow
 class TestLadderOverflow:
     """Adversarial ``chase_slots`` overflow (VERDICT r2 weak #6): a
     crafted board with MORE simultaneous live ladder chases than the
@@ -390,6 +391,7 @@ class TestAPI:
         assert la[2, 2, 3] == 1.0   # center stone: 4 libs
 
 
+@pytest.mark.slow
 class TestTwoPhaseChaseEquivalence:
     """The two-phase chase schedule (round 4) must be BIT-IDENTICAL
     to the single lockstep chase: phase 2 resumes each capped lane

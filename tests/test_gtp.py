@@ -395,17 +395,19 @@ def test_time_left_report_ages():
         eng._time_spent.get(pygo.WHITE, 0.0) + 12.0)
     eng._genmoves[pygo.WHITE] = eng._genmoves.get(pygo.WHITE, 0) + 2
     assert eng._move_budget_s(pygo.WHITE) == pytest.approx(18.0 / 3)
-    # consuming the reported period (stones OR time) rolls into a
-    # fresh settings-rate period, not a frozen 0.0 budget
+    # playing out the reported period's STONES rolls into a fresh
+    # settings-rate period, not a frozen 0.0 budget
     ok(eng, "time_settings 300 30 5")
     ok(eng, "time_left w 30 5")
     eng._genmoves[pygo.WHITE] = (             # period stones played
         eng._genmoves.get(pygo.WHITE, 0) + 5)
     assert eng._move_budget_s(pygo.WHITE) == pytest.approx(6.0)
+    # but exhausting the period TIME with stones still owed is a
+    # fallen flag under canadian rules — no refill, minimum budget
     ok(eng, "time_left w 30 5")
     eng._time_spent[pygo.WHITE] = (           # period time spent
         eng._time_spent.get(pygo.WHITE, 0.0) + 30.0)
-    assert eng._move_budget_s(pygo.WHITE) == pytest.approx(6.0)
+    assert eng._move_budget_s(pygo.WHITE) == 0.0
     # main-time report ages the same way
     ok(eng, "time_left b 100 0")
     eng._time_spent[pygo.BLACK] = (

@@ -129,6 +129,7 @@ def test_pallas_chase_under_vmap_matches_unbatched():
         np.asarray(batched).reshape(-1), want)
 
 
+@pytest.mark.slow
 def test_pallas_chase_disabled_lane_is_false():
     boards, labels, preys = chase_lanes(seed=5, positions=4)
     zeros = np.zeros((len(preys), N), bool)
@@ -138,6 +139,7 @@ def test_pallas_chase_disabled_lane_is_false():
     assert not got.any()
 
 
+@pytest.mark.slow
 def test_chase_impl_flag_produces_identical_planes(monkeypatch):
     """The ROCALPHAGO_PALLAS_CHASE=interpret path must yield the exact
     same ladder planes as the default XLA chase (plane-level wiring of

@@ -123,6 +123,7 @@ def test_replay_gradient_matches_direct_grad(net):
     assert 0.0 <= float(metrics["win_rate"]) <= 1.0
 
 
+@pytest.mark.slow
 def test_chunked_iteration_is_bit_identical(net):
     """The watchdog-safe chunked iteration (game segments + replay
     segments driven from host) must produce EXACTLY the monolithic
@@ -154,6 +155,7 @@ def test_chunked_iteration_is_bit_identical(net):
             np.asarray(jax.device_get(metrics_c[k])), err_msg=k)
 
 
+@pytest.mark.slow
 def test_chunked_iteration_sharded_matches_unsharded(net):
     """The chunked iteration with the game batch sharded over the
     8-virtual-device mesh's data axis must match the unsharded chunked

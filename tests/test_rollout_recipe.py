@@ -43,6 +43,7 @@ def test_converter_emits_rollout_planes(rollout_corpus):
     assert manifest["shard_counts"]
 
 
+@pytest.mark.slow
 def test_rollout_net_trains_and_drives_mcts(rollout_corpus, tmp_path):
     out = tmp_path / "out"
     net = CNNRollout(board=SIZE, filters=8)

@@ -88,6 +88,7 @@ def test_selfplay_deterministic_given_key(policy):
                                   np.asarray(b.actions))
 
 
+@pytest.mark.slow
 def test_chunked_selfplay_bit_identical(policy):
     """The chunked runner (TPU watchdog workaround) must reproduce the
     monolithic scan exactly — including a non-divisible remainder
@@ -111,6 +112,7 @@ def test_chunked_selfplay_bit_identical(policy):
                                   np.asarray(b.num_moves))
 
 
+@pytest.mark.slow
 def test_sharded_selfplay_bit_identical_and_distributed(policy):
     """Game-batch sharding over the mesh's data axis (env parallelism
     across devices, SURVEY.md §2b) must not change a single move, and

@@ -31,6 +31,7 @@ def nets():
     return pol, val
 
 
+@pytest.mark.slow
 def test_zero_iteration_trains_both_nets(nets):
     pol, val = nets
     cfg = GoConfig(size=SIZE)
@@ -71,6 +72,7 @@ def test_zero_iteration_trains_both_nets(nets):
                               np.asarray(newer.rng))
 
 
+@pytest.mark.slow
 def test_zero_cli_trains_saves_and_resumes(tmp_path, nets):
     """The trainer CLI end to end on tiny specs: metrics written,
     GTP-loadable exports, and a rerun with a higher --iterations
