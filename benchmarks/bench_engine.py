@@ -37,9 +37,12 @@ def main() -> None:
         states = random_game_states(cfg, batch, args.moves, sub)
         return jax.device_get(states.step_count)
 
+    from rocalphago_tpu.engine.jaxgo import _dense_engine
+
     dt = timed(once, reps=args.reps, profile_dir=args.profile)
     report("engine_steps", batch * args.moves / dt, "steps/s",
-           batch=batch, board=args.board)
+           batch=batch, board=args.board,
+           formulation="dense" if _dense_engine() else "scatter")
 
 
 if __name__ == "__main__":

@@ -357,15 +357,28 @@ class GTPEngine:
                 made = self._genmoves.get(color, 0) - moves0
                 if rem > 0 and made < stones:
                     return rem / (stones - made)
-                if made >= stones:
-                    # all reported stones played: a NEW period began,
-                    # refilled at the settings rate — not a frozen
-                    # 0.0 budget
+                if rem > 0 and made >= stones:
+                    # all reported stones played WITH time to spare:
+                    # a NEW period legitimately began. REBASE the
+                    # cached report to a synthetic fresh period at
+                    # the settings rate so its own aging starts now —
+                    # without this the old report's rem eventually
+                    # goes negative mid-new-period and would read as
+                    # a fallen flag.
                     if settings is not None and settings[2] > 0:
-                        return settings[1] / settings[2]
-                # rem <= 0 with stones still owed: by our own ledger
-                # the period flag has fallen — refilling here would
-                # search on lost time, so play out at minimum budget
+                        byo_t, byo_s = settings[1], settings[2]
+                        self._time_left[color] = (
+                            byo_t, byo_s,
+                            self._time_spent.get(color, 0.0),
+                            self._genmoves.get(color, 0))
+                        return byo_t / byo_s
+                # rem <= 0: by our own ledger the period flag has
+                # fallen (time ran out with stones owed, or stones
+                # completed only after the time was gone) — refilling
+                # would search on lost time, so play out at minimum
+                # budget until the controller's next time_left report
+                # replaces this ledger. Sticky by design: blitzing
+                # out the owed stones must NOT re-arm the clock.
                 return 0.0
             if rem > 0:
                 return rem / self._est_moves_left()

@@ -9,15 +9,18 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 # Default tier excludes @pytest.mark.slow (multi-minute trainer/e2e
-# tests) to keep the edit-test loop under 5 minutes; `--all` (or any
-# explicit -m) runs the full suite, which CI should do nightly.
+# tests) to keep the edit-test loop under 5 minutes; `--all`, an
+# explicit -m, or an exact ::node-id selection runs without the tier
+# filter (so naming one slow test runs it). CI should run --all
+# nightly.
 ARGS=()
 TIER=(-m "not slow")
 for a in "$@"; do
     case "$a" in
-        --all) TIER=() ;;
-        -m)    TIER=(); ARGS+=("$a") ;;
-        *)     ARGS+=("$a") ;;
+        --all)  TIER=() ;;
+        -m)     TIER=(); ARGS+=("$a") ;;
+        *::*)   TIER=(); ARGS+=("$a") ;;
+        *)      ARGS+=("$a") ;;
     esac
 done
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

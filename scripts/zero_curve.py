@@ -21,9 +21,10 @@ import os
 import sys
 
 
-def load(run_dir: str) -> list[dict]:
+def load(run_dir: str) -> dict[str, list[dict]]:
+    """One pass over metrics.jsonl → rows bucketed by event type."""
     path = os.path.join(run_dir, "metrics.jsonl")
-    rows = []
+    by_event: dict[str, list[dict]] = {}
     try:
         with open(path) as f:
             for line in f:
@@ -31,11 +32,11 @@ def load(run_dir: str) -> list[dict]:
                     r = json.loads(line)
                 except ValueError:
                     continue
-                if r.get("event") == "iteration":
-                    rows.append(r)
+                if isinstance(r.get("event"), str):
+                    by_event.setdefault(r["event"], []).append(r)
     except OSError as e:
         raise SystemExit(f"cannot read {path}: {e}")
-    return rows
+    return by_event
 
 
 def curve(rows, key, window):
@@ -59,7 +60,8 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None,
                     help="also write the summary JSON here")
     a = ap.parse_args(argv)
-    rows = load(a.run_dir)
+    by_event = load(a.run_dir)
+    rows = by_event.get("iteration", [])
     if not rows:
         raise SystemExit(f"no iteration records in {a.run_dir}")
 
@@ -75,10 +77,34 @@ def main(argv=None) -> int:
     except (OSError, ValueError):
         pass
     for key in ("value_acc", "value_mse", "policy_loss",
-                "black_win_rate", "mean_moves"):
+                "black_win_rate", "mean_moves", "finished_rate"):
         c = curve(rows, key, a.window)
         if c is not None:
             summary["curves"][key] = c
+
+    # evaluator-gate evidence (round-5 gated runs): promotion history
+    # and the incumbent-vs-sampled-past ladder probes — the
+    # monotonicity story VERDICT r4 #2 asked for, machine-readable
+    gates = by_event.get("gate", [])
+    ladders = by_event.get("ladder", [])
+    if gates:
+        summary["gate"] = {
+            "matches": len(gates),
+            "promotions": sum(bool(g.get("promoted")) for g in gates),
+            "last": {k: gates[-1].get(k) for k in (
+                "iteration", "promoted", "win_rate_a")},
+        }
+    if ladders:
+        wins = [l for l in ladders
+                if l.get("win_rate_a", 0.0) >= 0.5]
+        summary["ladder"] = {
+            "probes": len(ladders),
+            "incumbent_wins": len(wins),
+            "monotone_fraction": round(len(wins) / len(ladders), 4),
+            "probe_rows": [{k: l.get(k) for k in (
+                "iteration", "opponent", "win_rate_a")}
+                for l in ladders],
+        }
 
     acc = summary["curves"].get("value_acc")
     if acc:

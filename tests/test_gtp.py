@@ -408,6 +408,14 @@ def test_time_left_report_ages():
     eng._time_spent[pygo.WHITE] = (           # period time spent
         eng._time_spent.get(pygo.WHITE, 0.0) + 30.0)
     assert eng._move_budget_s(pygo.WHITE) == 0.0
+    # ...and STAYS fallen: blitzing out the owed stones must not
+    # re-arm the clock to a fresh settings-rate period
+    eng._genmoves[pygo.WHITE] = (
+        eng._genmoves.get(pygo.WHITE, 0) + 5)
+    assert eng._move_budget_s(pygo.WHITE) == 0.0
+    # only a fresh controller report revives the budget
+    ok(eng, "time_left w 30 5")
+    assert eng._move_budget_s(pygo.WHITE) == pytest.approx(6.0)
     # main-time report ages the same way
     ok(eng, "time_left b 100 0")
     eng._time_spent[pygo.BLACK] = (
