@@ -1,0 +1,103 @@
+"""End-of-run strength ladder over a gated zero run's promotion pool.
+
+The in-run ladder probes (``metrics.jsonl`` ``event: ladder``) sample
+ONE past snapshot per gate; this script plays the full table instead:
+the LAST promoted pair against every earlier pool snapshot,
+raw-policy stochastic sampling (the round-4 failure mode's exact
+measurement — ``results/zero_scale_r4/strength_*.jsonl`` showed
+iteration-260 losing 25–75 to iteration-80 raw when trained WITHOUT a
+gate; VERDICT r4 #2 asks the gated rerun to show this monotone).
+
+Each pool snapshot ``best.NNNNN.policy.msgpack`` gets a sibling spec
+JSON (same architecture as --spec) so ``interface.tournament`` can
+load it, then the matches run through the tournament CLI's machinery
+in-process.
+
+Usage::
+
+    python scripts/zero_ladder_matches.py results/zero_r5/run \
+        --spec results/zero_r5/zp9.json --games 64 \
+        --out results/zero_r5/ladder_final.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def pool_snapshots(run_dir: str) -> list[tuple[int, str]]:
+    pool = os.path.join(run_dir, "pool")
+    out = []
+    for name in sorted(os.listdir(pool)):
+        m = re.fullmatch(r"best\.(\d+)\.policy\.msgpack", name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(pool, name)))
+    return out
+
+
+def write_spec(spec_path: str, weights: str) -> str:
+    """Sibling spec JSON pointing at one pool snapshot's weights."""
+    with open(spec_path) as f:
+        spec = json.load(f)
+    spec["weights_file"] = os.path.basename(weights)
+    out = weights.replace(".policy.msgpack", ".policy.json")
+    with open(out, "w") as f:
+        json.dump(spec, f)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir")
+    ap.add_argument("--spec", required=True,
+                    help="policy spec JSON matching the pool's arch")
+    ap.add_argument("--games", type=int, default=64)
+    ap.add_argument("--board", type=int, default=9)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--move-limit", type=int, default=240)
+    ap.add_argument("--out", default=None)
+    a = ap.parse_args(argv)
+
+    snaps = pool_snapshots(a.run_dir)
+    if len(snaps) < 2:
+        raise SystemExit(f"need >=2 pool snapshots, found {len(snaps)}")
+    specs = {it: write_spec(a.spec, w) for it, w in snaps}
+    last_it = snaps[-1][0]
+
+    from rocalphago_tpu.interface import tournament
+
+    rows = []
+    for it, _ in snaps[:-1]:
+        r = tournament.main([
+            f"probabilistic:{specs[last_it]}",
+            f"probabilistic:{specs[it]}",
+            "--games", str(a.games), "--board", str(a.board),
+            "--temperature", str(a.temperature),
+            "--move-limit", str(a.move_limit)])
+        rows.append({"incumbent": last_it, "opponent": it,
+                     "incumbent_win_rate": r["win_rate_a"],
+                     "wins": r["wins"]})
+        print(json.dumps(rows[-1]), flush=True)
+
+    result = {
+        "run_dir": a.run_dir, "games_per_match": a.games,
+        "final_snapshot": last_it,
+        "matches": rows,
+        "monotone": all(r["incumbent_win_rate"] >= 0.5 for r in rows),
+    }
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
