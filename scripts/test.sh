@@ -23,5 +23,9 @@ for a in "$@"; do
         *)      ARGS+=("$a") ;;
     esac
 done
+# (JAX 0.9 CPU backend does not serialize executables to the
+# persistent compilation cache — measured no-op here — so the tier's
+# floor is genuine compile time: ~200 tests averaging ~2s, no single
+# test over ~13s.)
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest tests/ ${TIER[@]+"${TIER[@]}"} ${ARGS[@]+"${ARGS[@]}"}
