@@ -58,13 +58,13 @@ def _self_size_from_results():
         return None
     today = time.strftime("%Y-%m-%d")
     best = None     # (plies_per_s, batch)
+    # tolerant reader: the hunter writes this log from runs the TPU
+    # tunnel kills mid-line — a torn final record must not cost the
+    # day's measurements
+    from rocalphago_tpu.runtime.jsonl import iter_jsonl
     try:
         with open(path) as f:
-            for line in f:
-                try:
-                    r = json.loads(line)
-                except ValueError:
-                    continue
+            for r in iter_jsonl(f):
                 if (r.get("metric") == "selfplay_ply_program"
                         and r.get("platform") == "tpu"
                         and str(r.get("date", "")).startswith(today)

@@ -344,13 +344,25 @@ class GTPEngine:
         Proportional rule: in byo-yomi (``time_left`` with stones>0),
         the remaining period time splits evenly over the remaining
         period stones; in main time, the remaining clock splits over
-        the estimated moves left."""
+        the estimated moves left.
+
+        Idempotent per position: the byo-yomi rebase below rewrites
+        ``self._time_left`` from the REPORT snapshot (a pure function
+        of the cached report and the settings), so any number of
+        budget queries between genmoves (analysis, debug probes)
+        converge on the same ledger instead of re-basing a fresh
+        period at each query time — which would restart the period
+        clock on every call and never age it."""
         settings = self._time_settings
         left = self._time_left.get(color)
         if left is not None:
             t, stones, spent0, moves0 = left
-            # age the report by our own spend since it arrived
-            rem = t - (self._time_spent.get(color, 0.0) - spent0)
+            # age the report by our own spend since it arrived; a
+            # synthetic rebased ledger can place the period start
+            # before spend already made, so cap at the period size —
+            # byo-yomi time never accumulates
+            rem = min(t, t - (self._time_spent.get(color, 0.0)
+                              - spent0))
             if stones > 0:                     # canadian byo-yomi
                 # period stones also shrink by the moves we've made
                 # since the report
@@ -359,19 +371,24 @@ class GTPEngine:
                     return rem / (stones - made)
                 if rem > 0 and made >= stones:
                     # all reported stones played WITH time to spare:
-                    # a NEW period legitimately began. REBASE the
-                    # cached report to a synthetic fresh period at
-                    # the settings rate so its own aging starts now —
-                    # without this the old report's rem eventually
-                    # goes negative mid-new-period and would read as
-                    # a fallen flag.
+                    # a NEW period legitimately began when the
+                    # stones-th stone went down. REBASE the cached
+                    # report to that period, baselined at the REPORT
+                    # snapshot (its whole t consumed, its stones all
+                    # made) rather than at query-time counters: the
+                    # rewrite is then idempotent, and the new period
+                    # is not over-credited by whatever was spent
+                    # between the last period stone and this query.
                     if settings is not None and settings[2] > 0:
                         byo_t, byo_s = settings[1], settings[2]
                         self._time_left[color] = (
-                            byo_t, byo_s,
-                            self._time_spent.get(color, 0.0),
-                            self._genmoves.get(color, 0))
-                        return byo_t / byo_s
+                            byo_t, byo_s, spent0 + t,
+                            moves0 + stones)
+                        # recurse on the rebased ledger (terminates:
+                        # each level consumes byo_s made-moves, and a
+                        # blitz across several unreported periods
+                        # just rebases once per period)
+                        return self._move_budget_s(color)
                 # rem <= 0: by our own ledger the period flag has
                 # fallen (time ran out with stones owed, or stones
                 # completed only after the time was gone) — refilling

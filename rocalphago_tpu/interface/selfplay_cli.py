@@ -22,6 +22,8 @@ import numpy as np
 from rocalphago_tpu.data import sgf
 from rocalphago_tpu.engine import jaxgo
 from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.runtime import faults
+from rocalphago_tpu.runtime.atomic import atomic_write_json
 from rocalphago_tpu.search.selfplay import make_selfplay
 
 
@@ -182,8 +184,10 @@ def main(argv=None):
                             opp.module.apply, batch=a.games,
                             max_moves=a.max_moves,
                             temperature=a.temperature)
+    faults.barrier("selfplay_cli.pre_play")
     result = run(net.params, opp.params, jax.random.key(a.seed))
     jax.device_get(result.winners)
+    faults.barrier("selfplay_cli.post_play")
 
     winners = np.asarray(result.winners)
     summary = {
@@ -200,8 +204,8 @@ def main(argv=None):
             black_name=os.path.basename(a.policy),
             white_name=os.path.basename(a.opponent or a.policy))
         summary["sgf_files"] = len(paths)
-    with open(os.path.join(a.out, "summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
+        faults.barrier("selfplay_cli.post_sgf")
+    atomic_write_json(os.path.join(a.out, "summary.json"), summary)
     print(json.dumps(summary))
     return summary
 

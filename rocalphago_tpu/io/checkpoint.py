@@ -13,11 +13,15 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from rocalphago_tpu.runtime.atomic import atomic_write_json
+from rocalphago_tpu.runtime.retries import retry
 
 
 def pack_rng(key: jax.Array) -> jax.Array:
@@ -41,6 +45,11 @@ class TrainCheckpointer:
                 max_to_keep=max_to_keep, create=True,
                 enable_async_checkpointing=True))
 
+    # transient-failure backoff around the filesystem/RPC surface:
+    # Orbax writes are atomic (tmp dir + rename at finalize, so an
+    # interrupted save is invisible to latest_step) but a flaky
+    # shared filesystem can still fail the dispatch itself
+    @retry(max_attempts=3, base_delay=0.5)
     def save(self, step: int, tree, wait: bool = False) -> None:
         self.manager.save(step, args=ocp.args.StandardSave(tree))
         if wait:
@@ -49,6 +58,7 @@ class TrainCheckpointer:
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
 
+    @retry(max_attempts=3, base_delay=0.5)
     def restore(self, template, step: int | None = None):
         """Restore into the structure/shardings of ``template``
         (pass the freshly-initialized training pytree)."""
@@ -79,16 +89,36 @@ class MetadataWriter:
                  enabled: bool = True):
         self.path = path
         self.enabled = enabled
+        self.data = None
         if enabled and os.path.exists(path):
-            with open(path) as f:
-                self.data = json.load(f)
-        else:
+            try:
+                with open(path) as f:
+                    self.data = json.load(f)
+            except ValueError:
+                # a torn file from a pre-atomic-writes crash; the new
+                # writes go through atomic_write_json so this can only
+                # be legacy damage — start a fresh record rather than
+                # poisoning the resumed run
+                print(f"metadata: {path} is corrupt, starting fresh",
+                      file=sys.stderr)
+        if self.data is None:
             self.data = dict(header or {})
             self.data.setdefault("epochs", [])
             self._flush()
+        self.data.setdefault("epochs", [])
 
     def record_epoch(self, entry: dict) -> None:
         entry = dict(entry, wall_time=time.time())
+        # resume overwrite semantics: re-running an iteration/epoch
+        # after a crash REPLACES its provisional record, so a resumed
+        # run's metadata converges to the uninterrupted run's (the
+        # chaos tests compare the two)
+        for key in ("iteration", "epoch"):
+            if key in entry:
+                self.data["epochs"] = [
+                    e for e in self.data["epochs"]
+                    if e.get(key) != entry[key]]
+                break
         self.data["epochs"].append(entry)
         self._flush()
 
@@ -99,7 +129,4 @@ class MetadataWriter:
     def _flush(self) -> None:
         if not self.enabled:
             return
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.data, f, indent=2)
-        os.replace(tmp, self.path)
+        atomic_write_json(self.path, self.data)

@@ -15,6 +15,12 @@ import os
 import time
 
 
+# the crash-tolerant reader matching this module's writer; it lives
+# in runtime (stdlib-only) so light scripts can import it without
+# pulling this package's jax/orbax dependencies
+from rocalphago_tpu.runtime.jsonl import read_jsonl  # noqa: F401
+
+
 class MetricsLogger:
     def __init__(self, path: str | None, echo: bool = True):
         self.path = path

@@ -33,6 +33,10 @@ from flax import serialization
 
 from rocalphago_tpu.engine import jaxgo, pygo
 from rocalphago_tpu.features import DEFAULT_FEATURES, Preprocess
+from rocalphago_tpu.runtime.atomic import (
+    atomic_write_bytes,
+    atomic_write_json,
+)
 
 NEURALNETS: dict[str, type] = {}
 
@@ -208,24 +212,18 @@ class NeuralNetBase:
             weights_file = os.path.splitext(json_file)[0] + ".flax.msgpack"
         spec["weights_file"] = os.path.relpath(
             weights_file, os.path.dirname(json_file) or ".")
-        parent = os.path.dirname(json_file)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        with open(json_file, "w") as f:
-            json.dump(spec, f, indent=2)
+        # weights first, spec second: a crash between the two leaves a
+        # stale-but-loadable spec, never a spec pointing at a missing
+        # or half-written weights file
         self.save_weights(weights_file)
+        atomic_write_json(json_file, spec)
 
     def save_weights(self, weights_file: str):
-        parent = os.path.dirname(weights_file)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        # atomic tmp+rename: concurrent readers (multi-host opponent
-        # pools waiting on snapshot visibility) must never see a
-        # half-written msgpack
-        tmp = weights_file + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(serialization.to_bytes(self.params))
-        os.replace(tmp, weights_file)
+        # atomic tmp+fsync+rename: concurrent readers (multi-host
+        # opponent pools waiting on snapshot visibility) and post-crash
+        # resumes must never see a half-written msgpack
+        atomic_write_bytes(weights_file,
+                           serialization.to_bytes(self.params))
 
     def load_weights(self, weights_file: str):
         with open(weights_file, "rb") as f:

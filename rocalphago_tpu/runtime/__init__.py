@@ -1,0 +1,40 @@
+"""Crash-safe runtime layer shared by every trainer and CLI.
+
+The seed stack assumed a perfect machine; round-5 operations showed
+the opposite (TPU tunnel availability of 5/243 probes, multi-hour
+``nohup`` runs dying mid-write). This package makes the harness
+survive the hardware (docs/RESILIENCE.md):
+
+* :mod:`.atomic` — torn-write-proof artifact persistence
+  (tmp + fsync + ``os.replace``);
+* :mod:`.retries` — deterministic-jitter exponential backoff around
+  device dispatch and checkpoint I/O, with a transient-vs-programming
+  error classifier;
+* :mod:`.faults` — opt-in deterministic fault injection at named
+  barriers (``ROCALPHAGO_FAULT_PLAN=crash@iter3.post_save``), the
+  mechanism the chaos tests use to prove exact resume;
+* :mod:`.watchdog` — a heartbeat thread that logs ``stall`` events
+  and can abort a hung run with a clean checkpoint.
+"""
+
+from rocalphago_tpu.runtime.atomic import (  # noqa: F401
+    atomic_write_bytes,
+    atomic_write_json,
+    atomic_write_text,
+)
+from rocalphago_tpu.runtime.faults import (  # noqa: F401
+    FAULT_EXIT_CODE,
+    FAULT_PLAN_ENV,
+    InjectedFault,
+    barrier,
+)
+from rocalphago_tpu.runtime.jsonl import (  # noqa: F401
+    iter_jsonl,
+    read_jsonl,
+)
+from rocalphago_tpu.runtime.retries import (  # noqa: F401
+    is_transient,
+    retry,
+    retry_call,
+)
+from rocalphago_tpu.runtime.watchdog import Watchdog  # noqa: F401

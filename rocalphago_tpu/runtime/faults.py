@@ -1,0 +1,162 @@
+"""Deterministic fault injection at named barriers.
+
+Opt-in chaos harness: trainers call :func:`barrier` at named points
+("barriers") in their loops; a fault PLAN — normally from the
+``ROCALPHAGO_FAULT_PLAN`` env var, or installed programmatically via
+:func:`install` — declares which barrier hits should kill the process
+or raise. With no plan installed a barrier call is two attribute
+loads and a ``None`` check, so production loops pay nothing.
+
+Plan grammar (full reference in docs/RESILIENCE.md)::
+
+    plan   := spec ("," spec)*
+    spec   := kind "@" ["iter" N "."] barrier [":" hit] ["=" arg]
+    kind   := "crash" | "io_error" | "error" | "sleep"
+
+* ``crash`` — flush stdio and ``os._exit(FAULT_EXIT_CODE)`` (a hard
+  kill: no atexit hooks, no finally blocks — the honest model of
+  SIGKILL/OOM/power loss);
+* ``io_error`` — raise :class:`InjectedFault` (an ``OSError``
+  subclass, classified transient by :mod:`.retries`);
+* ``error`` — raise ``RuntimeError`` (classified non-transient);
+* ``sleep`` — block ``arg`` seconds (trips :mod:`.watchdog`).
+
+``iterN.`` restricts the spec to barrier hits whose ``iteration``
+argument equals N. ``:hit`` fires on the k-th matching hit (default
+the first). Each spec fires at most once. Barrier names are
+dot-qualified (``zero.post_save``); a spec's barrier matches on the
+full name or any dot-suffix, so ``crash@post_save`` hits
+``zero.post_save`` and ``sl.post_save`` alike while
+``crash@zero.post_save`` hits only the zero trainer.
+
+Examples::
+
+    ROCALPHAGO_FAULT_PLAN=crash@iter3.post_save
+    ROCALPHAGO_FAULT_PLAN=io_error@promote:2,sleep@pre_iteration=0.5
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import sys
+import time
+
+FAULT_PLAN_ENV = "ROCALPHAGO_FAULT_PLAN"
+FAULT_EXIT_CODE = 173          # distinct from shell/signal codes
+_KINDS = ("crash", "io_error", "error", "sleep")
+
+
+class InjectedFault(OSError):
+    """The raisable injected fault (an OSError: transient class)."""
+
+
+@dataclasses.dataclass
+class _Spec:
+    kind: str
+    barrier: str
+    iteration: int | None
+    hit: int
+    arg: float | None
+    text: str                  # original spec, for log lines
+    count: int = 0
+    fired: bool = False
+
+    def matches(self, name: str, iteration) -> bool:
+        if self.iteration is not None and iteration != self.iteration:
+            return False
+        return (name == self.barrier
+                or name.endswith("." + self.barrier))
+
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<barrier>[A-Za-z0-9_.]+)"
+    r"(?::(?P<hit>\d+))?(?:=(?P<arg>[0-9.]+))?$")
+
+# None = not yet loaded from the env; [] = loaded, empty
+_plan: list[_Spec] | None = None
+
+
+def parse_plan(text: str) -> list[_Spec]:
+    specs = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        m = _SPEC_RE.match(raw)
+        if m is None:
+            raise ValueError(
+                f"bad fault spec {raw!r}: expected "
+                "kind@[iterN.]barrier[:hit][=arg] "
+                f"(kinds: {', '.join(_KINDS)})")
+        kind = m.group("kind")
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {raw!r} "
+                f"(kinds: {', '.join(_KINDS)})")
+        barrier_part = m.group("barrier")
+        iteration = None
+        first, _, rest = barrier_part.partition(".")
+        it_m = re.fullmatch(r"iter(\d+)", first)
+        if it_m and rest:
+            iteration = int(it_m.group(1))
+            barrier_part = rest
+        if kind == "sleep" and m.group("arg") is None:
+            raise ValueError(
+                f"sleep spec {raw!r} needs a duration: sleep@name=0.5")
+        specs.append(_Spec(
+            kind=kind, barrier=barrier_part, iteration=iteration,
+            hit=int(m.group("hit") or 1),
+            arg=float(m.group("arg")) if m.group("arg") else None,
+            text=raw))
+    return specs
+
+
+def install(plan: str | None) -> None:
+    """Set the active plan (tests); ``None`` re-reads the env on the
+    next barrier call, ``""`` disables injection."""
+    global _plan
+    _plan = None if plan is None else parse_plan(plan)
+
+
+def _load() -> list[_Spec]:
+    global _plan
+    if _plan is None:
+        _plan = parse_plan(os.environ.get(FAULT_PLAN_ENV, ""))
+    return _plan
+
+
+def active() -> bool:
+    return bool(_load())
+
+
+def _fire(spec: _Spec, name: str) -> None:
+    spec.fired = True
+    if spec.kind == "crash":
+        print(f"faults: injected crash at {name} "
+              f"(spec {spec.text})", file=sys.stderr)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(FAULT_EXIT_CODE)
+    if spec.kind == "io_error":
+        raise InjectedFault(
+            f"injected io_error at {name} (spec {spec.text})")
+    if spec.kind == "error":
+        raise RuntimeError(
+            f"injected error at {name} (spec {spec.text})")
+    if spec.kind == "sleep":
+        time.sleep(spec.arg or 0.0)
+
+
+def barrier(name: str, iteration: int | None = None) -> None:
+    """Declare a fault barrier. No-op unless a plan names it."""
+    plan = _plan if _plan is not None else _load()
+    if not plan:
+        return
+    for spec in plan:
+        if spec.fired or not spec.matches(name, iteration):
+            continue
+        spec.count += 1
+        if spec.count >= spec.hit:
+            _fire(spec, name)

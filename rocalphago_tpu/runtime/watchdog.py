@@ -1,0 +1,102 @@
+"""Heartbeat watchdog for long training loops.
+
+A wedged device program (the round-2 tunnel postmortem: a worker kill
+mid-program hangs the host dispatch forever) leaves a ``nohup`` run
+silently stuck for hours. The watchdog is a daemon thread the loop
+feeds with :meth:`Watchdog.beat` once per iteration; if no beat
+arrives within the deadline it logs a ``stall`` event (to the run's
+``metrics.jsonl`` via the supplied logger) and — in abort mode —
+calls the caller's ``abort_fn``, whose job is to persist the last
+COMPLETED state (the in-flight iteration is unrecoverable from a
+sibling thread) and ``os._exit``. Logging mode just leaves a
+greppable trail for the operator.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+STALL_EXIT_CODE = 170
+
+
+class Watchdog:
+    """``with Watchdog(deadline_s, metrics=logger) as wd: wd.beat()``.
+
+    ``metrics``: a ``MetricsLogger``-shaped object (``log(event,
+    **fields)``) or None for stderr. ``abort_fn``: optional callable
+    run once on the first stall; after it returns the watchdog exits
+    the process with ``STALL_EXIT_CODE`` (pass ``exit=False`` to keep
+    the process — tests). Repeated stalls without an ``abort_fn`` log
+    every ``deadline_s``.
+    """
+
+    def __init__(self, deadline_s: float, metrics=None,
+                 abort_fn=None, name: str = "train",
+                 exit: bool = True, poll_s: float | None = None):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline must be > 0, got {deadline_s}")
+        self.deadline_s = deadline_s
+        self.metrics = metrics
+        self.abort_fn = abort_fn
+        self.name = name
+        self.exit = exit
+        self.stalls = 0
+        self._poll_s = poll_s or min(1.0, deadline_s / 4.0)
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch, name=f"watchdog-{name}", daemon=True)
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "Watchdog":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------ heartbeat
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    def _log(self, elapsed: float) -> None:
+        if self.metrics is not None:
+            self.metrics.log("stall", watchdog=self.name,
+                             elapsed_s=round(elapsed, 1),
+                             deadline_s=self.deadline_s)
+        else:
+            print(f"watchdog[{self.name}]: no heartbeat for "
+                  f"{elapsed:.0f}s (deadline {self.deadline_s:.0f}s)",
+                  file=sys.stderr)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            elapsed = time.monotonic() - self._last_beat
+            if elapsed < self.deadline_s:
+                continue
+            self.stalls += 1
+            self._log(elapsed)
+            if self.abort_fn is not None:
+                try:
+                    self.abort_fn()
+                finally:
+                    if self.exit:
+                        sys.stdout.flush()
+                        sys.stderr.flush()
+                        os._exit(STALL_EXIT_CODE)
+                return
+            # keep logging, but not more than once per deadline
+            self._last_beat = time.monotonic()

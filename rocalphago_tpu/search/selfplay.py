@@ -43,6 +43,7 @@ from rocalphago_tpu.engine.jaxgo import (
     winner,
 )
 from rocalphago_tpu.features.planes import encode, needs_member, true_eyes
+from rocalphago_tpu.runtime import faults
 
 
 def sensible_mask(cfg: GoConfig, state: GoState,
@@ -284,6 +285,7 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
             # exact remainder segment (one extra compile at most) so
             # no ply beyond max_moves ever runs — results stay
             # bit-identical to the monolithic scan
+            faults.barrier("selfplay.chunk", offset)
             length = min(chunk, max_moves - offset)
             states, rng, actions, live = segment(
                 params_a, params_b, states, rng, jnp.int32(offset),

@@ -54,6 +54,7 @@ from rocalphago_tpu.io.checkpoint import (
 from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
 from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.runtime import faults, retries
 from rocalphago_tpu.search.selfplay import (
     make_selfplay_chunked,
     play_games,
@@ -407,13 +408,24 @@ class RLTrainer:
                     "config": dataclasses.asdict(cfg)},
             enabled=self.coord)
         final = {}
+        # transient-failure re-dispatch: safe for the chunked
+        # (host-driven, nothing donated) iteration — it recomputes the
+        # identical result from the unchanged state. The monolithic
+        # jit DONATES the state buffers, so after a failed dispatch
+        # the input may already be invalid: no retry there.
+        step = self._iteration
+        if cfg.chunk:
+            step = retries.retry(max_attempts=3, base_delay=1.0,
+                                 logger=self.metrics.log)(step)
         for it in range(self.start_iteration, cfg.iterations):
+            faults.barrier("rl.pre_iteration", it)
             opp_params, opp_name = self.pool.sample(
                 cfg.seed, it, save_every=cfg.save_every)
             opp_params = meshlib.replicate(self.mesh, opp_params)
             t0 = time.time()
-            self.state, m = self._iteration(self.state, opp_params)
+            self.state, m = step(self.state, opp_params)
             win = float(m["win_rate"])
+            faults.barrier("rl.post_iteration", it)
             entry = {
                 "iteration": it, "opponent": opp_name,
                 "win_rate": win,
@@ -425,9 +437,19 @@ class RLTrainer:
             meta.record_epoch(entry)
             final = entry
             if (it + 1) % cfg.save_every == 0 or it + 1 == cfg.iterations:
+                # pool snapshot and exports BEFORE the checkpoint
+                # save (the commit point): a crash anywhere in here is
+                # healed by resume re-running the iteration and
+                # rewriting identical artifacts atomically
                 self.pool.add(self.state.params, it + 1)
-                self.ckpt.save(it + 1, jax.device_get(self.state))
                 self._export_weights(it + 1)
+                faults.barrier("rl.pre_save", it)
+                self.ckpt.save(it + 1, jax.device_get(self.state))
+                if faults.active():
+                    # deterministic barrier: commit the async save
+                    # before post_save (see training.zero)
+                    self.ckpt.wait()
+                faults.barrier("rl.post_save", it)
         self.ckpt.wait()
         return final
 

@@ -47,6 +47,7 @@ from rocalphago_tpu.io.checkpoint import (
 from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
 from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.training.symmetries import random_transform_batch
 
 
@@ -260,6 +261,7 @@ class SLTrainer:
         # of the same epoch after resume (reference shuffle.npz trick)
         final = {}
         for epoch in range(self.start_epoch, cfg.epochs):
+            faults.barrier("sl.pre_epoch", epoch)
             skip = self._resume_skip if epoch == self.start_epoch else 0
             host_rng = np.random.default_rng(
                 np.random.SeedSequence([cfg.seed, epoch]))
@@ -282,6 +284,7 @@ class SLTrainer:
                     gstep = epoch * steps_per_epoch + skip + len(losses)
                     if gstep % cfg.save_every == 0:
                         self.ckpt.save(gstep, jax.device_get(self.state))
+                        faults.barrier("sl.step_save", gstep)
             if not losses:
                 raise ValueError(
                     f"train split ({len(self.train_idx)} positions) "
@@ -300,8 +303,17 @@ class SLTrainer:
             }
             self.metrics.log("epoch", **entry)
             meta.record_epoch(entry)
-            self.ckpt.save(step, jax.device_get(self.state))
+            # exports BEFORE the checkpoint save (the commit point): a
+            # crash in between is healed by resume re-running the
+            # epoch and rewriting identical artifacts atomically
             self._export_weights(epoch)
+            faults.barrier("sl.pre_save", epoch)
+            self.ckpt.save(step, jax.device_get(self.state))
+            if faults.active():
+                # deterministic barrier: commit the async save before
+                # post_save (see training.zero)
+                self.ckpt.wait()
+            faults.barrier("sl.post_save", epoch)
             final = entry
         # held-out test-split metric (BASELINE.md metric 1: top-1 move
         # accuracy) — recorded in metadata.json for tooling and

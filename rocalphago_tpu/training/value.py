@@ -42,6 +42,7 @@ from rocalphago_tpu.io.checkpoint import (
 from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
 from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.training.sl import pad_batch
 from rocalphago_tpu.training.symmetries import transform_planes
 
@@ -210,6 +211,7 @@ class ValueTrainer:
         steps_per_epoch = self._steps_per_epoch()
         final = {}
         for epoch in range(self.start_epoch, cfg.epochs):
+            faults.barrier("value.pre_epoch", epoch)
             skip = self._resume_skip if epoch == self.start_epoch else 0
             host_rng = np.random.default_rng(
                 np.random.SeedSequence([cfg.seed, epoch]))
@@ -228,6 +230,7 @@ class ValueTrainer:
                     gstep = epoch * steps_per_epoch + skip + len(losses)
                     if gstep % cfg.save_every == 0:
                         self.ckpt.save(gstep, jax.device_get(self.state))
+                        faults.barrier("value.step_save", gstep)
             if not losses:
                 raise ValueError(
                     f"train split ({len(self.train_idx)} positions) "
@@ -245,8 +248,16 @@ class ValueTrainer:
             }
             self.metrics.log("epoch", **entry)
             meta.record_epoch(entry)
-            self.ckpt.save(step, jax.device_get(self.state))
+            # exports before the checkpoint save (commit point) — same
+            # crash-safe ordering as SLTrainer.run
             self._export_weights(epoch)
+            faults.barrier("value.pre_save", epoch)
+            self.ckpt.save(step, jax.device_get(self.state))
+            if faults.active():
+                # deterministic barrier: commit the async save before
+                # post_save (see training.zero)
+                self.ckpt.wait()
+            faults.barrier("value.post_save", epoch)
             final = entry
         # held-out test-split MSE (AlphaGo paper reports train+test MSE)
         if len(self.test_idx):

@@ -342,18 +342,28 @@ class ZeroGate:
 
     def promote(self, policy_params, value_params,
                 iteration: int) -> None:
-        import os
-
         if not self.write:
             return
         from flax import serialization
 
-        os.makedirs(self.pool_dir, exist_ok=True)
-        for path, params in zip(self._paths(iteration),
-                                (policy_params, value_params)):
-            with open(path, "wb") as f:
-                f.write(serialization.to_bytes(
-                    jax.device_get(params)))
+        from rocalphago_tpu.runtime import atomic, faults, retries
+
+        # atomic per-file writes + policy-before-value order: a crash
+        # mid-promotion leaves either a complete pair or a policy file
+        # whose missing value sibling keeps it OUT of snapshots() —
+        # never a torn incumbent. Transient write failures (flaky
+        # shared filesystem) retry with backoff; the promotion is
+        # idempotent (same params → same bytes).
+        @retries.retry(max_attempts=3, base_delay=0.2)
+        def write_pair():
+            for path, params in zip(self._paths(iteration),
+                                    (policy_params, value_params)):
+                faults.barrier("zero.promote", iteration)
+                atomic.atomic_write_bytes(
+                    path, serialization.to_bytes(
+                        jax.device_get(params)))
+
+        write_pair()
 
     def load(self, entry, policy_template, value_template) -> tuple:
         from flax import serialization
@@ -411,6 +421,8 @@ def run_training(argv=None) -> dict:
     )
     from rocalphago_tpu.io.metrics import MetricsLogger
     from rocalphago_tpu.models.nn_util import NeuralNetBase
+    from rocalphago_tpu.runtime import faults, retries
+    from rocalphago_tpu.runtime.watchdog import Watchdog
 
     ap = argparse.ArgumentParser(
         description="AlphaZero-style training: device-MCTS self-play "
@@ -477,6 +489,12 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--gate-temperature", type=float, default=1.0,
                     help="sampling temperature for gate/ladder match "
                          "play")
+    ap.add_argument("--iteration-deadline", type=float, default=0.0,
+                    help="watchdog: seconds one iteration may take "
+                         "before a 'stall' event is logged and the "
+                         "run aborts with the last completed "
+                         "checkpoint (0 = off); resume picks up at "
+                         "the aborted iteration")
     a = ap.parse_args(argv)
     if a.gumbel and a.dirichlet_alpha > 0:
         raise SystemExit("--dirichlet-alpha is PUCT-mode root noise; "
@@ -569,7 +587,13 @@ def run_training(argv=None) -> dict:
             threshold=a.gate_threshold,
             temperature=a.gate_temperature, move_limit=a.move_limit,
             write=coord)
-        snaps = gate.snapshots()
+        # only snapshots at-or-before the restored checkpoint count:
+        # a crash between a promotion and its checkpoint save leaves a
+        # "future" pool entry, and resuming with it as incumbent would
+        # diverge from the uninterrupted run (the re-run iteration
+        # re-promotes deterministically, overwriting it with identical
+        # bytes)
+        snaps = [s for s in gate.snapshots() if s[0] <= start]
         if restored is not None and snaps:
             # a resumed run keeps its incumbent (the candidate in the
             # checkpoint may be mid-losing-streak)
@@ -598,11 +622,41 @@ def run_training(argv=None) -> dict:
             net.save_model(
                 os.path.join(a.out_dir, f"{name}.json"), weights)
 
+    # transient device/XLA failures re-dispatch the whole iteration:
+    # it is functional (state in, new state out; nothing donated), so
+    # a retry recomputes the identical result from the same state
+    run_iteration = retries.retry(
+        max_attempts=3, base_delay=1.0, logger=metrics.log)(iteration)
+
+    # watchdog: a wedged device program (round-2 tunnel postmortem)
+    # must not hang a nohup run forever — log a stall and abort with
+    # the last COMPLETED iteration durably checkpointed; resume picks
+    # up exactly there
+    last_done = {"state": None, "step": -1}
+
+    def _stall_abort():
+        st = last_done["state"]
+        if st is not None and last_done["step"] != ckpt.latest_step():
+            ckpt.save(last_done["step"], st, wait=True)
+
+    watchdog = None
+    if a.iteration_deadline > 0:
+        watchdog = Watchdog(a.iteration_deadline, metrics=metrics,
+                            abort_fn=_stall_abort, name="zero").start()
+
     for it in range(start, a.iterations):
+        faults.barrier("zero.pre_iteration", it)
         t0 = time.time()
-        state, m = iteration(state, best_p, best_v)
-        entry = {"iteration": it,
-                 **{k: float(jax.device_get(v)) for k, v in m.items()},
+        state, m = run_iteration(state, best_p, best_v)
+        m = {k: float(jax.device_get(v)) for k, v in m.items()}
+        if watchdog is not None:
+            # the metrics fetch above synced the iteration's programs,
+            # so the beat marks real end-of-iteration
+            watchdog.beat()
+            last_done["state"] = jax.device_get(state)
+            last_done["step"] = it + 1
+        faults.barrier("zero.post_iteration", it)
+        entry = {"iteration": it, **m,
                  "games_per_min": a.game_batch * 60.0
                  / max(time.time() - t0, 1e-9)}
         metrics.log("iteration", **entry)
@@ -630,10 +684,28 @@ def run_training(argv=None) -> dict:
                                 lkey)
                 metrics.log("ladder", iteration=it,
                             opponent=snap[0], **lr)
+            faults.barrier("zero.post_gate", it)
         if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
-            ckpt.save(it + 1, jax.device_get(state))
+            # exports BEFORE the checkpoint save: everything written
+            # before the save that commits step it+1 is reproduced by
+            # a resume from the previous checkpoint, so a crash at any
+            # point leaves artifacts a resume makes identical to the
+            # uninterrupted run (the save is the commit point)
             export(it + 1)
+            faults.barrier("zero.post_export", it)
+            faults.barrier("zero.pre_save", it)
+            ckpt.save(it + 1, jax.device_get(state))
+            if faults.active():
+                # barriers are DETERMINISTIC points: under an active
+                # fault plan the async save commits before post_save,
+                # so crash@pre_save/post_save cleanly separate
+                # uncommitted from committed (a real crash can land
+                # anywhere — the chaos sweep covers that too)
+                ckpt.wait()
+            faults.barrier("zero.post_save", it)
     ckpt.wait()
+    if watchdog is not None:
+        watchdog.stop()
     print(json.dumps(final))
     return final
 

@@ -13,6 +13,12 @@ cd "$(dirname "$0")/.."
 # explicit -m, or an exact ::node-id selection runs without the tier
 # filter (so naming one slow test runs it). CI should run --all
 # nightly.
+#
+# Fault tolerance: the default tier includes the chaos SMOKE
+# (tests/test_chaos.py::test_chaos_smoke_single_kill_resume — one
+# injected kill + exact resume of the 5x5 zero loop, ~1 min); the
+# full every-barrier chaos sweep is @slow and runs with --all. See
+# docs/RESILIENCE.md.
 ARGS=()
 TIER=(-m "not slow")
 for a in "$@"; do

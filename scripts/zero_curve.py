@@ -20,18 +20,23 @@ import json
 import os
 import sys
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rocalphago_tpu.runtime.jsonl import iter_jsonl  # noqa: E402
+
 
 def load(run_dir: str) -> dict[str, list[dict]]:
-    """One pass over metrics.jsonl → rows bucketed by event type."""
+    """One pass over metrics.jsonl → rows bucketed by event type.
+
+    Tolerant reader: a run killed mid-write leaves at most one torn
+    trailing line, which is skipped instead of crashing the summary
+    (the whole point is summarizing interrupted runs)."""
     path = os.path.join(run_dir, "metrics.jsonl")
     by_event: dict[str, list[dict]] = {}
     try:
         with open(path) as f:
-            for line in f:
-                try:
-                    r = json.loads(line)
-                except ValueError:
-                    continue
+            for r in iter_jsonl(f):
                 if isinstance(r.get("event"), str):
                     by_event.setdefault(r["event"], []).append(r)
     except OSError as e:

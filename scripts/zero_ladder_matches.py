@@ -27,27 +27,46 @@ import json
 import os
 import re
 import sys
+import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
 def pool_snapshots(run_dir: str) -> list[tuple[int, str]]:
+    """Numerically-sorted ``(iteration, weights_path)`` pairs."""
     pool = os.path.join(run_dir, "pool")
+    try:
+        names = os.listdir(pool)
+    except FileNotFoundError:
+        raise SystemExit(
+            f"{pool} does not exist — pass a gated training.zero "
+            "out_dir (its pool/ holds the promoted best.NNNNN.* "
+            "snapshots this ladder replays)")
     out = []
-    for name in sorted(os.listdir(pool)):
+    for name in names:
         m = re.fullmatch(r"best\.(\d+)\.policy\.msgpack", name)
         if m:
             out.append((int(m.group(1)), os.path.join(pool, name)))
+    # numeric sort on the captured iteration: zero-padding keeps
+    # lexicographic order only until an iteration outgrows the pad
+    # width, and nothing enforces that width here
+    out.sort(key=lambda pair: pair[0])
     return out
 
 
-def write_spec(spec_path: str, weights: str) -> str:
-    """Sibling spec JSON pointing at one pool snapshot's weights."""
+def write_spec(spec_path: str, weights: str, out_dir: str) -> str:
+    """Spec JSON in ``out_dir`` pointing at one pool snapshot's
+    weights (absolute path — the spec does NOT live beside them).
+    Generated specs go to a temp dir, never into the run's pool/:
+    writing there silently clobbered git-tracked pool spec artifacts
+    with whatever --spec the caller supplied (ADVICE round 5)."""
     with open(spec_path) as f:
         spec = json.load(f)
-    spec["weights_file"] = os.path.basename(weights)
-    out = weights.replace(".policy.msgpack", ".policy.json")
+    spec["weights_file"] = os.path.abspath(weights)
+    out = os.path.join(
+        out_dir, os.path.basename(weights).replace(
+            ".policy.msgpack", ".policy.json"))
     with open(out, "w") as f:
         json.dump(spec, f)
     return out
@@ -68,7 +87,8 @@ def main(argv=None) -> int:
     snaps = pool_snapshots(a.run_dir)
     if len(snaps) < 2:
         raise SystemExit(f"need >=2 pool snapshots, found {len(snaps)}")
-    specs = {it: write_spec(a.spec, w) for it, w in snaps}
+    spec_dir = tempfile.mkdtemp(prefix="zero_ladder_specs.")
+    specs = {it: write_spec(a.spec, w, spec_dir) for it, w in snaps}
     last_it = snaps[-1][0]
 
     from rocalphago_tpu.interface import tournament

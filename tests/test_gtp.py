@@ -422,3 +422,37 @@ def test_time_left_report_ages():
         eng._time_spent.get(pygo.BLACK, 0.0) + 40.0)
     est = max(10.0, (0.75 * 81 - eng.state.turns_played) / 2.0)
     assert eng._move_budget_s(pygo.BLACK) == pytest.approx(60.0 / est)
+
+
+def test_byoyomi_rebase_idempotent_and_snapshot_based():
+    """ADVICE r5: the byo-yomi rebase inside _move_budget_s must be a
+    pure function of the cached report (idempotent), not of query-time
+    counters — a second budget query per move (analysis/debug) must
+    neither re-rebase nor inflate the budget, and the synthetic period
+    is baselined at the report snapshot (spent0 + t consumed at the
+    stones-th move), not at query time."""
+    eng = GTPEngine(ClockedPlayer())
+    ok(eng, "boardsize 9")
+    ok(eng, "clear_board")
+    ok(eng, "time_settings 300 60 6")
+    # report: 30s for 5 stones, taken at spent=0.0 / 0 genmoves
+    eng._time_left[pygo.BLACK] = (30.0, 5, 0.0, 0)
+    eng._time_spent[pygo.BLACK] = 10.0
+    eng._genmoves[pygo.BLACK] = 5        # all 5 stones played, 20s left
+    # first query triggers the rebase: fresh settings period (60s/6),
+    # baselined at the SNAPSHOT (spent0 + 30 consumed, moves0 + 5 made)
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(60.0 / 6)
+    assert eng._time_left[pygo.BLACK] == (60.0, 6, 30.0, 5)
+    # second query: same answer, same ledger — no re-rebase
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(60.0 / 6)
+    assert eng._time_left[pygo.BLACK] == (60.0, 6, 30.0, 5)
+    # the new period ages from the snapshot baseline: once total spend
+    # passes spent0 + t, the surplus comes out of the fresh period
+    # (query-time baselining would have forgiven it entirely)
+    eng._time_spent[pygo.BLACK] = 40.0   # 10s into the new period
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(50.0 / 6)
+    # blitzing through ANOTHER full period's stones recurses one
+    # rebase per period and still terminates with a sane budget
+    eng._genmoves[pygo.BLACK] = 11       # 5 report + 6 period stones
+    assert eng._move_budget_s(pygo.BLACK) == pytest.approx(60.0 / 6)
+    assert eng._time_left[pygo.BLACK] == (60.0, 6, 90.0, 11)
