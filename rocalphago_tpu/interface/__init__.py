@@ -12,6 +12,8 @@ _EXPORTS = {
     "run_gtp": "rocalphago_tpu.interface.gtp",
     "vertex_to_move": "rocalphago_tpu.interface.gtp",
     "elo_table": "rocalphago_tpu.interface.elo",
+    "ResilientPlayer": "rocalphago_tpu.interface.resilient",
+    "GameCrash": "rocalphago_tpu.interface.tournament",
     "run_tournament": "rocalphago_tpu.interface.tournament",
 }
 

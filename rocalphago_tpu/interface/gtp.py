@@ -10,16 +10,34 @@ performance-relevant) rather than depending on it.
 Supported commands: the GTP 2 administrative/core set
 (``protocol_version name version known_command list_commands quit``),
 setup (``boardsize clear_board komi fixed_handicap place_free_handicap
-set_free_handicap``), play (``play genmove undo``), and tournament
-niceties (``showboard final_score time_left time_settings``).
+set_free_handicap``), play (``play genmove undo``), tournament
+niceties (``showboard final_score time_left time_settings``), and the
+private operator probes ``rocalphago-health`` / ``rocalphago-stats``
+(one-line JSON; schema in docs/RESILIENCE.md).
+
+RESILIENT SERVING (default): a GTP controller forfeits the game on
+any ``? error`` genmove reply, so ``cmd_genmove`` never surfaces a
+player exception — the player is wrapped in a
+:class:`~rocalphago_tpu.interface.resilient.ResilientPlayer` and a
+failing search walks the degradation ladder (full search →
+reduced-sims retry → raw policy move → rules-oracle fallback) until a
+legal vertex comes out. Fault-injection barriers
+``genmove.pre_search`` / ``genmove.post_search`` /
+``genmove.pre_apply`` (:mod:`rocalphago_tpu.runtime.faults`) cover
+the engine's own serving path; in resilient mode a fault fired there
+is counted and logged, never echoed to the controller.
+``resilient=False`` restores the raw legacy behavior (exceptions
+become ``? error`` replies).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.runtime import faults
 
 COLS = "ABCDEFGHJKLMNOPQRSTUVWXYZ"  # GTP skips I
 
@@ -113,8 +131,27 @@ class GTPEngine:
     """
 
     def __init__(self, player, name: str = "rocalphago-tpu",
-                 version: str = "0.1"):
+                 version: str = "0.1", metrics=None,
+                 resilient: bool = True,
+                 hang_timeout_s: float | None = None):
+        from rocalphago_tpu.interface.resilient import ResilientPlayer
+
         self.player = player
+        self._metrics = metrics
+        if not resilient:
+            self._serve = None
+        elif isinstance(player, ResilientPlayer):
+            self._serve = player
+            if metrics is not None and player.metrics is None:
+                player.metrics = metrics
+            if hang_timeout_s is not None \
+                    and player.hang_timeout_s is None:
+                player.hang_timeout_s = hang_timeout_s
+        else:
+            self._serve = ResilientPlayer(
+                player, metrics=metrics,
+                hang_timeout_s=hang_timeout_s)
+        self.illegal_from_player = 0  # engine-level final-guard count
         self.name = name
         self.version = version
         self.size = self._player_board() or 19
@@ -129,8 +166,13 @@ class GTPEngine:
         self._time_left: dict = {}
         self._time_spent: dict = {}   # color -> own-genmove seconds
         self._genmoves: dict = {}     # color -> genmove count
+        # GTP command names may not contain "_" per the method-name
+        # mapping; the private extensions are conventionally dashed
+        # (rocalphago-health), so display/dispatch translate the
+        # rocalphago_ prefix both ways
         self._commands = sorted(
-            m[4:] for m in dir(self) if m.startswith("cmd_"))
+            m[4:].replace("rocalphago_", "rocalphago-", 1)
+            for m in dir(self) if m.startswith("cmd_"))
 
     # ------------------------------------------------------------ admin
 
@@ -243,6 +285,50 @@ class GTPEngine:
             raise
         return ""
 
+    def _serving_barrier(self, name: str) -> None:
+        """Declare a fault barrier on the genmove path. In resilient
+        mode an injected fault here is counted + logged (the move
+        must still go out); raw mode lets it raise like any command
+        error."""
+        try:
+            faults.barrier(name, iteration=self.state.turns_played)
+        except Exception as e:  # noqa: BLE001 — injected by design
+            if self._serve is None:
+                raise
+            self._serve.note_barrier_fault(name, e)
+
+    def _generate(self, color):
+        """One move off the player surface. Resilient mode guarantees
+        a servable answer (the ladder bottoms out at pass); raw mode
+        propagates player exceptions (legacy ``? error`` replies)."""
+        try:
+            # a raising time hook must not take the move down with it
+            set_time = getattr(self.player, "set_move_time", None)
+            if set_time is not None:
+                set_time(self._move_budget_s(color))
+        except Exception as e:  # noqa: BLE001
+            if self._serve is None:
+                raise
+            self._serve.note_barrier_fault("genmove.set_move_time", e)
+        self._serving_barrier("genmove.pre_search")
+        if self._serve is not None:
+            move = self._serve.get_move(self.state)
+        else:
+            move = self.player.get_move(self.state)
+        self._serving_barrier("genmove.post_search")
+        if move is not None and not self.state.is_legal(move):
+            # final guard (the ladder validates before this in
+            # resilient mode): historically a silent pass — count it
+            # and emit the degradation signal instead of losing it
+            self.illegal_from_player += 1
+            if self._metrics is not None:
+                self._metrics.log(
+                    "degradation", rung="engine",
+                    reason="illegal_from_player",
+                    turn=self.state.turns_played, move=str(move))
+            move = None
+        return move
+
     def cmd_genmove(self, args):
         color = parse_color(args[0])
         prev = self.state.current_player
@@ -251,14 +337,11 @@ class GTPEngine:
 
         t0 = _time.monotonic()
         try:
-            # inside the try: a raising time hook must restore the
-            # side to move like any other genmove failure
-            set_time = getattr(self.player, "set_move_time", None)
-            if set_time is not None:
-                set_time(self._move_budget_s(color))
-            move = self.player.get_move(self.state)
-            if move is not None and not self.state.is_legal(move):
-                move = None
+            # inside the try: any genmove failure must restore the
+            # side to move (raw mode; resilient mode only raises
+            # below for a game already over)
+            move = self._generate(color)
+            self._serving_barrier("genmove.pre_apply")
             self._apply_move(move, color)
         except Exception:
             self.state.current_player = prev
@@ -299,6 +382,80 @@ class GTPEngine:
         if white > black:
             return f"W+{white - black:g}"
         return "0"
+
+    # ----------------------------------------------- operator probes
+    #
+    # Private extensions (the `rocalphago-` prefix keeps them out of
+    # controllers' way; GoGui shows them under "analyze commands"):
+    # one-line JSON so an operator — or a load balancer — can probe a
+    # live engine over its GTP pipe. Schema: docs/RESILIENCE.md.
+
+    def _primary_player(self):
+        return self._serve.primary if self._serve is not None \
+            else self.player
+
+    def cmd_rocalphago_health(self, args):
+        """Degradation-ladder health: counts per rung, p50/p99
+        genmove latency, last fallback reason, sims actually run."""
+        if self._serve is None:
+            raise ValueError("resilient serving disabled")
+        s = self._serve.stats()
+        s["illegal_from_player"] += self.illegal_from_player
+        s["status"] = ("ok" if s["last_rung"] in (None, "search")
+                       else "degraded")
+        primary = self._primary_player()
+        s["sims"] = {"last": getattr(primary, "last_n_sim", None),
+                     "nominal": getattr(primary, "n_sim", None)}
+        s["deadline"] = {
+            "hits": getattr(primary, "deadline_hits", 0),
+            "last_hit": bool(getattr(primary, "last_deadline_hit",
+                                     False))}
+        return json.dumps(s, sort_keys=True)
+
+    def cmd_rocalphago_stats(self, args):
+        """Operational snapshot: game/clock/search state plus the
+        full ladder stats (superset of rocalphago-health)."""
+        primary = self._primary_player()
+        clock = getattr(primary, "_clock", None)
+
+        def per_color(d, r=None):
+            return {"black": (round(d.get(pygo.BLACK, 0), 3)
+                              if r else d.get(pygo.BLACK, 0)),
+                    "white": (round(d.get(pygo.WHITE, 0), 3)
+                              if r else d.get(pygo.WHITE, 0))}
+
+        out = {
+            "name": self.name,
+            "version": self.version,
+            "game": {
+                "size": self.size,
+                "komi": self.komi,
+                "turns": self.state.turns_played,
+                "to_move": ("black" if self.state.current_player
+                            == pygo.BLACK else "white"),
+                "over": bool(self.state.is_end_of_game),
+            },
+            "genmoves": per_color(self._genmoves),
+            "time_spent_s": per_color(self._time_spent, r=True),
+            "clock": {
+                "settings": (list(self._time_settings)
+                             if self._time_settings else None),
+                "move_time_s": getattr(clock, "move_time", None),
+                "rate_units_per_s": getattr(clock, "rate", None),
+            },
+            "search": {
+                "last_n_sim": getattr(primary, "last_n_sim", None),
+                "nominal_n_sim": getattr(primary, "n_sim", None),
+                "reuses": getattr(primary, "reuses", None),
+                "deadline_hits": getattr(primary, "deadline_hits",
+                                         None),
+                "last_deadline_hit": getattr(
+                    primary, "last_deadline_hit", None),
+            },
+            "ladder": (self._serve.stats()
+                       if self._serve is not None else None),
+        }
+        return json.dumps(out, sort_keys=True)
 
     # ------------------------------------------------------------- time
     #
@@ -437,7 +594,11 @@ class GTPEngine:
         if not parts:
             return None, False
         cmd, args = parts[0], parts[1:]
-        fn = getattr(self, f"cmd_{cmd}", None)
+        # the private extensions are dashed on the wire
+        # (rocalphago-health) but methods can't be — translate
+        lookup = cmd.replace("-", "_") \
+            if cmd.startswith("rocalphago-") else cmd
+        fn = getattr(self, f"cmd_{lookup}", None)
         if fn is None:
             return f"?{cmd_id} unknown command\n\n", False
         try:
@@ -499,8 +660,26 @@ def main(argv=None):
     ap.add_argument("--device-rollout", action="store_true",
                     help="mcts rollouts as one on-device scan per "
                          "wave instead of host rules")
+    ap.add_argument("--metrics", default=None,
+                    help="JSONL path for degradation/stall events "
+                         "(the serving metrics.jsonl)")
+    ap.add_argument("--genmove-timeout", type=float, default=None,
+                    help="abandon a silent search after this many "
+                         "seconds and degrade to the policy rung "
+                         "(watchdog hang protection; default off)")
+    ap.add_argument("--no-resilient", action="store_true",
+                    help="raw legacy serving: player exceptions "
+                         "become ? error replies (forfeits under "
+                         "most controllers)")
     a = ap.parse_args(argv)
-    run_gtp(make_player(a))
+    metrics = None
+    if a.metrics:
+        from rocalphago_tpu.io.metrics import MetricsLogger
+
+        metrics = MetricsLogger(a.metrics, echo=False)
+    run_gtp(make_player(a), metrics=metrics,
+            resilient=not a.no_resilient,
+            hang_timeout_s=a.genmove_timeout)
 
 
 if __name__ == "__main__":

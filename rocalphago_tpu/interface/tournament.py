@@ -15,9 +15,27 @@ import sys
 from rocalphago_tpu.engine import pygo
 
 
+class GameCrash(Exception):
+    """A player failed mid-game (raising ``get_move`` or an illegal
+    move the engine rejected). Carries the side that crashed so the
+    tournament can score the forfeit and play on."""
+
+    def __init__(self, color: int, cause: BaseException):
+        self.color = color
+        self.cause = cause
+        side = "black" if color == pygo.BLACK else "white"
+        super().__init__(
+            f"{side} crashed: {type(cause).__name__}: {cause}")
+
+
 def play_match(black, white, size: int = 19, komi: float = 7.5,
                move_limit: int = 722):
-    """One game; returns +1 (black win), -1 (white win), 0 (draw)."""
+    """One game; returns +1 (black win), -1 (white win), 0 (draw).
+
+    A raising player (or one whose move the rules reject) aborts the
+    game with :class:`GameCrash` naming the crashing side — the
+    caller decides whether that forfeits (``run_tournament``) or
+    propagates."""
     from rocalphago_tpu.search.players import reset_player
 
     state = pygo.GameState(size=size, komi=komi)
@@ -25,8 +43,12 @@ def play_match(black, white, size: int = 19, komi: float = 7.5,
     for player in players.values():
         reset_player(player)
     while not state.is_end_of_game and state.turns_played < move_limit:
-        move = players[state.current_player].get_move(state)
-        state.do_move(move)
+        mover = state.current_player
+        try:
+            move = players[mover].get_move(state)
+            state.do_move(move)
+        except Exception as e:  # noqa: BLE001 — scored as a forfeit
+            raise GameCrash(mover, e) from e
     return state.get_winner()
 
 
@@ -37,35 +59,59 @@ def run_tournament(player_a, player_b, games: int, size: int = 19,
 
     The tally is kept by player INDEX (0 / 1 / draw) and mapped to
     ``names`` only for display — duplicate or reserved display names
-    can't corrupt the counts, and are rejected up front."""
+    can't corrupt the counts, and are rejected up front.
+
+    Per-game FAULT ISOLATION: a game a player crashes out of
+    (:class:`GameCrash`) is scored as a forfeit — the crashing side
+    loses, the log entry records the forfeit and cause — and the
+    tournament plays on; one bad game no longer aborts the whole
+    run. Forfeit counts come back in the tally (``forfeits``)."""
     if len(set(names)) != 2 or "draw" in names:
         raise ValueError(
             f"names must be two distinct labels, neither 'draw'; "
             f"got {names!r}")
     tally = [0, 0, 0]                 # wins A, wins B, draws
+    forfeits = [0, 0]                 # games A / B crashed out of
     for g in range(games):
         a_is_black = g % 2 == 0
         black, white = (player_a, player_b) if a_is_black \
             else (player_b, player_a)
         black_name, white_name = (names if a_is_black
                                   else names[::-1])
-        w = play_match(black, white, size=size, komi=komi,
-                       move_limit=move_limit)
+        forfeit = None
+        try:
+            w = play_match(black, white, size=size, komi=komi,
+                           move_limit=move_limit)
+        except GameCrash as e:
+            w = -e.color              # the crashing side forfeits
+            forfeit = {"side": ("black" if e.color == pygo.BLACK
+                                else "white"),
+                       "error": f"{type(e.cause).__name__}: "
+                                f"{e.cause}"}
         idx = 2 if w == 0 else (0 if (w == pygo.BLACK) == a_is_black
                                 else 1)
         tally[idx] += 1
+        if forfeit is not None:
+            # idx of the WINNER is 0/1; the loser crashed
+            forfeits[1 - idx] += 1
         winner = "draw" if idx == 2 else names[idx]
         entry = {"game": g, "black": black_name, "white": white_name,
                  "winner": winner}
+        if forfeit is not None:
+            entry["forfeit"] = forfeit
         if log:
             log.write(json.dumps(entry) + "\n")
             log.flush()
+        note = (f" (forfeit by {forfeit['side']}: {forfeit['error']})"
+                if forfeit else "")
         print(f"game {g}: {black_name}(B) vs {white_name}(W) -> "
-              f"{winner}", file=sys.stderr)
+              f"{winner}{note}", file=sys.stderr)
     decided = max(tally[0] + tally[1], 1)
     return {"games": games,
             "wins": {names[0]: tally[0], names[1]: tally[1],
                      "draw": tally[2]},
+            "forfeits": {names[0]: forfeits[0],
+                         names[1]: forfeits[1]},
             # win rates are over decided games; draws reported apart
             "win_rate_a": tally[0] / decided,
             "win_rate_b": tally[1] / decided}

@@ -59,16 +59,41 @@ class TrainCheckpointer:
         return self.manager.latest_step()
 
     @retry(max_attempts=3, base_delay=0.5)
+    def _restore_step(self, template, step: int):
+        return self.manager.restore(
+            step, args=ocp.args.StandardRestore(template))
+
     def restore(self, template, step: int | None = None):
         """Restore into the structure/shardings of ``template``
-        (pass the freshly-initialized training pytree)."""
-        if step is None:
-            step = self.manager.latest_step()
-        if step is None:
+        (pass the freshly-initialized training pytree).
+
+        Fallback: a finalized-then-damaged newest step (a torn
+        directory on a flaky shared filesystem — files missing or
+        truncated AFTER Orbax's atomic rename) would otherwise
+        exhaust the transient retries and kill the resume. With no
+        explicit ``step`` requested, each failing step logs a warning
+        and restore falls back to the next-older retained step; an
+        explicitly requested step still raises (the caller asked for
+        THAT step, silently serving another would be a lie)."""
+        if step is not None:
+            return self._restore_step(template, step), step
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not steps:
             return None, None
-        restored = self.manager.restore(
-            step, args=ocp.args.StandardRestore(template))
-        return restored, step
+        last_exc = None
+        for i, s in enumerate(steps):
+            try:
+                return self._restore_step(template, s), s
+            except Exception as e:  # noqa: BLE001 — warned + fall back
+                last_exc = e
+                older = steps[i + 1] if i + 1 < len(steps) else None
+                tail = (f"; falling back to step {older}"
+                        if older is not None
+                        else "; no older step retained")
+                print(f"checkpoint: step {s} failed to restore "
+                      f"({type(e).__name__}: {e}){tail}",
+                      file=sys.stderr)
+        raise last_exc
 
     def wait(self) -> None:
         self.manager.wait_until_finished()

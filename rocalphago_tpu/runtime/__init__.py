@@ -14,7 +14,9 @@ survive the hardware (docs/RESILIENCE.md):
   barriers (``ROCALPHAGO_FAULT_PLAN=crash@iter3.post_save``), the
   mechanism the chaos tests use to prove exact resume;
 * :mod:`.watchdog` — a heartbeat thread that logs ``stall`` events
-  and can abort a hung run with a clean checkpoint.
+  and can abort a hung run with a clean checkpoint;
+* :mod:`.deadline` — hard wall-clock cutoffs for the serving path
+  (the play-side enforcer behind the GTP engine's anytime genmove).
 """
 
 from rocalphago_tpu.runtime.atomic import (  # noqa: F401
@@ -22,6 +24,7 @@ from rocalphago_tpu.runtime.atomic import (  # noqa: F401
     atomic_write_json,
     atomic_write_text,
 )
+from rocalphago_tpu.runtime.deadline import Deadline  # noqa: F401
 from rocalphago_tpu.runtime.faults import (  # noqa: F401
     FAULT_EXIT_CODE,
     FAULT_PLAN_ENV,

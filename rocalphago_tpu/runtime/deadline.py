@@ -1,0 +1,70 @@
+"""Hard wall-clock deadlines for the serving path.
+
+The GTP time machinery is PREDICTIVE: :class:`~rocalphago_tpu.search.
+clock.MoveClock` converts the per-move second budget into a simulation
+budget from a measured sims/sec estimate, and the search then runs
+that many simulations however long they take. A compile stall, a
+mispredicted rate, or a slow chunk simply blows the clock — the plan
+was wrong and nothing enforces it. :class:`Deadline` is the ENFORCER:
+an absolute ``time.monotonic`` timestamp threaded through the chunked
+search (``run_sims_chunked`` / the gumbel ``run_chunked`` in
+:mod:`rocalphago_tpu.search.device_mcts`), checked between compiled
+chunks. When it expires the search stops where it is and the caller
+serves the ANYTIME answer — argmax of the visits accumulated so far
+(the Gumbel searcher reranks its surviving candidates) — instead of
+trusting the prediction to the end.
+
+Division of labor: the ``MoveClock`` stays the planner (how many sims
+SHOULD fit), the ``Deadline`` is the enforcer (when the move MUST go
+out). The floor is one chunk: the first chunk always runs, so an
+already-expired deadline still yields a searched move and the caller
+returns within the deadline plus one chunk's wall time — the
+AlphaGo-lineage anytime contract (the policy prior itself is the
+rung below, served by the degradation ladder in
+:mod:`rocalphago_tpu.interface.resilient`).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Deadline:
+    """Absolute wall-clock cutoff (``time.monotonic`` domain).
+
+    ``Deadline(None)`` / ``Deadline.after(None)`` is the unlimited
+    deadline: ``expired()`` is always False and ``remaining()`` is
+    None, so callers thread one object unconditionally instead of
+    branching on "is there a clock at all".
+    """
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float | None):
+        self.at = at                  # monotonic timestamp, or None
+
+    @classmethod
+    def after(cls, seconds: float | None) -> "Deadline":
+        """Deadline ``seconds`` from now (None = unlimited; negative
+        budgets clamp to an already-expired deadline)."""
+        if seconds is None:
+            return cls(None)
+        return cls(time.monotonic() + max(float(seconds), 0.0))
+
+    @property
+    def unlimited(self) -> bool:
+        return self.at is None
+
+    def expired(self) -> bool:
+        return self.at is not None and time.monotonic() >= self.at
+
+    def remaining(self) -> float | None:
+        """Seconds left (clamped at 0.0), or None when unlimited."""
+        if self.at is None:
+            return None
+        return max(0.0, self.at - time.monotonic())
+
+    def __repr__(self) -> str:
+        if self.at is None:
+            return "Deadline(unlimited)"
+        return f"Deadline(in {self.at - time.monotonic():+.3f}s)"

@@ -22,6 +22,14 @@ wall time (GC pause, background load, an OS scheduling hiccup) would
 otherwise halve or double the next move's budget, which matters in
 exactly the timed tournament play the feature exists for. A median
 ignores a single outlier entirely until it repeats.
+
+The clock is the PLANNER only: its sims/playouts budget is a
+prediction, and nothing here stops a search whose chunks run slower
+than predicted. The ENFORCER is :class:`~rocalphago_tpu.runtime.
+deadline.Deadline` — the device player arms one from the same
+``move_time`` and the chunked search checks it between compiled
+chunks, serving the anytime argmax-visits answer on expiry
+(docs/RESILIENCE.md "Hard deadlines").
 """
 
 from __future__ import annotations

@@ -375,31 +375,54 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         return _root_stats(tree)
 
     def run_sims_chunked(params_p, params_v, tree: DeviceTree,
-                         chunk: int, n: int | None = None
-                         ) -> DeviceTree:
+                         chunk: int, n: int | None = None,
+                         deadline=None):
         """The one owner of the watchdog chunk schedule: ``n``
         (default ``n_sim``; a game clock may ask for fewer)
         simulations as ``chunk``-sized compiled programs, tree
-        device-resident in between."""
+        device-resident in between. Returns ``(tree, ran)`` — the
+        simulations actually dispatched.
+
+        ``deadline`` (a :class:`~rocalphago_tpu.runtime.deadline.
+        Deadline` or None) is the hard wall-clock enforcer: it is
+        checked before every chunk AFTER the first (the anytime floor
+        — an already-expired deadline still yields one searched
+        chunk), and the tree is blocked to ready between chunks while
+        a deadline is armed so the check sees real wall time, not
+        async dispatch latency. On expiry the tree is returned as-is;
+        argmax of its visits is the anytime answer."""
         n = n_sim if n is None else n
+        enforce = deadline is not None and not deadline.unlimited
+        ran = 0
         for done in range(0, n, chunk):
-            tree = run_sims(params_p, params_v, tree,
-                            k=min(chunk, n - done))
-        return tree
+            if ran and enforce and deadline.expired():
+                break
+            k = min(chunk, n - done)
+            # the chunk program is read off the ``search`` attribute
+            # (not the closure) so tests/instrumentation can wrap it
+            tree = search.run_sims(params_p, params_v, tree, k=k)
+            if enforce:
+                jax.block_until_ready(tree.n_nodes)
+            ran += k
+        return tree, ran
 
     def run_chunked(params_p, params_v, roots: GoState, chunk: int,
-                    tree: DeviceTree | None = None):
+                    tree: DeviceTree | None = None, deadline=None):
         """Full search as ``chunk``-simulation compiled programs with
         the tree device-resident in between — THE way to drive this
         on watchdog-limited backends (the ~40s TPU worker limit);
         identical results to :func:`search` (deterministic, the tree
-        carry is the entire state). Pass ``tree`` to resume from a
-        prepared tree (e.g. root priors mixed with exploration noise,
-        or a reused subtree) instead of ``init(roots)``."""
+        carry is the entire state) unless a ``deadline`` expires
+        mid-search, in which case the stats reflect the simulations
+        that fit. Pass ``tree`` to resume from a prepared tree (e.g.
+        root priors mixed with exploration noise, or a reused
+        subtree) instead of ``init(roots)``."""
         if tree is None:
             tree = search.init(params_p, params_v, roots)
-        return search.root_stats(
-            run_sims_chunked(params_p, params_v, tree, chunk))
+        tree, ran = run_sims_chunked(params_p, params_v, tree, chunk,
+                                     deadline=deadline)
+        search.last_ran = ran
+        return search.root_stats(tree)
 
     # chunk-driving surface (same convention as the chunked runners):
     # search.init → DeviceTree, search.run_sims(…, k=) → DeviceTree,
@@ -413,6 +436,7 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     search.simulate = simulate          # forced-root hook (Gumbel)
     search.advance_root = advance_root  # subtree reuse across moves
     search.max_nodes = max_nodes        # the slab size actually built
+    search.last_ran = None              # sims the last chunked run ran
     return search
 
 
@@ -602,18 +626,42 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     search = jax.jit(search_impl)
 
     def run_chunked(params_p, params_v, roots: GoState, rng,
-                    chunk: int):
+                    chunk: int, deadline=None):
         """Phase-by-phase, ``chunk``-simulation compiled programs with
         the tree device-resident in between (the ~40s TPU worker
-        watchdog); identical results to :func:`search`."""
+        watchdog); identical results to :func:`search` unless a
+        ``deadline`` (:class:`~rocalphago_tpu.runtime.deadline.
+        Deadline`) expires mid-plan. On expiry the halving stops
+        where it is, the SURVIVING candidates are reranked by the
+        evidence gathered so far, and ``best`` is the anytime answer
+        (``g + σ(q̂)`` argmax — the same rule a completed phase
+        applies, on a truncated schedule). The first chunk always
+        runs; ``search.last_ran`` reports the real simulation count.
+        """
         tree, g, cand, logits = init_j(params_p, params_v, roots, rng)
+        enforce = deadline is not None and not deadline.unlimited
+        ran, out_of_time = 0, False
         for k, v in schedule:
             total = k * v
             for j0 in range(0, total, chunk):
-                tree = run_phase(params_p, params_v, tree, g, cand,
-                                 jnp.int32(j0),
-                                 count=min(chunk, total - j0), k=k)
+                if ran and enforce and deadline.expired():
+                    out_of_time = True
+                    break
+                count = min(chunk, total - j0)
+                # read off the attribute (not the closure) so tests/
+                # instrumentation can wrap the compiled phase program
+                tree = search.run_phase(params_p, params_v, tree, g,
+                                        cand, jnp.int32(j0),
+                                        count=count, k=k)
+                if enforce:
+                    jax.block_until_ready(tree.n_nodes)
+                ran += count
+            # rerank even a truncated phase: the anytime ``best`` is
+            # the top candidate under whatever evidence exists
             cand = rerank_j(tree, g, cand, k)
+            if out_of_time:
+                break
+        search.last_ran = ran
         visits, q = base.root_stats(tree)
         return visits, q, cand[:, 0], improved_j(tree, logits)
 
@@ -630,6 +678,7 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     search.schedule = schedule
     search.m_root = m
     search.max_nodes = max_nodes        # the slab size actually built
+    search.last_ran = None              # sims the last chunked run ran
     return search
 
 
@@ -661,6 +710,22 @@ class DeviceMCTSPlayer:
     multiple — only already-compiled chunk programs run; gumbel
     quantizes to halvings of ``n_sim`` so at most log₂ tiers ever
     compile. ``last_n_sim`` reports what the last search really ran.
+
+    DEADLINE: the clock plan is predictive; the same ``seconds``
+    budget also arms a hard :class:`~rocalphago_tpu.runtime.deadline.
+    Deadline` checked between compiled chunks — a mispredicted
+    sims/sec rate or a slow chunk stops the search where it is and
+    the ANYTIME answer (argmax visits so far; the gumbel rerank of
+    the surviving candidates) goes out instead of blowing the wall
+    clock. The floor is one chunk. ``last_deadline_hit`` /
+    ``deadline_hits`` report enforcement; ``last_n_sim`` then shows
+    the truncated count.
+
+    ``sim_limit`` (int or None) caps the next searches' budget
+    regardless of the clock — the degradation ladder's reduced-sims
+    retry rung (:class:`~rocalphago_tpu.interface.resilient.
+    ResilientPlayer`) sets it for its one cheap re-dispatch after a
+    transient device error.
     """
 
     def __init__(self, value_net, policy_net, n_sim: int = 100,
@@ -696,6 +761,12 @@ class DeviceMCTSPlayer:
         # first run never pollutes the sims/sec EMA
         self._clock = MoveClock()
         self.last_n_sim = None      # sims the last get_move ran
+        # hard-deadline enforcement stats (class docstring DEADLINE)
+        self.last_deadline_hit = False
+        self.deadline_hits = 0
+        # external per-search sim cap (degradation ladder's reduced
+        # rung); None = uncapped
+        self.sim_limit: int | None = None
         # searchers are cached PER KOMI: the search's terminal-node
         # evaluations score with its GoConfig's komi, and GTP can set
         # any komi per game — same handling as the host MCTSPlayer's
@@ -706,6 +777,11 @@ class DeviceMCTSPlayer:
         # missing-value guard), not on the first genmove
         self._max_nodes = self._searcher_for(
             self._cfg.komi)[1].max_nodes
+
+    @property
+    def n_sim(self) -> int:
+        """Nominal per-move simulation budget (uncapped)."""
+        return self._n_sim
 
     def reset(self) -> None:
         """Forget cross-move search state (new game)."""
@@ -724,6 +800,9 @@ class DeviceMCTSPlayer:
         (the very first search — which pays the compiles anyway and
         seeds the estimate): full budget."""
         allowed = self._clock.allowed_units()
+        if self.sim_limit is not None:
+            allowed = (self.sim_limit if allowed is None
+                       else min(allowed, self.sim_limit))
         if allowed is None:
             return self._n_sim
         if self._gumbel:
@@ -812,6 +891,8 @@ class DeviceMCTSPlayer:
         from rocalphago_tpu.engine import jaxgo as _jaxgo
         from rocalphago_tpu.utils.coords import unflatten_idx
 
+        from rocalphago_tpu.runtime.deadline import Deadline
+
         komi = float(state.komi)
         eff = self._effective_sims()
         skey = (komi, eff if self._gumbel else self._n_sim)
@@ -819,16 +900,26 @@ class DeviceMCTSPlayer:
             komi, eff if self._gumbel else None)
         root = _jaxgo.from_pygo(cfg, state)
         roots = jax.tree.map(lambda x: x[None], root)
+        # the clock PLANNED eff sims; the deadline ENFORCES the wall
+        # budget between chunks (anytime answer on expiry). The first
+        # search per komi pays the compiles — no rate estimate exists
+        # yet and no deadline would be meaningful through a compile —
+        # so enforcement starts once the clock is warmed.
+        deadline = Deadline.after(
+            self._clock.move_time if self._clock.rate is not None
+            else None)
         t0 = time.monotonic()
         if self._gumbel:
             self._rng, sub = jax.random.split(self._rng)
             visits, _, best, _ = search.run_chunked(
                 self.policy.params, self.value.params, roots, sub,
-                self._chunk)
+                self._chunk, deadline=deadline)
             action = int(jax.device_get(best)[0])
             counts = np.asarray(jax.device_get(visits))[0]
             # a halving plan really runs its schedule total, not eff
-            ran = sum(k * v for k, v in search.schedule)
+            planned = sum(k * v for k, v in search.schedule)
+            ran = search.last_ran if search.last_ran is not None \
+                else planned
         else:
             tree = (self._reused_tree(search, state, komi, root)
                     if self._reuse else None)
@@ -840,16 +931,18 @@ class DeviceMCTSPlayer:
             # the clock owns the sim count: eff ≤ n_sim simulations
             # in chunk-sized compiled programs (same programs the
             # full budget runs — shrinking never recompiles)
-            tree = search.run_sims_chunked(
+            tree, ran = search.run_sims_chunked(
                 self.policy.params, self.value.params, tree,
-                self._chunk, n=eff)
+                self._chunk, n=eff, deadline=deadline)
+            planned = eff
             visits, _ = search.root_stats(tree)
             counts = np.asarray(jax.device_get(visits))[0]
             action = int(counts.argmax())
-            ran = eff
             if self._reuse:
                 self._carry = (komi, state.size, state.turns_played,
                                tree)
+        self.last_deadline_hit = ran < planned
+        self.deadline_hits += int(self.last_deadline_hit)
         self._clock.note(skey, ran, time.monotonic() - t0)
         self.last_n_sim = ran
         if action >= cfg.num_points or counts[action] == 0:

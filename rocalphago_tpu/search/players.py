@@ -150,10 +150,13 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
 def player_board(player) -> int | None:
     """Fixed board size the player's nets were compiled for, or None
     for size-agnostic players (shared by the GTP boardsize guard and
-    the tournament CLI's --board validation)."""
+    the tournament CLI's --board validation). Sees through wrappers
+    that expose the wrapped agent as ``primary`` (ResilientPlayer)."""
     board = getattr(player, "board", None)
     if board is None:
         board = getattr(getattr(player, "policy", None), "board", None)
+    if board is None and getattr(player, "primary", None) is not None:
+        board = player_board(player.primary)
     return board
 
 

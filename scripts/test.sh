@@ -16,9 +16,13 @@ cd "$(dirname "$0")/.."
 #
 # Fault tolerance: the default tier includes the chaos SMOKE
 # (tests/test_chaos.py::test_chaos_smoke_single_kill_resume — one
-# injected kill + exact resume of the 5x5 zero loop, ~1 min); the
-# full every-barrier chaos sweep is @slow and runs with --all. See
-# docs/RESILIENCE.md.
+# injected kill + exact resume of the 5x5 zero loop, ~1 min) and the
+# SERVING-chaos smoke (tests/test_serving_chaos.py fast tier —
+# injected faults at every genmove barrier/ladder rung, one fully
+# degraded 5x5 game, and the hard-deadline anytime proof, ~15 s);
+# the full every-barrier chaos sweeps (training kill/resume AND the
+# serving barrier×rung×kind sweep over the real device search) are
+# @slow and run with --all. See docs/RESILIENCE.md.
 ARGS=()
 TIER=(-m "not slow")
 for a in "$@"; do

@@ -456,3 +456,61 @@ def test_byoyomi_rebase_idempotent_and_snapshot_based():
     eng._genmoves[pygo.BLACK] = 11       # 5 report + 6 period stones
     assert eng._move_budget_s(pygo.BLACK) == pytest.approx(60.0 / 6)
     assert eng._time_left[pygo.BLACK] == (60.0, 6, 90.0, 11)
+
+
+def test_clock_starvation_floors_at_one_chunk():
+    """Satellite (ISSUE 2): a zero/tiny move budget must floor the
+    PUCT device search at ONE CHUNK and the gumbel search at its
+    halving-plan floor — never at zero simulations."""
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.clock import MoveClock
+    from rocalphago_tpu.search.device_mcts import (
+        DeviceMCTSPlayer,
+        gumbel_plan_sims,
+    )
+
+    clock = MoveClock()
+    clock.rate = 100.0
+    clock.set_move_time(0.0)
+    assert clock.allowed_units() == 0
+
+    pol = CNNPolicy(("board", "ones"), board=5, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(("board", "ones", "color"), board=5, layers=1,
+                   filters_per_layer=2)
+    player = DeviceMCTSPlayer(val, pol, n_sim=32, sim_chunk=8)
+    player._clock.rate = 100.0
+    player.set_move_time(0.0)
+    assert player._effective_sims() == 8          # one chunk, not 0
+    gp = DeviceMCTSPlayer(val, pol, n_sim=64, gumbel=True, m_root=4,
+                          sim_chunk=8)
+    gp._clock.rate = 100.0
+    gp.set_move_time(0.0)
+    tier = gp._effective_sims()
+    assert tier >= 2                              # plan floor, not 0
+    assert gumbel_plan_sims(tier, 4, 26) == gumbel_plan_sims(
+        max(2, tier // 2), 4, 26)
+
+
+def test_time_left_zero_still_produces_move():
+    """Satellite (ISSUE 2): GTP ``time_left <c> 0 0`` — a flagged
+    clock — must still produce a legal move within the ladder (the
+    floored one-chunk search), not an error or a stall."""
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol = CNNPolicy(("board", "ones"), board=5, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(("board", "ones", "color"), board=5, layers=1,
+                   filters_per_layer=2)
+    player = DeviceMCTSPlayer(val, pol, n_sim=8, sim_chunk=4,
+                              reuse=False)
+    eng = GTPEngine(player)
+    ok(eng, "boardsize 5")
+    ok(eng, "genmove b")                  # compile-bearing first move
+    player._clock.rate = 100.0            # warmed, deterministic rate
+    ok(eng, "time_left w 0 0")
+    assert eng._move_budget_s(pygo.WHITE) == 0.0
+    vertex = ok(eng, "genmove w")
+    assert vertex                          # a reply, not an error
+    assert player.last_n_sim == 4          # the one-chunk floor ran

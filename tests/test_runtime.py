@@ -326,3 +326,73 @@ def test_ladder_write_spec_never_clobbers_pool(tmp_path):
     with open(out) as f:
         spec = json.load(f)
     assert spec["weights_file"] == os.path.abspath(str(weights))
+
+
+# -------------------------------------------------------- deadline
+
+def test_deadline_semantics():
+    from rocalphago_tpu.runtime.deadline import Deadline
+
+    d = Deadline.after(None)
+    assert d.unlimited
+    assert not d.expired()
+    assert d.remaining() is None
+    d0 = Deadline.after(0)
+    assert d0.expired()
+    assert d0.remaining() == 0.0
+    assert Deadline.after(-5).expired()      # negative budgets clamp
+    d1 = Deadline.after(60)
+    assert not d1.expired()
+    assert 0 < d1.remaining() <= 60
+    assert "unlimited" in repr(d)
+
+
+def test_deadline_expires_with_wall_clock():
+    from rocalphago_tpu.runtime.deadline import Deadline
+
+    d = Deadline.after(0.05)
+    assert not d.expired()
+    time.sleep(0.08)
+    assert d.expired()
+    assert d.remaining() == 0.0
+
+
+# ---------------------------------------- checkpoint restore fallback
+
+def test_checkpoint_restore_falls_back_past_torn_step(tmp_path,
+                                                      capsys):
+    """Satellite (ISSUE 2): a finalized-then-damaged newest Orbax
+    step must not kill the resume — restore warns and falls back to
+    the next-older retained step. An EXPLICITLY requested step still
+    raises."""
+    import shutil
+
+    import numpy as np
+
+    from rocalphago_tpu.io.checkpoint import TrainCheckpointer
+
+    d = str(tmp_path / "ckpt")
+    ckpt = TrainCheckpointer(d, max_to_keep=3)
+    template = {"w": np.zeros(4, np.float32), "step": 0}
+    for s in (1, 2):
+        ckpt.save(s, {"w": np.full(4, float(s), np.float32),
+                      "step": s}, wait=True)
+    ckpt.wait()
+    assert ckpt.latest_step() == 2
+    # tear the newest step AFTER finalize: rip out its item payload
+    # (the torn-directory model — rename already happened, contents
+    # later damaged by the flaky filesystem)
+    item_dir = os.path.join(d, "2", "default")
+    assert os.path.isdir(item_dir)
+    shutil.rmtree(item_dir)
+
+    restored, step = ckpt.restore(template)
+    assert step == 1
+    assert restored["step"] == 1
+    assert restored["w"][0] == 1.0
+    err = capsys.readouterr().err
+    assert "falling back to step 1" in err
+
+    with pytest.raises(Exception):
+        ckpt.restore(template, step=2)       # asked-for step: honest
+    ckpt.close()

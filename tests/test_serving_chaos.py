@@ -1,0 +1,528 @@
+"""Serving chaos: break the play path at every barrier and ladder
+rung and prove ``cmd_genmove`` still answers a legal vertex.
+
+The training-side chaos suite (``test_chaos.py``) kills trainers and
+proves exact resume; a SERVING process has no resume — a GTP
+controller forfeits on any ``? error`` reply, so the invariant here
+is availability: with ``ROCALPHAGO_FAULT_PLAN``-style faults injected
+at the genmove serving barriers (``genmove.pre_search`` /
+``post_search`` / ``pre_apply``) and inside every degradation-ladder
+rung (``serve.search`` / ``reduced`` / ``policy`` / ``fallback``),
+genmove must still produce a legal move, the engine session must stay
+consistent (undo stack, side to move, clocks), and a full scripted
+5×5 game must complete end-to-end — with every degradation visible in
+``metrics.jsonl`` and the ``rocalphago-health`` counters.
+
+The fast tier covers one injected fault per engine barrier, each
+ladder rung, the hard-deadline anytime answer, and one fully degraded
+game; the slow sweep crosses fault kinds with every barrier/rung over
+the real device search, including a hang (``sleep``) abandoned by the
+watchdog.
+"""
+
+import json
+import os
+
+import pytest
+
+from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.interface.gtp import GTPEngine, vertex_to_move
+from rocalphago_tpu.interface.resilient import ResilientPlayer
+from rocalphago_tpu.io.metrics import MetricsLogger
+from rocalphago_tpu.runtime import faults
+from rocalphago_tpu.runtime.faults import InjectedFault
+from rocalphago_tpu.runtime.jsonl import read_jsonl
+
+SIZE = 5
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    """Tests install plans programmatically; always restore the
+    env-derived (empty) plan afterwards."""
+    yield
+    faults.install(None)
+
+
+@pytest.fixture(scope="module")
+def nets():
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+
+    pol = CNNPolicy(("board", "ones"), board=SIZE, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(("board", "ones", "color"), board=SIZE, layers=1,
+                   filters_per_layer=2)
+    return pol, val
+
+
+@pytest.fixture(scope="module")
+def device_player(nets):
+    """One compiled 5×5 device searcher shared by the module (XLA
+    compiles dominate; every test drives it through a fresh engine)."""
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol, val = nets
+    return DeviceMCTSPlayer(val, pol, n_sim=8, sim_chunk=4)
+
+
+class ScriptedPlayer:
+    """First sensible legal move; never fails (the well-behaved
+    baseline the faults are injected around)."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def get_move(self, state):
+        self.calls += 1
+        moves = state.get_legal_moves(include_eyes=False)
+        return moves[0] if moves else None
+
+
+class FailingPlayer:
+    """Raises ``exc_factory()`` on every get_move."""
+
+    def __init__(self, exc_factory):
+        self.exc_factory = exc_factory
+        self.calls = 0
+
+    def get_move(self, state):
+        self.calls += 1
+        raise self.exc_factory()
+
+
+class FlakyPlayer:
+    """Transient failure on the first call, then first-sensible moves
+    — the reduced-retry rung's success case. Advertises the
+    ``sim_limit``/``n_sim`` surface so the ladder exercises the
+    reduced-budget hook."""
+
+    n_sim = 8
+
+    def __init__(self):
+        self.calls = 0
+        self.sim_limit = None
+        self.limits_seen = []
+
+    def get_move(self, state):
+        self.calls += 1
+        self.limits_seen.append(self.sim_limit)
+        if self.calls == 1:
+            raise InjectedFault("transient device flake")
+        moves = state.get_legal_moves(include_eyes=False)
+        return moves[0] if moves else None
+
+
+class IllegalPlayer:
+    """Always answers an occupied point (after the first move)."""
+
+    def get_move(self, state):
+        return (0, 0)
+
+
+def ok(engine, line):
+    reply, _ = engine.handle(line)
+    assert reply.startswith("="), reply
+    return reply[1:].strip()
+
+
+def assert_legal_vertex(engine, vertex, state_before):
+    """The reply names pass or a point that was legal to play."""
+    if vertex == "pass":
+        return
+    move = vertex_to_move(vertex, engine.size)
+    assert state_before.is_legal(move), (vertex, move)
+
+
+# ------------------------------------------------------- engine barriers
+
+
+ENGINE_BARRIERS = ("genmove.pre_search", "genmove.post_search",
+                   "genmove.pre_apply")
+
+
+@pytest.mark.parametrize("barrier", ENGINE_BARRIERS)
+@pytest.mark.parametrize("kind", ("error", "io_error"))
+def test_engine_barrier_fault_still_moves(barrier, kind):
+    """A fault at any genmove serving barrier is absorbed: the reply
+    is a legal vertex, the move is applied, undo unwinds it, and the
+    side to move stays consistent."""
+    engine = GTPEngine(ScriptedPlayer())
+    ok(engine, "boardsize 5")
+    faults.install(f"{kind}@{barrier}")
+    before = engine.state.copy()
+    vertex = ok(engine, "genmove b")
+    assert_legal_vertex(engine, vertex, before)
+    assert engine.state.turns_played == 1
+    assert engine.state.current_player == pygo.WHITE
+    assert engine._serve.barrier_faults == 1
+    ok(engine, "undo")
+    assert engine.state.turns_played == 0
+    assert (engine.state.board == before.board).all()
+    # a clean follow-up genmove works (each spec fires once)
+    ok(engine, "genmove b")
+
+
+def test_raw_mode_surfaces_barrier_fault():
+    """resilient=False keeps the legacy contract: the fault becomes a
+    GTP error reply and the state is untouched."""
+    engine = GTPEngine(ScriptedPlayer(), resilient=False)
+    ok(engine, "boardsize 5")
+    faults.install("error@genmove.pre_search")
+    reply, _ = engine.handle("genmove b")
+    assert reply.startswith("?")
+    assert engine.state.turns_played == 0
+    assert engine.state.current_player == pygo.BLACK
+
+
+# -------------------------------------------------------- ladder rungs
+
+
+def test_nontransient_error_degrades_to_policy(nets):
+    pol, _ = nets
+    primary = FailingPlayer(lambda: RuntimeError("shape bug"))
+    engine = GTPEngine(ResilientPlayer(primary, policy=pol))
+    ok(engine, "boardsize 5")
+    before = engine.state.copy()
+    vertex = ok(engine, "genmove b")
+    assert_legal_vertex(engine, vertex, before)
+    serve = engine._serve
+    assert serve.served["policy"] == 1
+    assert serve.served["reduced"] == 0      # non-transient: no retry
+    assert primary.calls == 1
+    assert serve.last_fallback["reason"] == "error"
+
+
+def test_transient_error_retries_reduced(nets):
+    pol, _ = nets
+    primary = FlakyPlayer()
+    engine = GTPEngine(ResilientPlayer(primary, policy=pol))
+    ok(engine, "boardsize 5")
+    before = engine.state.copy()
+    vertex = ok(engine, "genmove b")
+    assert_legal_vertex(engine, vertex, before)
+    serve = engine._serve
+    assert serve.served["reduced"] == 1
+    assert primary.calls == 2
+    # the retry ran under the reduced sim cap, and the cap came off
+    assert primary.limits_seen == [None, max(1, FlakyPlayer.n_sim // 4)]
+    assert primary.sim_limit is None
+    assert serve.last_fallback["reason"] == "transient_error"
+
+
+def test_illegal_move_counted_and_degraded(nets):
+    """Satellite: an illegal move from the player is no longer a
+    silent pass — it degrades with reason ``illegal_from_player`` and
+    shows up in the health counters."""
+    pol, _ = nets
+    engine = GTPEngine(ResilientPlayer(IllegalPlayer(), policy=pol))
+    ok(engine, "boardsize 5")
+    ok(engine, "play b A1")                  # occupy (0, 0)
+    ok(engine, "play w C3")
+    before = engine.state.copy()
+    vertex = ok(engine, "genmove b")         # player answers A1 again
+    assert_legal_vertex(engine, vertex, before)
+    assert vertex != "pass"                  # policy rung found a move
+    serve = engine._serve
+    assert serve.illegal_from_player == 1
+    assert serve.served["policy"] == 1
+    health = json.loads(ok(engine, "rocalphago-health"))
+    assert health["illegal_from_player"] == 1
+    assert health["reasons"]["illegal_from_player"] == 1
+
+
+def test_fallback_rung_without_policy_net():
+    """No policy net: the ladder lands on the rules-oracle rung; a
+    fault injected INSIDE that rung still yields pass (unconditional
+    floor)."""
+    primary = FailingPlayer(lambda: RuntimeError("boom"))
+    engine = GTPEngine(ResilientPlayer(primary, policy=None))
+    ok(engine, "boardsize 5")
+    before = engine.state.copy()
+    vertex = ok(engine, "genmove b")
+    assert_legal_vertex(engine, vertex, before)
+    assert vertex != "pass"                  # sensible move exists
+    assert engine._serve.served["fallback"] == 1
+    # now break the fallback rung itself
+    faults.install("error@serve.fallback")
+    vertex = ok(engine, "genmove w")
+    assert vertex == "pass"
+    assert engine._serve.reasons["fallback_error"] == 1
+
+
+def test_hang_abandoned_by_watchdog(nets):
+    """A silent search (injected sleep) is abandoned at the hang
+    timeout — the PR-1 watchdog logs the stall — and the ladder
+    serves the policy rung instead of blocking the controller."""
+    import time
+
+    pol, _ = nets
+
+    class SleepyPlayer(ScriptedPlayer):
+        def get_move(self, state):
+            time.sleep(2.0)
+            return super().get_move(state)
+
+    engine = GTPEngine(ResilientPlayer(
+        SleepyPlayer(), policy=pol, hang_timeout_s=0.2))
+    ok(engine, "boardsize 5")
+    before = engine.state.copy()
+    t0 = time.monotonic()
+    vertex = ok(engine, "genmove b")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.5                     # did not wait out the hang
+    assert_legal_vertex(engine, vertex, before)
+    serve = engine._serve
+    assert serve.served["policy"] == 1
+    assert serve.reasons["hang"] == 1
+    assert serve.last_fallback["reason"] == "hang"
+
+
+# ------------------------------------------------------- health probes
+
+
+def test_health_and_stats_surface(nets):
+    pol, _ = nets
+    engine = GTPEngine(ScriptedPlayer())
+    ok(engine, "boardsize 5")
+    cmds = ok(engine, "list_commands")
+    assert "rocalphago-health" in cmds.split()
+    assert "rocalphago-stats" in cmds.split()
+    assert ok(engine, "known_command rocalphago-health") == "true"
+    ok(engine, "genmove b")
+    health = json.loads(ok(engine, "rocalphago-health"))
+    assert health["status"] == "ok"
+    assert health["genmoves"] == 1
+    assert health["degraded_total"] == 0
+    assert health["latency_s"]["p50"] is not None
+    assert health["last_fallback"] is None
+    stats = json.loads(ok(engine, "rocalphago-stats"))
+    assert stats["game"]["size"] == 5
+    assert stats["game"]["turns"] == 1
+    assert stats["genmoves"]["black"] == 1
+    assert stats["ladder"]["genmoves"] == 1
+
+
+def test_health_reports_degraded(nets):
+    pol, _ = nets
+    engine = GTPEngine(ResilientPlayer(
+        FailingPlayer(lambda: RuntimeError("boom")), policy=pol))
+    ok(engine, "boardsize 5")
+    ok(engine, "genmove b")
+    health = json.loads(ok(engine, "rocalphago-health"))
+    assert health["status"] == "degraded"
+    assert health["degradations"]["policy"] == 1
+    assert health["last_fallback"]["rung"] == "policy"
+
+
+# -------------------------------------------------- full degraded game
+
+
+def play_scripted_game(engine, max_genmoves=80):
+    """Alternate genmoves to a finished game (forcing the final
+    passes past the cap); every reply must be ``=`` and legal."""
+    colors = ("b", "w")
+    replies = 0
+    while not engine.state.is_end_of_game and replies < max_genmoves:
+        color = colors[replies % 2]
+        before = engine.state.copy()
+        vertex = ok(engine, f"genmove {color}")
+        assert_legal_vertex(engine, vertex, before)
+        replies += 1
+    if not engine.state.is_end_of_game:
+        side = colors[replies % 2]
+        ok(engine, f"play {side} pass")
+        ok(engine, f"play {colors[(replies + 1) % 2]} pass")
+    assert engine.state.is_end_of_game
+    return replies
+
+
+def test_full_degraded_game_completes(nets, tmp_path):
+    """Tier-1 smoke (ISSUE 2 chaos proof, fast half): a primary that
+    fails EVERY move plus an injected fault at the policy rung — the
+    whole 5×5 game still completes through the ladder, with the
+    degradation trail in metrics.jsonl and the health counters."""
+    pol, _ = nets
+    metrics_path = os.path.join(str(tmp_path), "metrics.jsonl")
+    metrics = MetricsLogger(metrics_path, echo=False)
+    primary = FailingPlayer(lambda: RuntimeError("device wedged"))
+    engine = GTPEngine(ResilientPlayer(primary, policy=pol,
+                                       metrics=metrics))
+    ok(engine, "boardsize 5")
+    faults.install("error@iter3.serve.policy")   # one EXTRA rung fault
+    genmoves = play_scripted_game(engine)
+    assert genmoves >= 5
+    ok(engine, "final_score")
+    serve = engine._serve
+    # every move degraded (primary always fails); the injected policy
+    # fault pushed exactly one move down to the rules-oracle rung
+    assert serve.served["search"] == 0
+    assert serve.served["policy"] == genmoves - 1
+    assert serve.served["fallback"] == 1
+    health = json.loads(ok(engine, "rocalphago-health"))
+    assert health["degraded_total"] == genmoves
+    events = [r for r in read_jsonl(metrics_path)
+              if r.get("event") == "degradation"]
+    assert len(events) >= genmoves
+    assert {e["reason"] for e in events} >= {"error"}
+    # undo still unwinds the whole game coherently
+    ok(engine, "undo")
+    assert not engine.state.is_end_of_game
+
+
+# --------------------------------------------------- deadline (anytime)
+
+
+def test_deadline_returns_anytime_answer(nets):
+    """ISSUE 2 deadline proof: with chunk wall time far above the
+    clock's prediction, ``get_move`` stops at the hard deadline and
+    serves argmax-visits-so-far — within deadline + one chunk's
+    slack, not the full planned budget."""
+    import time
+
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol, val = nets
+    player = DeviceMCTSPlayer(val, pol, n_sim=32, sim_chunk=2,
+                              reuse=False)
+    state = pygo.GameState(size=SIZE, komi=7.5)
+    player.get_move(state)                   # pay the compiles
+    cfg, search = player._searcher_for(7.5)
+    orig = search.run_sims
+    chunk_s = 0.08
+
+    def slow_run_sims(*args, **kwargs):
+        time.sleep(chunk_s)
+        return orig(*args, **kwargs)
+
+    search.run_sims = slow_run_sims
+    try:
+        # pathological prediction: the clock thinks the full 32 sims
+        # fit easily; really each 2-sim chunk costs ~80ms
+        player._clock.rate = 1e9
+        player._clock.note = lambda *a, **k: None
+        player.set_move_time(0.1)
+        t0 = time.monotonic()
+        move = player.get_move(state)
+        elapsed = time.monotonic() - t0
+    finally:
+        search.run_sims = orig
+    assert player.last_deadline_hit
+    assert player.deadline_hits == 1
+    assert player.last_n_sim < 32            # truncated plan
+    assert player.last_n_sim >= 2            # one-chunk anytime floor
+    # hard deadline + one chunk's slack (+ host margin)
+    assert elapsed < 0.1 + 2 * chunk_s + 0.3
+    assert move is None or state.is_legal(move)
+
+
+def test_deadline_unlimited_runs_full_budget(nets):
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol, val = nets
+    player = DeviceMCTSPlayer(val, pol, n_sim=8, sim_chunk=4,
+                              reuse=False)
+    state = pygo.GameState(size=SIZE, komi=7.5)
+    player.get_move(state)
+    assert player.last_n_sim == 8
+    assert not player.last_deadline_hit
+    assert player.deadline_hits == 0
+
+
+# ------------------------------------------------------ slow full sweep
+
+
+LADDER_PLANS = [
+    # one fault kind per rung barrier, plus compound plans that walk
+    # the ladder further down
+    "error@serve.search",
+    "io_error@serve.search",
+    "io_error@serve.search,error@serve.reduced",
+    "io_error@serve.search,io_error@serve.reduced",
+    "error@serve.search,error@serve.policy",
+    "io_error@serve.search,error@serve.reduced,error@serve.policy",
+    ("io_error@serve.search,error@serve.reduced,"
+     "error@serve.policy,error@serve.fallback"),
+] + [f"{kind}@{b}" for b in ENGINE_BARRIERS
+     for kind in ("error", "io_error")]
+
+
+@pytest.mark.slow
+def test_sweep_every_barrier_and_rung_device_search(device_player):
+    """The headline chaos sweep over the REAL device search: every
+    serving barrier and every ladder rung, both fault kinds — genmove
+    always answers a legal vertex and the session stays consistent."""
+    for plan in LADDER_PLANS:
+        engine = GTPEngine(device_player)
+        ok(engine, "boardsize 5")
+        faults.install(plan)
+        for color, expect_player in (("b", pygo.WHITE),
+                                     ("w", pygo.BLACK)):
+            before = engine.state.copy()
+            vertex = ok(engine, f"genmove {color}")
+            assert_legal_vertex(engine, vertex, before), plan
+            assert engine.state.current_player == expect_player
+        ok(engine, "undo")
+        ok(engine, "undo")
+        assert engine.state.turns_played == 0
+        faults.install(None)
+
+
+@pytest.mark.slow
+def test_full_device_game_under_faults(device_player, tmp_path):
+    """A full 5×5 game on the device search with faults sprinkled
+    through it (transient, programming, and a hang) completes with
+    the degradations on record."""
+    metrics_path = os.path.join(str(tmp_path), "metrics.jsonl")
+    serve = ResilientPlayer(device_player,
+                            metrics=MetricsLogger(metrics_path,
+                                                  echo=False),
+                            hang_timeout_s=1.0)
+    engine = GTPEngine(serve)
+    ok(engine, "boardsize 5")
+    faults.install("io_error@iter1.serve.search,"
+                   "error@iter4.serve.search,"
+                   "sleep@iter7.serve.search=3.0,"
+                   "error@genmove.pre_apply")
+    genmoves = play_scripted_game(engine)
+    assert genmoves >= 8
+    health = json.loads(ok(engine, "rocalphago-health"))
+    assert health["degraded_total"] >= 2     # reduced + policy at least
+    assert health["reasons"].get("hang", 0) == 1
+    events = [r for r in read_jsonl(metrics_path)
+              if r.get("event") in ("degradation", "stall")]
+    assert any(e.get("reason") == "transient_error" for e in events)
+    assert any(e["event"] == "stall" for e in events)
+
+
+@pytest.mark.slow
+def test_gumbel_deadline_anytime(nets):
+    """The gumbel searcher honors the deadline too: a truncated
+    halving plan still reranks and serves its surviving best."""
+    import time
+
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol, val = nets
+    player = DeviceMCTSPlayer(val, pol, n_sim=16, sim_chunk=2,
+                              gumbel=True, m_root=4)
+    state = pygo.GameState(size=SIZE, komi=7.5)
+    player.get_move(state)                   # compiles
+    _, search = player._searcher_for(7.5, 16)
+    orig = search.run_phase
+
+    def slow_run_phase(*args, **kwargs):
+        time.sleep(0.08)
+        return orig(*args, **kwargs)
+
+    search.run_phase = slow_run_phase
+    try:
+        player._clock.rate = 1e9
+        player._clock.note = lambda *a, **k: None
+        player.set_move_time(0.1)
+        move = player.get_move(state)
+    finally:
+        search.run_phase = orig
+    assert player.last_deadline_hit
+    planned = sum(k * v for k, v in search.schedule)
+    assert player.last_n_sim < planned
+    assert move is None or state.is_legal(move)

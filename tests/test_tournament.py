@@ -55,3 +55,79 @@ def test_run_tournament_rejects_bad_names():
     for names in (("X", "X"), ("draw", "B")):
         with pytest.raises(ValueError, match="names"):
             run_tournament(a, b, games=1, size=SIZE, names=names)
+
+
+# ------------------------------------------- per-game fault isolation
+
+
+class CrashingPlayer:
+    """Raises after ``good_moves`` successful first-sensible moves."""
+
+    def __init__(self, good_moves=0):
+        self.good_moves = good_moves
+        self.calls = 0
+
+    def get_move(self, state):
+        self.calls += 1
+        if self.calls > self.good_moves:
+            raise RuntimeError("kaboom")
+        moves = state.get_legal_moves(include_eyes=False)
+        return moves[0] if moves else None
+
+
+class StuckPlayer:
+    """Always answers the same point — an illegal move the second
+    time (occupied), which the rules engine rejects."""
+
+    def get_move(self, state):
+        return (0, 0)
+
+
+def test_play_match_raises_game_crash_naming_side():
+    from rocalphago_tpu.engine import pygo
+    from rocalphago_tpu.interface.tournament import GameCrash
+
+    _, good = make_players()
+    with pytest.raises(GameCrash) as ei:
+        play_match(CrashingPlayer(), good, size=SIZE, move_limit=40)
+    assert ei.value.color == pygo.BLACK
+    assert isinstance(ei.value.cause, RuntimeError)
+    with pytest.raises(GameCrash) as ei:
+        play_match(good, CrashingPlayer(), size=SIZE, move_limit=40)
+    assert ei.value.color == pygo.WHITE
+
+
+def test_play_match_rejected_move_is_a_crash():
+    """An illegal move the engine rejects forfeits the mover too —
+    the rules oracle is the arbiter, not the crashing player."""
+    from rocalphago_tpu.engine import pygo
+    from rocalphago_tpu.interface.tournament import GameCrash
+
+    _, good = make_players()
+    with pytest.raises(GameCrash) as ei:
+        play_match(StuckPlayer(), good, size=SIZE, move_limit=40)
+    assert ei.value.color == pygo.BLACK
+
+
+def test_run_tournament_isolates_crashing_games():
+    """Satellite: a raising game records a forfeit for the crashing
+    side and the tournament CONTINUES — one bad game no longer aborts
+    the run."""
+    _, good = make_players()
+    log = io.StringIO()
+    tally = run_tournament(CrashingPlayer(good_moves=1), good,
+                           games=4, size=SIZE, komi=5.5,
+                           move_limit=40, log=log)
+    assert tally["games"] == 4
+    assert tally["wins"]["B"] == 4           # opponent wins them all
+    assert tally["forfeits"] == {"A": 4, "B": 0}
+    assert tally["win_rate_b"] == 1.0
+    entries = [json.loads(line) for line in
+               log.getvalue().strip().splitlines()]
+    assert len(entries) == 4
+    for e in entries:
+        assert e["winner"] == "B"
+        assert "RuntimeError" in e["forfeit"]["error"]
+    # colors still alternate through the forfeits
+    assert [e["forfeit"]["side"] for e in entries] == \
+        ["black", "white", "black", "white"]
