@@ -37,6 +37,8 @@ import json
 import sys
 
 from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.obs import registry as obs_registry
+from rocalphago_tpu.obs import trace
 from rocalphago_tpu.runtime import faults
 
 COLS = "ABCDEFGHJKLMNOPQRSTUVWXYZ"  # GTP skips I
@@ -339,17 +341,23 @@ class GTPEngine:
         try:
             # inside the try: any genmove failure must restore the
             # side to move (raw mode; resilient mode only raises
-            # below for a game already over)
-            move = self._generate(color)
-            self._serving_barrier("genmove.pre_apply")
-            self._apply_move(move, color)
+            # below for a game already over). The span names this
+            # phase for watchdog stall events; the histogram backs
+            # the latency section of the stats probe.
+            with trace.span("gtp.genmove",
+                            turn=self.state.turns_played):
+                move = self._generate(color)
+                self._serving_barrier("genmove.pre_apply")
+                self._apply_move(move, color)
         except Exception:
             self.state.current_player = prev
             raise
         finally:
+            dt = _time.monotonic() - t0
             self._time_spent[color] = (self._time_spent.get(color, 0.0)
-                                       + _time.monotonic() - t0)
+                                       + dt)
             self._genmoves[color] = self._genmoves.get(color, 0) + 1
+            obs_registry.histogram("gtp_genmove_seconds").observe(dt)
         return move_to_vertex(move, self.size)
 
     def cmd_undo(self, args):
@@ -454,6 +462,10 @@ class GTPEngine:
             },
             "ladder": (self._serve.stats()
                        if self._serve is not None else None),
+            # the live process-wide metric registry (ladder-rung
+            # counters, genmove/chunk latency histograms, deadline
+            # margin — obs.registry; schema docs/OBSERVABILITY.md)
+            "registry": obs_registry.snapshot(),
         }
         return json.dumps(out, sort_keys=True)
 
@@ -677,6 +689,8 @@ def main(argv=None):
         from rocalphago_tpu.io.metrics import MetricsLogger
 
         metrics = MetricsLogger(a.metrics, echo=False)
+        # genmove spans + compile events join the serving metrics
+        trace.configure(metrics)
     run_gtp(make_player(a), metrics=metrics,
             resilient=not a.no_resilient,
             hang_timeout_s=a.genmove_timeout)

@@ -45,6 +45,8 @@ from __future__ import annotations
 import threading
 import time
 
+from rocalphago_tpu.obs import registry as obs_registry
+from rocalphago_tpu.obs import trace
 from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.runtime.retries import is_transient
 from rocalphago_tpu.runtime.watchdog import Watchdog
@@ -156,9 +158,14 @@ class ResilientPlayer:
                    else None)
 
         def protected():
-            faults.barrier(f"serve.{rung}",
-                           iteration=state.turns_played)
-            return fn(state)
+            # the rung span pins WHERE a hang happened: the watchdog's
+            # stall event reads the deepest open span across threads
+            # (obs.trace.where), which is this one when a rung wedges
+            with trace.span(f"serve.{rung}",
+                            turn=state.turns_played):
+                faults.barrier(f"serve.{rung}",
+                               iteration=state.turns_played)
+                return fn(state)
 
         if timeout is None:
             return protected()
@@ -228,6 +235,8 @@ class ResilientPlayer:
               turn: int) -> None:
         self.rung_failures[rung] += 1
         self.reasons[reason] = self.reasons.get(reason, 0) + 1
+        obs_registry.counter("serve_degradation_total", rung=rung,
+                             reason=reason).inc()
         if reason == "illegal_from_player":
             self.illegal_from_player += 1
         if self.metrics is not None:
@@ -244,6 +253,8 @@ class ResilientPlayer:
         self.barrier_faults += 1
         self.reasons["barrier_fault"] = \
             self.reasons.get("barrier_fault", 0) + 1
+        obs_registry.counter("serve_degradation_total", rung="barrier",
+                             reason="barrier_fault").inc()
         if self.metrics is not None:
             self.metrics.log("degradation", rung="barrier",
                              reason="barrier_fault", barrier=barrier,
@@ -269,6 +280,9 @@ class ResilientPlayer:
         finally:
             self.latencies.append(time.monotonic() - t0)
         self.served[rung] += 1
+        # ladder rungs as registry counters: the GTP stats probe and
+        # obs_report read served-per-rung without a ladder reference
+        obs_registry.counter("serve_rung_total", rung=rung).inc()
         self.last_rung = rung
         if rung != "search":
             self.last_fallback = {

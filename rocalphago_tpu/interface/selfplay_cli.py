@@ -22,6 +22,7 @@ import numpy as np
 from rocalphago_tpu.data import sgf
 from rocalphago_tpu.engine import jaxgo
 from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.runtime.atomic import atomic_write_json
 from rocalphago_tpu.search.selfplay import make_selfplay
@@ -185,9 +186,25 @@ def main(argv=None):
                             max_moves=a.max_moves,
                             temperature=a.temperature)
     faults.barrier("selfplay_cli.pre_play")
+    import time as _time
+
+    t0 = _time.monotonic()
     result = run(net.params, opp.params, jax.random.key(a.seed))
     jax.device_get(result.winners)
+    dt = max(_time.monotonic() - t0, 1e-9)
     faults.barrier("selfplay_cli.post_play")
+
+    # throughput + game-length telemetry (obs.registry): the headline
+    # games/min number plus a ply histogram an operator can read off
+    # the summary (or obs_report) instead of re-deriving from SGFs
+    num_moves = np.asarray(result.num_moves)
+    ply_h = obs_registry.histogram("selfplay_game_plies",
+                                   edges=obs_registry.COUNT_EDGES)
+    for moves in num_moves:
+        ply_h.observe(float(moves))
+    obs_registry.counter("selfplay_games_total").inc(a.games)
+    games_per_min = a.games * 60.0 / dt
+    obs_registry.gauge("selfplay_games_per_min").set(games_per_min)
 
     winners = np.asarray(result.winners)
     summary = {
@@ -195,7 +212,9 @@ def main(argv=None):
         "black_wins": int((winners > 0).sum()),
         "white_wins": int((winners < 0).sum()),
         "draws": int((winners == 0).sum()),
-        "mean_moves": float(np.asarray(result.num_moves).mean()),
+        "mean_moves": float(num_moves.mean()),
+        "games_per_min": round(games_per_min, 3),
+        "wall_s": round(dt, 3),
     }
     os.makedirs(a.out, exist_ok=True)
     if not a.no_sgf:
@@ -205,6 +224,9 @@ def main(argv=None):
             white_name=os.path.basename(a.opponent or a.policy))
         summary["sgf_files"] = len(paths)
         faults.barrier("selfplay_cli.post_sgf")
+    # the full counter/histogram state rides along in the summary
+    # (this CLI has no metrics.jsonl for obs_report to read)
+    summary["registry"] = obs_registry.snapshot()
     atomic_write_json(os.path.join(a.out, "summary.json"), summary)
     print(json.dumps(summary))
     return summary

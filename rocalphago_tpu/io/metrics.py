@@ -6,11 +6,25 @@ one JSON object per event to ``metrics.jsonl`` (step, loss, accuracy,
 games/min, …) — greppable, plottable, and the format ``bench.py``
 reuses. TensorBoard is intentionally not a dependency; the JSONL is
 trivially convertible.
+
+The same stream carries the observability subsystem's records
+(``span``/``compile``/``registry`` events — see
+:mod:`rocalphago_tpu.obs` and docs/OBSERVABILITY.md), emitted through
+:meth:`MetricsLogger.write` (file-only: high-rate telemetry must not
+spam the console ``log`` echoes).
+
+Strict-parser contract: non-finite floats (NaN/Inf — e.g. the
+``evaluate`` path's empty-split NaN) are sanitized to JSON ``null``
+before serialization, so no line ever contains a bare ``NaN``/
+``Infinity`` token (valid for ``json.loads`` only by a non-standard
+extension many parsers reject). ``json.dumps`` runs with
+``allow_nan=False`` to make the guarantee load-bearing.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 
@@ -21,7 +35,23 @@ import time
 from rocalphago_tpu.runtime.jsonl import read_jsonl  # noqa: F401
 
 
+def sanitize(value):
+    """Recursively replace non-finite floats with None (JSON null);
+    tuples become lists (their JSON form anyway)."""
+    if isinstance(value, float):           # incl. np.float64 subclass
+        return value if math.isfinite(value) else None
+    if isinstance(value, dict):
+        return {k: sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [sanitize(v) for v in value]
+    return value
+
+
 class MetricsLogger:
+    """Line-buffered JSONL event stream (``with``-able: closing is
+    ``close``; a crashed process that never exits the ``with`` loses
+    at most the in-flight line — tests/test_runtime.py pins that)."""
+
     def __init__(self, path: str | None, echo: bool = True):
         self.path = path
         self.echo = echo
@@ -33,10 +63,17 @@ class MetricsLogger:
         else:
             self._f = None
 
-    def log(self, event: str, **fields) -> None:
-        rec = {"event": event, "time": time.time(), **fields}
+    def write(self, event: str, **fields) -> None:
+        """File-only emission (no console echo) — the channel for
+        high-rate telemetry (spans, compile events, registry
+        snapshots)."""
+        rec = sanitize({"event": event, "time": time.time(), **fields})
         if self._f:
-            self._f.write(json.dumps(rec) + "\n")
+            self._f.write(json.dumps(rec, allow_nan=False) + "\n")
+
+    def log(self, event: str, **fields) -> None:
+        fields = sanitize(fields)
+        self.write(event, **fields)
         if self.echo:
             shown = " ".join(
                 f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
@@ -47,3 +84,9 @@ class MetricsLogger:
         if self._f:
             self._f.close()
             self._f = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -10,6 +10,12 @@ calls the caller's ``abort_fn``, whose job is to persist the last
 COMPLETED state (the in-flight iteration is unrecoverable from a
 sibling thread) and ``os._exit``. Logging mode just leaves a
 greppable trail for the operator.
+
+Stall events carry WHERE the process hung, not just that it hung:
+the ``span`` field is the deepest open tracing span across all
+threads (:func:`rocalphago_tpu.obs.trace.where`) at the moment the
+watchdog fired — e.g. ``zero.iteration/zero.selfplay`` — so the
+operator reads the stuck phase straight off ``metrics.jsonl``.
 """
 
 from __future__ import annotations
@@ -18,6 +24,8 @@ import os
 import sys
 import threading
 import time
+
+from rocalphago_tpu.obs import trace
 
 STALL_EXIT_CODE = 170
 
@@ -73,13 +81,15 @@ class Watchdog:
         self._last_beat = time.monotonic()
 
     def _log(self, elapsed: float) -> None:
+        at = trace.where()          # deepest open span, any thread
         if self.metrics is not None:
             self.metrics.log("stall", watchdog=self.name,
                              elapsed_s=round(elapsed, 1),
-                             deadline_s=self.deadline_s)
+                             deadline_s=self.deadline_s, span=at)
         else:
             print(f"watchdog[{self.name}]: no heartbeat for "
-                  f"{elapsed:.0f}s (deadline {self.deadline_s:.0f}s)",
+                  f"{elapsed:.0f}s (deadline {self.deadline_s:.0f}s)"
+                  f"{f' in {at}' if at else ''}",
                   file=sys.stderr)
 
     def _watch(self) -> None:

@@ -58,6 +58,8 @@ from rocalphago_tpu.engine.jaxgo import (
 )
 from rocalphago_tpu.features.planes import encode, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
+from rocalphago_tpu.obs import jaxobs
+from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.search.clock import MoveClock
 from rocalphago_tpu.search.selfplay import sensible_mask
 
@@ -390,20 +392,37 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         chunk), and the tree is blocked to ready between chunks while
         a deadline is armed so the check sees real wall time, not
         async dispatch latency. On expiry the tree is returned as-is;
-        argmax of its visits is the anytime answer."""
+        argmax of its visits is the anytime answer.
+
+        Observability: per-chunk latency/sims-per-sec histograms and
+        the deadline-margin gauge are recorded ONLY while a deadline
+        is armed — that path already blocks per chunk, so the numbers
+        are real execution time; the unenforced (training) path stays
+        fully async and records just the simulation counter."""
         n = n_sim if n is None else n
         enforce = deadline is not None and not deadline.unlimited
         ran = 0
+        t_start = time.monotonic()
         for done in range(0, n, chunk):
             if ran and enforce and deadline.expired():
                 break
             k = min(chunk, n - done)
             # the chunk program is read off the ``search`` attribute
             # (not the closure) so tests/instrumentation can wrap it
+            t0 = time.monotonic()
             tree = search.run_sims(params_p, params_v, tree, k=k)
             if enforce:
                 jax.block_until_ready(tree.n_nodes)
+                _chunk_h.observe(time.monotonic() - t0)
             ran += k
+        _sims_c.inc(ran)
+        if enforce:
+            elapsed = time.monotonic() - t_start
+            if elapsed > 0:
+                _rate_h.observe(ran / elapsed)
+            rem = deadline.remaining()
+            if rem is not None:
+                _margin_g.set(rem)
         return tree, ran
 
     def run_chunked(params_p, params_v, roots: GoState, chunk: int,
@@ -424,12 +443,22 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         search.last_ran = ran
         return search.root_stats(tree)
 
+    # serving-path telemetry (obs.registry): hoisted once per searcher
+    # so the chunk loop pays a method call, not a registry lookup
+    _chunk_h = obs_registry.histogram("device_mcts_chunk_seconds")
+    _rate_h = obs_registry.histogram("device_mcts_sims_per_s",
+                                     edges=obs_registry.RATE_EDGES)
+    _margin_g = obs_registry.gauge("device_mcts_deadline_margin_s")
+    _sims_c = obs_registry.counter("device_mcts_sims_total")
+
     # chunk-driving surface (same convention as the chunked runners):
     # search.init → DeviceTree, search.run_sims(…, k=) → DeviceTree,
     # search.root_stats(tree) → (visits, q); search.run_chunked =
-    # all three composed
-    search.init = jax.jit(init_tree)
-    search.run_sims = run_sims
+    # all three composed. init/run_sims are compile-tracked
+    # (obs.jaxobs): an unexpected recompile — a new chunk size, a new
+    # komi — surfaces as a named `compile` event.
+    search.init = jaxobs.track("device_mcts.init", jax.jit(init_tree))
+    search.run_sims = jaxobs.track("device_mcts.run_sims", run_sims)
     search.run_sims_chunked = run_sims_chunked
     search.root_stats = jax.jit(_root_stats)
     search.run_chunked = run_chunked
@@ -641,6 +670,7 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         tree, g, cand, logits = init_j(params_p, params_v, roots, rng)
         enforce = deadline is not None and not deadline.unlimited
         ran, out_of_time = 0, False
+        t_start = time.monotonic()
         for k, v in schedule:
             total = k * v
             for j0 in range(0, total, chunk):
@@ -650,17 +680,27 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                 count = min(chunk, total - j0)
                 # read off the attribute (not the closure) so tests/
                 # instrumentation can wrap the compiled phase program
+                t0 = time.monotonic()
                 tree = search.run_phase(params_p, params_v, tree, g,
                                         cand, jnp.int32(j0),
                                         count=count, k=k)
                 if enforce:
                     jax.block_until_ready(tree.n_nodes)
+                    _chunk_h.observe(time.monotonic() - t0)
                 ran += count
             # rerank even a truncated phase: the anytime ``best`` is
             # the top candidate under whatever evidence exists
             cand = rerank_j(tree, g, cand, k)
             if out_of_time:
                 break
+        _sims_c.inc(ran)
+        if enforce:
+            elapsed = time.monotonic() - t_start
+            if elapsed > 0:
+                _rate_h.observe(ran / elapsed)
+            rem = deadline.remaining()
+            if rem is not None:
+                _margin_g.set(rem)
         search.last_ran = ran
         visits, q = base.root_stats(tree)
         return visits, q, cand[:, 0], improved_j(tree, logits)
@@ -669,9 +709,17 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     rerank_j = jax.jit(rerank, static_argnames=("k",))
     improved_j = jax.jit(improved_policy)
 
+    # same serving-path telemetry as the PUCT chunk loop (shared
+    # metric names — one histogram serves both searchers)
+    _chunk_h = obs_registry.histogram("device_mcts_chunk_seconds")
+    _rate_h = obs_registry.histogram("device_mcts_sims_per_s",
+                                     edges=obs_registry.RATE_EDGES)
+    _margin_g = obs_registry.gauge("device_mcts_deadline_margin_s")
+    _sims_c = obs_registry.counter("device_mcts_sims_total")
+
     search.init = init_j
     search.rerank = rerank_j
-    search.run_phase = run_phase
+    search.run_phase = jaxobs.track("device_mcts.run_phase", run_phase)
     search.root_stats = base.root_stats
     search.improved_policy = improved_j
     search.run_chunked = run_chunked
@@ -767,6 +815,12 @@ class DeviceMCTSPlayer:
         # external per-search sim cap (degradation ladder's reduced
         # rung); None = uncapped
         self.sim_limit: int | None = None
+        # per-move telemetry (obs.registry): get_move is fully synced
+        # (the visit fetch), so these are real wall numbers
+        self._move_h = obs_registry.histogram(
+            "device_mcts_get_move_seconds")
+        self._rate_h = obs_registry.histogram(
+            "device_mcts_sims_per_s", edges=obs_registry.RATE_EDGES)
         # searchers are cached PER KOMI: the search's terminal-node
         # evaluations score with its GoConfig's komi, and GTP can set
         # any komi per game — same handling as the host MCTSPlayer's
@@ -943,7 +997,11 @@ class DeviceMCTSPlayer:
                                tree)
         self.last_deadline_hit = ran < planned
         self.deadline_hits += int(self.last_deadline_hit)
-        self._clock.note(skey, ran, time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._clock.note(skey, ran, dt)
+        self._move_h.observe(dt)
+        if dt > 0:
+            self._rate_h.observe(ran / dt)
         self.last_n_sim = ran
         if action >= cfg.num_points or counts[action] == 0:
             return None                              # pass
@@ -1071,6 +1129,10 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         return search.run_chunked(params_p, params_v, states,
                                   sim_chunk, tree=tree)
 
+    # per-ply wall time of search self-play (the done-fetch below
+    # syncs each ply, so the numbers are real)
+    _ply_h = obs_registry.histogram("selfplay_ply_seconds")
+
     def run(params_p, params_v, rng):
         states = new_states(cfg, batch)
         if mesh is not None:
@@ -1082,6 +1144,7 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
             states = meshlib.shard_batch(mesh, states)
         actions, lives, visit_seq = [], [], []
         for _ in range(max_moves):
+            t_ply = time.monotonic()
             if gumbel:
                 rng, sub = jax.random.split(rng)
                 visits, _, best, pi = search.run_chunked(
@@ -1117,7 +1180,9 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
             lives.append(live)
             if record_visits:
                 visit_seq.append(target)
-            if bool(jax.device_get(states.done.all())):
+            done = bool(jax.device_get(states.done.all()))
+            _ply_h.observe(time.monotonic() - t_ply)
+            if done:
                 break
         n_act = cfg.num_points + 1
         out = (states,

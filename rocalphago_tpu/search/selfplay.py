@@ -43,6 +43,7 @@ from rocalphago_tpu.engine.jaxgo import (
     winner,
 )
 from rocalphago_tpu.features.planes import encode, needs_member, true_eyes
+from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime import faults
 
 
@@ -239,6 +240,12 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
     finish = jax.jit(functools.partial(
         _finish, cfg, score_on_device=score_on_device, batch=batch))
 
+    # per-segment host wall time (real execution time under
+    # stop_when_done — its done-fetch syncs each segment — dispatch
+    # latency otherwise) + total plies dispatched
+    _seg_h = obs_registry.histogram("selfplay_segment_seconds")
+    _plies_c = obs_registry.counter("selfplay_plies_total")
+
     def run(params_a, params_b, rng,
             initial_states: GoState | None = None,
             deadline: float | None = None,
@@ -287,14 +294,18 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
             # bit-identical to the monolithic scan
             faults.barrier("selfplay.chunk", offset)
             length = min(chunk, max_moves - offset)
+            t0 = _time.monotonic()
             states, rng, actions, live = segment(
                 params_a, params_b, states, rng, jnp.int32(offset),
                 length)
             acts.append(actions)
             lives.append(live)
             plies = offset + length
-            if stop_when_done and bool(jax.device_get(
-                    states.done.all())):
+            _plies_c.inc(length)
+            done_now = (stop_when_done and bool(jax.device_get(
+                states.done.all())))
+            _seg_h.observe(_time.monotonic() - t0)
+            if done_now:
                 # zero-pad the skipped tail (see docstring): fixed
                 # output shapes keep the finish program at one compile
                 pad = max_moves - plies

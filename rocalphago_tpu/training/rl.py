@@ -53,6 +53,8 @@ from rocalphago_tpu.io.checkpoint import (
 )
 from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.obs import jaxobs, trace
+from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.parallel import mesh as meshlib
 from rocalphago_tpu.runtime import faults, retries
 from rocalphago_tpu.search.selfplay import (
@@ -228,6 +230,7 @@ def make_rl_iteration_chunked(cfg: jaxgo.GoConfig, features: tuple,
     replay_ply = _make_replay_ply(cfg, features, apply_fn, batch,
                                   temperature)
 
+    @jaxobs.track("rl.replay_segment")
     @functools.partial(jax.jit, static_argnames=("length",))
     def replay_segment(params, z, states, grads, actions, live,
                        offset, length):
@@ -244,7 +247,10 @@ def make_rl_iteration_chunked(cfg: jaxgo.GoConfig, features: tuple,
         key, game_key = jax.random.split(key)
         params = state.params
 
-        result = runner(params, opp_params, game_key)
+        # phase spans (see training.zero.iteration for the async-
+        # dispatch caveat: the caller's metrics fetch is the sync)
+        with trace.span("rl.play"):
+            result = runner(params, opp_params, game_key)
         z = _learner_z(result.winners, half)
 
         states = jaxgo.new_states(cfg, batch)
@@ -253,15 +259,17 @@ def make_rl_iteration_chunked(cfg: jaxgo.GoConfig, features: tuple,
         grads = jax.tree.map(jnp.zeros_like, params)
         live = result.live.astype(jnp.float32)
         plies = result.actions.shape[0]
-        for offset in range(0, plies, chunk):
-            length = min(chunk, plies - offset)
-            states, grads = replay_segment(
-                params, z, states, grads,
-                result.actions[offset:offset + length],
-                live[offset:offset + length],
-                jnp.int32(offset), length)
+        with trace.span("rl.replay", plies=plies):
+            for offset in range(0, plies, chunk):
+                length = min(chunk, plies - offset)
+                states, grads = replay_segment(
+                    params, z, states, grads,
+                    result.actions[offset:offset + length],
+                    live[offset:offset + length],
+                    jnp.int32(offset), length)
 
-        return update(state, grads, z, result.num_moves, key)
+        with trace.span("rl.update"):
+            return update(state, grads, z, result.num_moves, key)
 
     return iteration
 
@@ -389,6 +397,8 @@ class RLTrainer:
         self.metrics = MetricsLogger(
             os.path.join(cfg.out_dir, "metrics.jsonl")
             if self.coord else None, echo=self.coord)
+        # spans/compile events share the metrics stream (obs.trace)
+        trace.configure(self.metrics)
         self.start_iteration = 0
         self._maybe_resume()
 
@@ -417,13 +427,18 @@ class RLTrainer:
         if cfg.chunk:
             step = retries.retry(max_attempts=3, base_delay=1.0,
                                  logger=self.metrics.log)(step)
+        jaxobs.maybe_start_profiler()      # env-gated capture
         for it in range(self.start_iteration, cfg.iterations):
+          with trace.span("rl.iteration", iteration=it):
             faults.barrier("rl.pre_iteration", it)
-            opp_params, opp_name = self.pool.sample(
-                cfg.seed, it, save_every=cfg.save_every)
-            opp_params = meshlib.replicate(self.mesh, opp_params)
+            with trace.span("rl.data"):    # opponent-pool draw (I/O)
+                opp_params, opp_name = self.pool.sample(
+                    cfg.seed, it, save_every=cfg.save_every)
+                opp_params = meshlib.replicate(self.mesh, opp_params)
             t0 = time.time()
             self.state, m = step(self.state, opp_params)
+            # the win-rate fetch syncs the iteration's programs, so
+            # rl.iteration is real end-to-end wall time
             win = float(m["win_rate"])
             faults.barrier("rl.post_iteration", it)
             entry = {
@@ -437,6 +452,7 @@ class RLTrainer:
             meta.record_epoch(entry)
             final = entry
             if (it + 1) % cfg.save_every == 0 or it + 1 == cfg.iterations:
+              with trace.span("rl.save"):
                 # pool snapshot and exports BEFORE the checkpoint
                 # save (the commit point): a crash anywhere in here is
                 # healed by resume re-running the iteration and
@@ -451,6 +467,9 @@ class RLTrainer:
                     self.ckpt.wait()
                 faults.barrier("rl.post_save", it)
         self.ckpt.wait()
+        # the run's counter/histogram state, queryable by obs_report
+        obs_registry.log_to(self.metrics)
+        jaxobs.stop_profiler()
         return final
 
     def _export_weights(self, iteration: int) -> None:

@@ -46,6 +46,8 @@ from rocalphago_tpu.io.checkpoint import (
 )
 from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.obs import jaxobs, trace
+from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.parallel import mesh as meshlib
 from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.training.symmetries import random_transform_batch
@@ -190,15 +192,18 @@ class SLTrainer:
             params=jax.tree.map(lambda _: rep, self.net.params),
             opt_state=jax.tree.map(lambda _: rep, opt_state0),
             step=rep, rng=rep)
-        self._train_step = jax.jit(
+        # compile-tracked (obs.jaxobs): a recompile mid-run — a shape
+        # drifting between epochs — surfaces as a named `compile`
+        # event instead of a silent throughput cliff
+        self._train_step = jaxobs.track("sl.train_step", jax.jit(
             make_train_step(self.net.module.apply, tx, size, cfg.symmetries),
             in_shardings=(state_sh, batch_sh, act_sh),
             out_shardings=(state_sh, rep),
-            donate_argnums=(0,))
-        self._eval_step = jax.jit(
+            donate_argnums=(0,)))
+        self._eval_step = jaxobs.track("sl.eval_step", jax.jit(
             make_eval_step(self.net.module.apply, size * size),
             in_shardings=(state_sh.params, batch_sh, act_sh, act_sh),
-            out_shardings=rep)
+            out_shardings=rep))
 
         self.tx = tx
         # multi-host: artifact files are coordinator-only; Orbax saves
@@ -209,6 +214,8 @@ class SLTrainer:
         self.metrics = MetricsLogger(
             os.path.join(cfg.out_dir, "metrics.jsonl")
             if self.coord else None, echo=self.coord)
+        # spans/compile events share the metrics stream (obs.trace)
+        trace.configure(self.metrics)
 
         key = jax.random.key(cfg.seed)
         self.state = meshlib.replicate(self.mesh, SLState(
@@ -257,10 +264,16 @@ class SLTrainer:
                     "dataset_positions": len(self.dataset)},
             enabled=self.coord)
         steps_per_epoch = self._steps_per_epoch()
+        jaxobs.maybe_start_profiler()      # env-gated capture
+        # host wait per prefetched batch — the data-starvation probe
+        # (near-zero while the input pipeline keeps up with the step)
+        data_wait = obs_registry.histogram(
+            "train_data_wait_seconds", trainer="sl")
         # host RNG seeded per-epoch → identical batch order on re-run
         # of the same epoch after resume (reference shuffle.npz trick)
         final = {}
         for epoch in range(self.start_epoch, cfg.epochs):
+          with trace.span("sl.epoch", epoch=epoch):
             faults.barrier("sl.pre_epoch", epoch)
             skip = self._resume_skip if epoch == self.start_epoch else 0
             host_rng = np.random.default_rng(
@@ -272,8 +285,9 @@ class SLTrainer:
                   for b in it)
             t0 = time.time()
             losses, accs = [], []
-            for i, (planes, actions) in enumerate(
-                    device_prefetch(it, size=2)):
+            with trace.span("sl.train"):
+              for i, (planes, actions) in enumerate(obs_registry.timed(
+                      device_prefetch(it, size=2), data_wait)):
                 if i >= steps_per_epoch - skip:
                     break
                 self.state, m = self._train_step(
@@ -293,7 +307,8 @@ class SLTrainer:
             train_loss = float(jnp.mean(jnp.stack(losses)))
             train_acc = float(jnp.mean(jnp.stack(accs)))
             dt = time.time() - t0
-            val = self.evaluate(self.val_idx)
+            with trace.span("sl.eval"):
+                val = self.evaluate(self.val_idx)
             step = int(jax.device_get(self.state.step))
             entry = {
                 "epoch": epoch, "step": step,
@@ -306,14 +321,16 @@ class SLTrainer:
             # exports BEFORE the checkpoint save (the commit point): a
             # crash in between is healed by resume re-running the
             # epoch and rewriting identical artifacts atomically
-            self._export_weights(epoch)
-            faults.barrier("sl.pre_save", epoch)
-            self.ckpt.save(step, jax.device_get(self.state))
-            if faults.active():
-                # deterministic barrier: commit the async save before
-                # post_save (see training.zero)
-                self.ckpt.wait()
-            faults.barrier("sl.post_save", epoch)
+            with trace.span("sl.export"):
+                self._export_weights(epoch)
+            with trace.span("sl.save"):
+                faults.barrier("sl.pre_save", epoch)
+                self.ckpt.save(step, jax.device_get(self.state))
+                if faults.active():
+                    # deterministic barrier: commit the async save
+                    # before post_save (see training.zero)
+                    self.ckpt.wait()
+                faults.barrier("sl.post_save", epoch)
             final = entry
         # held-out test-split metric (BASELINE.md metric 1: top-1 move
         # accuracy) — recorded in metadata.json for tooling and
@@ -326,6 +343,9 @@ class SLTrainer:
                         test_accuracy=test["accuracy"])
             self.metrics.log("test", **test)
         self.ckpt.wait()
+        # the run's counter/histogram state, queryable by obs_report
+        obs_registry.log_to(self.metrics)
+        jaxobs.stop_profiler()
         return final
 
     def evaluate(self, indices, max_batches: int | None = None) -> dict:

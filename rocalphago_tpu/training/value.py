@@ -41,6 +41,8 @@ from rocalphago_tpu.io.checkpoint import (
 )
 from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.obs import jaxobs, trace
+from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.parallel import mesh as meshlib
 from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.training.sl import pad_batch
@@ -151,15 +153,17 @@ class ValueTrainer:
             params=jax.tree.map(lambda _: rep, self.net.params),
             opt_state=jax.tree.map(lambda _: rep, opt_state0),
             step=rep, rng=rep)
-        self._train_step = jax.jit(
+        # compile-tracked (obs.jaxobs): recompiles surface as named
+        # `compile` events (see training.sl)
+        self._train_step = jaxobs.track("value.train_step", jax.jit(
             make_train_step(self.net.module.apply, tx, cfg.symmetries),
             in_shardings=(state_sh, batch_sh, z_sh),
             out_shardings=(state_sh, rep),
-            donate_argnums=(0,))
-        self._eval_step = jax.jit(
+            donate_argnums=(0,)))
+        self._eval_step = jaxobs.track("value.eval_step", jax.jit(
             make_eval_step(self.net.module.apply),
             in_shardings=(state_sh.params, batch_sh, z_sh, z_sh),
-            out_shardings=rep)
+            out_shardings=rep))
 
         # multi-host: artifact files are coordinator-only; Orbax saves
         # stay all-process (SURVEY.md §2b "Multi-host")
@@ -169,6 +173,8 @@ class ValueTrainer:
         self.metrics = MetricsLogger(
             os.path.join(cfg.out_dir, "metrics.jsonl")
             if self.coord else None, echo=self.coord)
+        # spans/compile events share the metrics stream (obs.trace)
+        trace.configure(self.metrics)
         self.state = meshlib.replicate(self.mesh, ValueState(
             params=self.net.params,
             opt_state=opt_state0,
@@ -209,8 +215,13 @@ class ValueTrainer:
                     "dataset_positions": len(self.dataset)},
             enabled=self.coord)
         steps_per_epoch = self._steps_per_epoch()
+        jaxobs.maybe_start_profiler()      # env-gated capture
+        # host wait per prefetched batch (see training.sl)
+        data_wait = obs_registry.histogram(
+            "train_data_wait_seconds", trainer="value")
         final = {}
         for epoch in range(self.start_epoch, cfg.epochs):
+          with trace.span("value.epoch", epoch=epoch):
             faults.barrier("value.pre_epoch", epoch)
             skip = self._resume_skip if epoch == self.start_epoch else 0
             host_rng = np.random.default_rng(
@@ -221,7 +232,9 @@ class ValueTrainer:
             it = (meshlib.shard_batch(self.mesh, b) for b in it)
             t0 = time.time()
             losses = []
-            for i, (planes, z) in enumerate(device_prefetch(it, size=2)):
+            with trace.span("value.train"):
+              for i, (planes, z) in enumerate(obs_registry.timed(
+                      device_prefetch(it, size=2), data_wait)):
                 if i >= steps_per_epoch - skip:
                     break
                 self.state, m = self._train_step(self.state, planes, z)
@@ -238,7 +251,8 @@ class ValueTrainer:
                     "generate more data or shrink the minibatch")
             train_mse = float(jnp.mean(jnp.stack(losses)))
             dt = time.time() - t0
-            val = self.evaluate(self.val_idx)
+            with trace.span("value.eval"):
+                val = self.evaluate(self.val_idx)
             step = int(jax.device_get(self.state.step))
             entry = {
                 "epoch": epoch, "step": step,
@@ -250,14 +264,16 @@ class ValueTrainer:
             meta.record_epoch(entry)
             # exports before the checkpoint save (commit point) — same
             # crash-safe ordering as SLTrainer.run
-            self._export_weights(epoch)
-            faults.barrier("value.pre_save", epoch)
-            self.ckpt.save(step, jax.device_get(self.state))
-            if faults.active():
-                # deterministic barrier: commit the async save before
-                # post_save (see training.zero)
-                self.ckpt.wait()
-            faults.barrier("value.post_save", epoch)
+            with trace.span("value.export"):
+                self._export_weights(epoch)
+            with trace.span("value.save"):
+                faults.barrier("value.pre_save", epoch)
+                self.ckpt.save(step, jax.device_get(self.state))
+                if faults.active():
+                    # deterministic barrier: commit the async save
+                    # before post_save (see training.zero)
+                    self.ckpt.wait()
+                faults.barrier("value.post_save", epoch)
             final = entry
         # held-out test-split MSE (AlphaGo paper reports train+test MSE)
         if len(self.test_idx):
@@ -266,6 +282,9 @@ class ValueTrainer:
             meta.update(test_mse=test["mse"])
             self.metrics.log("test", **test)
         self.ckpt.wait()
+        # the run's counter/histogram state, queryable by obs_report
+        obs_registry.log_to(self.metrics)
+        jaxobs.stop_profiler()
         return final
 
     def evaluate(self, indices, max_batches: int | None = None) -> dict:

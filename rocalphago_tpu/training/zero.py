@@ -46,6 +46,7 @@ from rocalphago_tpu.engine import jaxgo
 from rocalphago_tpu.features.planes import encode, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
+from rocalphago_tpu.obs import jaxobs, trace
 from rocalphago_tpu.parallel import mesh as meshlib
 from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
 from rocalphago_tpu.search.selfplay import sensible_mask
@@ -160,6 +161,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         # share the ply's one group analysis with the rules step
         return (vstep(states, actions_t, gd), grads_p, grads_v, stats)
 
+    @jaxobs.track("zero.replay_segment")
     @jax.jit
     def replay_segment(policy_params, value_params, winners, finished,
                        carry, actions, live, visits):
@@ -172,6 +174,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         carry, _ = lax.scan(body, carry, (actions, live, visits))
         return carry
 
+    @jaxobs.track("zero.apply_updates")
     @jax.jit
     def apply_updates(state: ZeroState, grads_p, grads_v, stats,
                       winners, finished, num_moves, key):
@@ -213,13 +216,19 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         key = unpack_rng(state.rng)
         key, game_key = jax.random.split(key)
 
-        final, actions, live, visits = selfplay(
-            state.policy_params if sp_policy_params is None
-            else sp_policy_params,
-            state.value_params if sp_value_params is None
-            else sp_value_params, game_key)
-        winners = jax.vmap(
-            functools.partial(jaxgo.winner, cfg))(final)
+        # phase spans (data = search self-play, step = replay +
+        # update): host wall time per phase — the self-play loop
+        # syncs per ply (its done-fetch), so its span is honest; the
+        # replay spans measure dispatch, with the sync landing in the
+        # caller's metrics fetch (see docs/OBSERVABILITY.md)
+        with trace.span("zero.selfplay", plies=move_limit):
+            final, actions, live, visits = selfplay(
+                state.policy_params if sp_policy_params is None
+                else sp_policy_params,
+                state.value_params if sp_value_params is None
+                else sp_value_params, game_key)
+            winners = jax.vmap(
+                functools.partial(jaxgo.winner, cfg))(final)
         wf = winners.astype(jnp.float32)
         finished = final.done.astype(jnp.float32)
 
@@ -232,16 +241,19 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         live_f = live.astype(jnp.float32)
         plies = actions.shape[0]
         carry = (states, grads_p, grads_v, stats)
-        for offset in range(0, plies, replay_chunk):
-            sl = slice(offset, offset + replay_chunk)
-            carry = replay_segment(
-                state.policy_params, state.value_params, wf, finished,
-                carry, actions[sl], live_f[sl], visits[sl])
+        with trace.span("zero.replay", plies=plies):
+            for offset in range(0, plies, replay_chunk):
+                sl = slice(offset, offset + replay_chunk)
+                carry = replay_segment(
+                    state.policy_params, state.value_params, wf,
+                    finished, carry, actions[sl], live_f[sl],
+                    visits[sl])
         _, grads_p, grads_v, stats = carry
 
         num_moves = live.sum(axis=0, dtype=jnp.int32)
-        return apply_updates(state, grads_p, grads_v, stats, winners,
-                             finished, num_moves, key)
+        with trace.span("zero.update"):
+            return apply_updates(state, grads_p, grads_v, stats,
+                                 winners, finished, num_moves, key)
 
     return iteration
 
@@ -421,6 +433,7 @@ def run_training(argv=None) -> dict:
     )
     from rocalphago_tpu.io.metrics import MetricsLogger
     from rocalphago_tpu.models.nn_util import NeuralNetBase
+    from rocalphago_tpu.obs import registry as obs_registry
     from rocalphago_tpu.runtime import faults, retries
     from rocalphago_tpu.runtime.watchdog import Watchdog
 
@@ -495,6 +508,10 @@ def run_training(argv=None) -> dict:
                          "run aborts with the last completed "
                          "checkpoint (0 = off); resume picks up at "
                          "the aborted iteration")
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler trace of the run "
+                         "into this directory (also via "
+                         "ROCALPHAGO_JAX_PROFILE; default off)")
     a = ap.parse_args(argv)
     if a.gumbel and a.dirichlet_alpha > 0:
         raise SystemExit("--dirichlet-alpha is PUCT-mode root noise; "
@@ -559,6 +576,10 @@ def run_training(argv=None) -> dict:
     metrics = MetricsLogger(
         os.path.join(a.out_dir, "metrics.jsonl") if coord else None,
         echo=coord)
+    # observability: spans/compile events share the metrics stream;
+    # opt-in profiler capture (--profile-dir / env) brackets the run
+    trace.configure(metrics)
+    jaxobs.maybe_start_profiler(a.profile_dir)
     meta = MetadataWriter(
         os.path.join(a.out_dir, "metadata.json"),
         header={"cmd": " ".join(sys.argv), "config": vars(a)},
@@ -645,67 +666,80 @@ def run_training(argv=None) -> dict:
                             abort_fn=_stall_abort, name="zero").start()
 
     for it in range(start, a.iterations):
-        faults.barrier("zero.pre_iteration", it)
-        t0 = time.time()
-        state, m = run_iteration(state, best_p, best_v)
-        m = {k: float(jax.device_get(v)) for k, v in m.items()}
-        if watchdog is not None:
-            # the metrics fetch above synced the iteration's programs,
-            # so the beat marks real end-of-iteration
-            watchdog.beat()
-            last_done["state"] = jax.device_get(state)
-            last_done["step"] = it + 1
-        faults.barrier("zero.post_iteration", it)
-        entry = {"iteration": it, **m,
-                 "games_per_min": a.game_batch * 60.0
-                 / max(time.time() - t0, 1e-9)}
-        metrics.log("iteration", **entry)
-        meta.record_epoch(entry)
-        final = entry
-        if gate and ((it + 1) % gate_every == 0
-                     or it + 1 == a.iterations):
-            gkey, lkey = jax.random.split(
-                jax.random.fold_in(gate_root, it))
-            r = gate.match(state.policy_params, best_p, gkey)
-            promoted = r["win_rate_a"] >= gate.threshold
-            if promoted:
-                best_p, best_v = (state.policy_params,
-                                  state.value_params)
-                gate.promote(best_p, best_v, it + 1)
-            metrics.log("gate", iteration=it, promoted=promoted, **r)
-            # ladder probe: the (possibly new) incumbent vs a sampled
-            # past best — the monotonicity evidence round 4 lacked
-            snap = gate.sample(a.seed, it)
-            if snap is not None:
-                lp, _ = gate.load(snap, jax.device_get(
-                    state.policy_params), jax.device_get(
-                    state.value_params))
-                lr = gate.match(best_p, meshlib.replicate(mesh, lp),
-                                lkey)
-                metrics.log("ladder", iteration=it,
-                            opponent=snap[0], **lr)
-            faults.barrier("zero.post_gate", it)
-        if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
-            # exports BEFORE the checkpoint save: everything written
-            # before the save that commits step it+1 is reproduced by
-            # a resume from the previous checkpoint, so a crash at any
-            # point leaves artifacts a resume makes identical to the
-            # uninterrupted run (the save is the commit point)
-            export(it + 1)
-            faults.barrier("zero.post_export", it)
-            faults.barrier("zero.pre_save", it)
-            ckpt.save(it + 1, jax.device_get(state))
-            if faults.active():
-                # barriers are DETERMINISTIC points: under an active
-                # fault plan the async save commits before post_save,
-                # so crash@pre_save/post_save cleanly separate
-                # uncommitted from committed (a real crash can land
-                # anywhere — the chaos sweep covers that too)
-                ckpt.wait()
-            faults.barrier("zero.post_save", it)
+        with trace.span("zero.iteration", iteration=it):
+            faults.barrier("zero.pre_iteration", it)
+            t0 = time.time()
+            state, m = run_iteration(state, best_p, best_v)
+            # the fetch below syncs the iteration's device programs,
+            # so zero.iteration is real end-to-end wall time and the
+            # replay spans' async remainder lands inside this span,
+            # not outside it
+            m = {k: float(jax.device_get(v)) for k, v in m.items()}
+            if watchdog is not None:
+                watchdog.beat()
+                last_done["state"] = jax.device_get(state)
+                last_done["step"] = it + 1
+            faults.barrier("zero.post_iteration", it)
+            entry = {"iteration": it, **m,
+                     "games_per_min": a.game_batch * 60.0
+                     / max(time.time() - t0, 1e-9)}
+            metrics.log("iteration", **entry)
+            meta.record_epoch(entry)
+            final = entry
+            if gate and ((it + 1) % gate_every == 0
+                         or it + 1 == a.iterations):
+                with trace.span("zero.gate", iteration=it):
+                    gkey, lkey = jax.random.split(
+                        jax.random.fold_in(gate_root, it))
+                    r = gate.match(state.policy_params, best_p, gkey)
+                    promoted = r["win_rate_a"] >= gate.threshold
+                    if promoted:
+                        best_p, best_v = (state.policy_params,
+                                          state.value_params)
+                        gate.promote(best_p, best_v, it + 1)
+                    metrics.log("gate", iteration=it,
+                                promoted=promoted, **r)
+                    # ladder probe: the (possibly new) incumbent vs a
+                    # sampled past best — the monotonicity evidence
+                    # round 4 lacked
+                    snap = gate.sample(a.seed, it)
+                    if snap is not None:
+                        lp, _ = gate.load(snap, jax.device_get(
+                            state.policy_params), jax.device_get(
+                            state.value_params))
+                        lr = gate.match(
+                            best_p, meshlib.replicate(mesh, lp), lkey)
+                        metrics.log("ladder", iteration=it,
+                                    opponent=snap[0], **lr)
+                    faults.barrier("zero.post_gate", it)
+            if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
+                # exports BEFORE the checkpoint save: everything
+                # written before the save that commits step it+1 is
+                # reproduced by a resume from the previous
+                # checkpoint, so a crash at any point leaves
+                # artifacts a resume makes identical to the
+                # uninterrupted run (the save is the commit point)
+                with trace.span("zero.export", iteration=it):
+                    export(it + 1)
+                    faults.barrier("zero.post_export", it)
+                with trace.span("zero.save", iteration=it):
+                    faults.barrier("zero.pre_save", it)
+                    ckpt.save(it + 1, jax.device_get(state))
+                    if faults.active():
+                        # barriers are DETERMINISTIC points: under an
+                        # active fault plan the async save commits
+                        # before post_save, so crash@pre_save/
+                        # post_save cleanly separate uncommitted from
+                        # committed (a real crash can land anywhere —
+                        # the chaos sweep covers that too)
+                        ckpt.wait()
+                    faults.barrier("zero.post_save", it)
     ckpt.wait()
     if watchdog is not None:
         watchdog.stop()
+    # the run's counter/histogram state, queryable by obs_report
+    obs_registry.log_to(metrics)
+    jaxobs.stop_profiler()
     print(json.dumps(final))
     return final
 

@@ -1,0 +1,235 @@
+"""Render a run's observability report from its ``metrics.jsonl``.
+
+The capstone of the obs subsystem (docs/OBSERVABILITY.md): every
+trainer wraps its iteration phases in tracing spans and logs its
+metric-registry snapshot, all into the run directory's
+``metrics.jsonl``; this script turns that stream into the per-phase
+time breakdown and histogram summary a perf investigation starts
+from — which phase dominates an iteration, whether recompiles fired
+mid-run, where the genmove latency tail sits.
+
+Stdlib-only (reads through the crash-tolerant
+``rocalphago_tpu.runtime.jsonl`` reader — no jax import), so it runs
+anywhere, including on a laptop against a copied log.
+
+Usage:
+    python scripts/obs_report.py RUN_DIR_or_metrics.jsonl [--top N]
+    python scripts/obs_report.py --selftest   # fixture render (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from rocalphago_tpu.obs.registry import quantile_from_buckets  # noqa: E402
+from rocalphago_tpu.runtime.jsonl import read_jsonl  # noqa: E402
+
+
+def nearest_rank(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def span_stats(records) -> dict:
+    """``path -> {count, total_s, durs}`` over the span records."""
+    out: dict = {}
+    for r in records:
+        if r.get("event") != "span" or "path" not in r:
+            continue
+        s = out.setdefault(r["path"], {"count": 0, "total_s": 0.0,
+                                       "durs": [], "errors": 0})
+        d = float(r.get("dur_s") or 0.0)
+        s["count"] += 1
+        s["total_s"] += d
+        s["durs"].append(d)
+        if not r.get("ok", True):
+            s["errors"] += 1
+    for s in out.values():
+        s["durs"].sort()
+    return out
+
+
+def _fmt_s(v) -> str:
+    return "—" if v is None else f"{v:.3f}"
+
+
+def render_spans(stats: dict) -> str:
+    """Indented tree (paths sort parents before children), with each
+    span's share of its parent's total — the 'where did the time go'
+    table."""
+    if not stats:
+        return "(no span records)"
+    width = max(len(p) for p in stats) + 2
+    lines = [f"{'span':<{width}} {'count':>6} {'total_s':>9} "
+             f"{'mean_s':>8} {'p50_s':>8} {'p99_s':>8} {'%parent':>8}"]
+    for path in sorted(stats):
+        s = stats[path]
+        parent, _, name = path.rpartition("/")
+        share = ""
+        if parent and parent in stats and stats[parent]["total_s"] > 0:
+            frac = 100.0 * s["total_s"] / stats[parent]["total_s"]
+            share = f"{frac:.1f}%"
+        indent = "  " * path.count("/")
+        label = indent + name
+        err = f"  ({s['errors']} failed)" if s["errors"] else ""
+        lines.append(
+            f"{label:<{width}} {s['count']:>6} {s['total_s']:>9.3f} "
+            f"{_fmt_s(s['total_s'] / s['count']):>8} "
+            f"{_fmt_s(nearest_rank(s['durs'], 0.5)):>8} "
+            f"{_fmt_s(nearest_rank(s['durs'], 0.99)):>8} "
+            f"{share:>8}{err}")
+    return "\n".join(lines)
+
+
+def render_registry(snap: dict) -> str:
+    """Counters/gauges as-is; histograms as count/sum + estimated
+    p50/p99 (bucket upper edges) + the non-empty buckets."""
+    lines = []
+    for key, v in snap.get("counters", {}).items():
+        lines.append(f"counter   {key} = {v}")
+    for key, v in snap.get("gauges", {}).items():
+        lines.append(f"gauge     {key} = {v}")
+    for key, h in snap.get("histograms", {}).items():
+        p50 = quantile_from_buckets(h, 0.5)
+        p99 = quantile_from_buckets(h, 0.99)
+        prev = 0
+        occupied = []
+        for edge, cum in h["buckets"].items():
+            if cum > prev:
+                occupied.append(f"≤{edge}:{cum - prev}")
+            prev = cum
+        lines.append(
+            f"histogram {key}: count={h['count']} sum={h['sum']} "
+            f"p50≲{p50} p99≲{p99}  [{' '.join(occupied)}]")
+    return "\n".join(lines) if lines else "(no registry snapshot)"
+
+
+def render_events(records) -> str:
+    """Counts of the notable non-span events (compiles, stalls,
+    degradations, retries) — the 'did anything unusual happen' row."""
+    counts: dict = {}
+    for r in records:
+        ev = r.get("event")
+        if ev in ("compile", "stall", "degradation", "retry",
+                  "resume", "profiler"):
+            counts[ev] = counts.get(ev, 0) + 1
+    if not counts:
+        return "(none)"
+    return "  ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+
+
+def report(records, top: int | None = None) -> str:
+    stats = span_stats(records)
+    if top:
+        keep = sorted(stats, key=lambda p: -stats[p]["total_s"])[:top]
+        stats = {p: stats[p] for p in stats if p in keep}
+    reg = None
+    for r in records:            # last snapshot wins (end-of-run)
+        if r.get("event") == "registry" and "snapshot" in r:
+            reg = r["snapshot"]
+    parts = ["## per-phase time breakdown (span records)", "",
+             render_spans(stats), "",
+             "## notable events", "", render_events(records), "",
+             "## metric registry (last snapshot)", "",
+             render_registry(reg or {})]
+    return "\n".join(parts)
+
+
+# ---------------------------------------------------------- selftest
+
+FIXTURE = [
+    {"event": "span", "name": "zero.selfplay", "ok": True,
+     "path": "zero.iteration/zero.selfplay",
+     "parent": "zero.iteration", "depth": 1, "dur_s": 8.0},
+    {"event": "span", "name": "zero.replay", "ok": True,
+     "path": "zero.iteration/zero.replay",
+     "parent": "zero.iteration", "depth": 1, "dur_s": 1.5},
+    {"event": "span", "name": "zero.update", "ok": True,
+     "path": "zero.iteration/zero.update",
+     "parent": "zero.iteration", "depth": 1, "dur_s": 0.5},
+    {"event": "span", "name": "zero.iteration", "ok": True,
+     "path": "zero.iteration", "parent": None, "depth": 0,
+     "dur_s": 10.5, "iteration": 0},
+    {"event": "compile", "entry": "device_mcts.run_sims",
+     "dur_s": 3.2, "calls": 1, "recompile": False},
+    {"event": "registry", "snapshot": {
+        "counters": {'serve_rung_total{rung="search"}': 41,
+                     'serve_rung_total{rung="policy"}': 1},
+        "gauges": {"device_mcts_deadline_margin_s": 0.42},
+        "histograms": {"gtp_genmove_seconds": {
+            "count": 42, "sum": 33.6,
+            "buckets": {"0.5": 17, "1": 40, "2.5": 42,
+                        "+Inf": 42}}}}},
+]
+
+
+def selftest() -> int:
+    out = report(FIXTURE)
+    print(out)
+    needed = ("zero.selfplay", "zero.iteration", "76.2%",
+              "serve_rung_total", "gtp_genmove_seconds", "compile=1",
+              "p99≲2.5")
+    missing = [n for n in needed if n not in out]
+    if missing:
+        print(f"obs_report selftest FAILED: missing {missing}",
+              file=sys.stderr)
+        return 1
+    print("\nobs_report selftest OK", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-phase time breakdown + histogram summary "
+                    "from a run's metrics.jsonl")
+    ap.add_argument("run", nargs="?",
+                    help="run directory (containing metrics.jsonl) "
+                         "or a metrics.jsonl path")
+    ap.add_argument("--top", type=int, default=None,
+                    help="keep only the N paths with the largest "
+                         "total time")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable: dump the aggregated span "
+                         "stats + last registry snapshot as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="render the built-in fixture and verify the "
+                         "output (CI guard for the report path)")
+    a = ap.parse_args(argv)
+    if a.selftest:
+        return selftest()
+    if not a.run:
+        ap.error("RUN_DIR (or --selftest) required")
+    path = a.run
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    if not os.path.exists(path):
+        print(f"obs_report: no metrics.jsonl at {path}",
+              file=sys.stderr)
+        return 2
+    records = read_jsonl(path)
+    if a.json:
+        stats = {p: {k: v for k, v in s.items() if k != "durs"}
+                 for p, s in span_stats(records).items()}
+        reg = None
+        for r in records:
+            if r.get("event") == "registry" and "snapshot" in r:
+                reg = r["snapshot"]
+        print(json.dumps({"spans": stats, "registry": reg},
+                         sort_keys=True, indent=2))
+        return 0
+    print(f"# obs report — {path}\n")
+    print(report(records, top=a.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

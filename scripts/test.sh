@@ -23,6 +23,12 @@ cd "$(dirname "$0")/.."
 # the full every-barrier chaos sweeps (training kill/resume AND the
 # serving barrier×rung×kind sweep over the real device search) are
 # @slow and run with --all. See docs/RESILIENCE.md.
+#
+# Observability: tests/test_obs.py is tier-1 — span/registry/compile
+# -tracking units, a zero-trainer smoke asserting the per-phase span
+# records land in metrics.jsonl, and `scripts/obs_report.py
+# --selftest` (the fixture render), so the report path cannot rot
+# silently. See docs/OBSERVABILITY.md.
 ARGS=()
 TIER=(-m "not slow")
 for a in "$@"; do
