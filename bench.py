@@ -270,13 +270,22 @@ def _measure() -> None:
 
     run = make(batch, chunk)
 
-    def one(r):
+    # pipelined dispatch (runtime.pipeline): the measured reps run at
+    # the process-default depth (env ROCALPHAGO_PIPELINE_DEPTH / 1 —
+    # one segment in flight, done-poll one segment behind); the
+    # pipeline's host_gap_frac (fraction of wall time with nothing in
+    # flight) lands in the result line for the pipelined-vs-sync A/B
+    from rocalphago_tpu.runtime.pipeline import ChunkPipeline, default_depth
+    pipe = ChunkPipeline(runner="bench_headline")
+
+    def one(r, pipeline=pipe):
         # stop_when_done: games/min measures time to *finish* the
         # games — once every game has ended by two passes there is
         # nothing left to measure, and the early exit keeps full-game
         # (max_moves=300) runs well inside the budget
         res = run(net.params, net.params, jax.random.key(r),
-                  deadline=deadline, stop_when_done=True)
+                  deadline=deadline, stop_when_done=True,
+                  pipeline=pipeline)
         boards = jax.device_get(res.final.board)
         done_all = bool(jax.device_get(res.final.done.all()))
         # a deadline stop mid-run leaves games unfinished AND short of
@@ -293,6 +302,8 @@ def _measure() -> None:
     compile_valid = one(0)
     compile_dt = time.time() - tc0
 
+    pipe.reset_stats()      # the compile rep pollutes gap accounting
+
     # adaptive reps: stop once ~2 minutes of measurement accumulate
     # (or the deadline nears) so the round-end run always completes.
     # Only VALID reps' wall time enters dt — a deadline-truncated
@@ -308,6 +319,17 @@ def _measure() -> None:
         reps = r
         if measured > 120:
             break
+
+    # sync A/B rep (budget permitting): one rep at pipeline depth 0
+    # (the old per-segment host sync) so the result line carries both
+    # sides of the pipelined-vs-sync gap comparison. Same compiled
+    # programs — depth is host-side scheduling only.
+    gap_frac_sync = None
+    if reps and default_depth() > 0 \
+            and time.time() + compile_dt * 0.75 < deadline:
+        sync_pipe = ChunkPipeline(depth=0, runner="bench_headline_sync")
+        if one(reps + 1, pipeline=sync_pipe):
+            gap_frac_sync = round(sync_pipe.host_gap_frac, 4)
     includes_compile = False
     if reps:
         dt = measured / reps
@@ -342,7 +364,11 @@ def _measure() -> None:
         "batch": batch,
         "max_moves": max_moves,
         "chunk": chunk,
+        "pipeline_depth": default_depth(),
+        "host_gap_frac": round(pipe.host_gap_frac, 4),
     }
+    if gap_frac_sync is not None:
+        line["host_gap_frac_sync"] = gap_frac_sync
     if truncated:
         line["truncated"] = True
     if includes_compile:

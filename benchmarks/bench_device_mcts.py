@@ -16,7 +16,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-from benchmarks._harness import report, std_parser, timed  # noqa: E402
+from benchmarks._harness import report, std_parser  # noqa: E402
 
 
 def main() -> None:
@@ -61,26 +61,54 @@ def main() -> None:
         n_sim=args.sims, max_nodes=max_nodes)
     roots = new_states(GoConfig(size=args.board), batch)
 
-    # chunked driving on TPU: one compiled program per 8 simulations,
+    # chunked driving: one compiled program per chunk of simulations,
     # tree device-resident between calls — the ~40s worker watchdog
-    # must never see the whole search as one program
-    chunk = 8 if on_tpu else args.sims
+    # must never see the whole search as one program. Off-TPU the
+    # chunk still splits the search so the pipelined-vs-sync A/B
+    # below measures real chunk boundaries.
+    chunk = 8 if on_tpu else max(1, args.sims // 4)
     rng = [jax.random.key(0)]
 
-    def once():
+    def once(pipe):
         if args.gumbel:
             rng[0], sub = jax.random.split(rng[0])
             visits, _, _, _ = search.run_chunked(
-                policy.params, value.params, roots, sub, chunk)
+                policy.params, value.params, roots, sub, chunk,
+                pipeline=pipe)
         else:
             visits, _ = search.run_chunked(policy.params,
-                                           value.params, roots, chunk)
+                                           value.params, roots, chunk,
+                                           pipeline=pipe)
         return jax.device_get(visits)
 
-    dt = timed(once, reps=args.reps, profile_dir=args.profile)
-    report("device_mcts_sims", batch * plan_sims / dt, "sims/s",
-           batch=batch, sims=plan_sims, max_nodes=max_nodes,
-           board=args.board, gumbel=args.gumbel)
+    # pipelined-vs-sync A/B: depth 0 = the old per-chunk host sync,
+    # depth 1 = one chunk in flight while the host decides
+    # (runtime.pipeline). Same compiled programs either way — the A/B
+    # pays no extra compiles; host_gap_frac is the fraction of wall
+    # time the device had nothing in flight.
+    import time as _time
+
+    from rocalphago_tpu.runtime.pipeline import ChunkPipeline
+
+    for depth in (0, 1):
+        pipe = ChunkPipeline(depth=depth, runner="bench_device_mcts")
+        once(pipe)                       # warmup/compile rep
+        pipe.drain()                     # clear the async tail
+        pipe.reset_stats()
+        if args.profile and depth == 1:
+            jax.profiler.start_trace(args.profile)
+        t0 = _time.time()
+        for _ in range(args.reps):
+            once(pipe)
+        pipe.drain()
+        dt = (_time.time() - t0) / args.reps
+        if args.profile and depth == 1:
+            jax.profiler.stop_trace()
+        report("device_mcts_sims", batch * plan_sims / dt, "sims/s",
+               batch=batch, sims=plan_sims, max_nodes=max_nodes,
+               board=args.board, gumbel=args.gumbel,
+               pipeline_depth=depth,
+               host_gap_frac=round(pipe.host_gap_frac, 4))
 
 
 if __name__ == "__main__":

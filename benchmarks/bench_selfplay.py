@@ -96,6 +96,44 @@ def main() -> None:
                batch=batch, board=args.board,
                seed_plies=args.seed_plies, **extra)
 
+    # pipelined-vs-sync A/B over a MULTI-segment run (the single-
+    # segment program above has no chunk boundary to pipeline): four
+    # `--plies`-ply segments with the done-poll on, once at depth 0
+    # (per-segment host sync — the old behavior) and once at depth 1
+    # (one segment in flight while the host reads the LAGGED
+    # done-scalar; runtime.pipeline). Same compiled segment program
+    # both ways; host_gap_frac = fraction of wall time with nothing
+    # in flight.
+    import time as _time
+
+    from rocalphago_tpu.runtime.pipeline import ChunkPipeline
+
+    ab_batch = max(batches)
+    ab_states = jax.tree.map(lambda x: x[:ab_batch], mid)
+    ab_run = make_selfplay_chunked(
+        cfg, net.feature_list, net.module.apply, net.module.apply,
+        ab_batch, args.plies * 4, chunk=args.plies,
+        score_on_device=False)
+    for depth in (0, 1):
+        pipe = ChunkPipeline(depth=depth, runner="bench_selfplay")
+
+        def once_ab():
+            res = ab_run(net.params, net.params, jax.random.key(2),
+                         initial_states=ab_states,
+                         stop_when_done=True, pipeline=pipe)
+            return jax.device_get(res.final.board)
+
+        once_ab()                        # warmup/compile rep
+        pipe.reset_stats()
+        t0 = _time.time()
+        for _ in range(args.reps):
+            once_ab()
+        dt = (_time.time() - t0) / args.reps
+        report("selfplay_pipeline", ab_batch * args.plies * 4 / dt,
+               "board-plies/s", batch=ab_batch, board=args.board,
+               seed_plies=args.seed_plies, pipeline_depth=depth,
+               host_gap_frac=round(pipe.host_gap_frac, 4))
+
 
 if __name__ == "__main__":
     main()

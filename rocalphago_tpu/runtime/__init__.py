@@ -16,7 +16,10 @@ survive the hardware (docs/RESILIENCE.md):
 * :mod:`.watchdog` — a heartbeat thread that logs ``stall`` events
   and can abort a hung run with a clean checkpoint;
 * :mod:`.deadline` — hard wall-clock cutoffs for the serving path
-  (the play-side enforcer behind the GTP engine's anytime genmove).
+  (the play-side enforcer behind the GTP engine's anytime genmove);
+* :mod:`.pipeline` — pipelined chunk dispatch (keep a compiled chunk
+  in flight while the host decides), the scheduling layer every
+  chunked hot loop drives its per-chunk host decisions through.
 """
 
 from rocalphago_tpu.runtime.atomic import (  # noqa: F401
@@ -35,7 +38,12 @@ from rocalphago_tpu.runtime.jsonl import (  # noqa: F401
     iter_jsonl,
     read_jsonl,
 )
+from rocalphago_tpu.runtime.pipeline import (  # noqa: F401
+    ChunkPipeline,
+    default_depth,
+)
 from rocalphago_tpu.runtime.retries import (  # noqa: F401
+    donates,
     is_transient,
     retry,
     retry_call,

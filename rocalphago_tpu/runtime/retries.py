@@ -18,9 +18,15 @@ Only retry PURE work: a functional train step (state in, new state
 out) or an idempotent artifact write. Never wrap a step whose input
 buffers were donated to the device program — after a failed dispatch
 the donated buffers may already be invalid, so the retry would
-compute on garbage (the monolithic RL iteration stays unwrapped for
-exactly this reason; the chunked/host-driven iterations don't
-donate).
+compute on garbage. Since the pipelined-dispatch PR this hazard is
+ENFORCED, not just documented: chunk programs that donate advertise
+``donates_buffers = True`` (the convention
+``runtime.pipeline``-driven loops follow), and :func:`retry` /
+:func:`retry_call` refuse to wrap such a callable with an explicit
+``ValueError``. Retry remains valid one level up — the chunked
+iterations confine donation to loop-internal carries and re-derive
+them from never-donated state, so wrapping the *iteration* is safe
+(and is what the trainers do).
 """
 
 from __future__ import annotations
@@ -81,6 +87,14 @@ def backoff_delay(attempt: int, base: float, cap: float,
     return envelope * (0.5 + 0.5 * frac)
 
 
+def donates(fn) -> bool:
+    """Does ``fn`` declare that it donates its input buffers?
+    Convention: donating jitted chunk programs (and wrappers around
+    them, e.g. ``obs.jaxobs.track``, which delegates attributes) set
+    ``donates_buffers = True``."""
+    return bool(getattr(fn, "donates_buffers", False))
+
+
 def retry(max_attempts: int = 3, base_delay: float = 0.5,
           max_delay: float = 30.0, classify=is_transient,
           seed: int = 0, sleep=time.sleep, logger=None):
@@ -92,11 +106,25 @@ def retry(max_attempts: int = 3, base_delay: float = 0.5,
     ``logger`` (optional callable, e.g. ``MetricsLogger.log``) gets
     ``("retry", attempt=..., of=..., error=..., delay_s=...)`` per
     retry so flake is visible in metrics.jsonl.
+
+    Refuses (``ValueError``, at wrap time) a callable that declares
+    ``donates_buffers = True``: after ANY dispatch — including a
+    failed one — the donated inputs may be invalid, so re-invoking
+    with the same arguments would compute on garbage. Wrap the
+    enclosing iteration (which rebuilds its donated carries from
+    never-donated state) instead.
     """
     if max_attempts < 1:
         raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
 
     def decorate(fn):
+        if donates(fn):
+            raise ValueError(
+                f"retry would re-dispatch {getattr(fn, '__name__', fn)!r}"
+                " whose inputs are DONATED (donates_buffers=True) — a "
+                "failed attempt may already have invalidated them. "
+                "Retry the enclosing iteration instead (see "
+                "runtime/retries.py module docstring).")
         key = getattr(fn, "__qualname__", None) or repr(fn)
 
         @functools.wraps(fn)

@@ -60,6 +60,8 @@ from rocalphago_tpu.features.planes import encode, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.obs import jaxobs
 from rocalphago_tpu.obs import registry as obs_registry
+from rocalphago_tpu.runtime import faults
+from rocalphago_tpu.runtime.pipeline import ChunkPipeline
 from rocalphago_tpu.search.clock import MoveClock
 from rocalphago_tpu.search.selfplay import sensible_mask
 
@@ -376,57 +378,103 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         tree = run_sims(params_p, params_v, tree, n_sim)
         return _root_stats(tree)
 
+    # the chunk loop's program: same trace as run_sims, but the tree
+    # slab is DONATED into the program so a pipelined loop (one chunk
+    # in flight while the next is prepared) never holds two slabs.
+    # Callers that keep their tree use `run_sims` (non-donating);
+    # the loop below protects a non-owned input with one copy.
+    run_sims_donated = functools.partial(
+        jax.jit, static_argnames=("k",), donate_argnums=(2,))(
+        lambda params_p, params_v, tree, k: lax.fori_loop(
+            0, k, lambda _, t: simulate(params_p, params_v, t), tree))
+
+    copy_tree = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
     def run_sims_chunked(params_p, params_v, tree: DeviceTree,
                          chunk: int, n: int | None = None,
-                         deadline=None):
+                         deadline=None, depth: int | None = None,
+                         pipeline: ChunkPipeline | None = None,
+                         owned: bool = False):
         """The one owner of the watchdog chunk schedule: ``n``
         (default ``n_sim``; a game clock may ask for fewer)
         simulations as ``chunk``-sized compiled programs, tree
         device-resident in between. Returns ``(tree, ran)`` — the
         simulations actually dispatched.
 
+        PIPELINED (``runtime.pipeline``): the loop dispatches through
+        a :class:`ChunkPipeline` (``depth`` in-flight chunks; default
+        env/1, ``depth=0`` = the old fully-sync behavior; pass
+        ``pipeline`` to share one across calls, e.g. a bench A/B) and
+        DONATES the tree slab into each chunk program so pipelining
+        never doubles slab memory. The input ``tree`` is treated as
+        caller-owned and copied once before the first donation —
+        callers that hand the tree over (the player, the self-play
+        loop) pass ``owned=True`` to skip the copy. Results are
+        bit-identical to the sync path at any depth: same programs,
+        same operands, same order.
+
         ``deadline`` (a :class:`~rocalphago_tpu.runtime.deadline.
         Deadline` or None) is the hard wall-clock enforcer: it is
         checked before every chunk AFTER the first (the anytime floor
         — an already-expired deadline still yields one searched
-        chunk), and the tree is blocked to ready between chunks while
-        a deadline is armed so the check sees real wall time, not
-        async dispatch latency. On expiry the tree is returned as-is;
-        argmax of its visits is the anytime answer.
+        chunk). The pipeline paces the host to real device completion
+        lagged by ``depth`` chunks, so on expiry at most ``depth``
+        chunks (one, at the default) are still in flight — they
+        complete, their simulations count, and argmax of the returned
+        tree's visits is the anytime answer; the hard-stop overshoot
+        is bounded by those in-flight chunks (docs/RESILIENCE.md).
 
-        Observability: per-chunk latency/sims-per-sec histograms and
-        the deadline-margin gauge are recorded ONLY while a deadline
-        is armed — that path already blocks per chunk, so the numbers
-        are real execution time; the unenforced (training) path stays
-        fully async and records just the simulation counter."""
+        Observability: per-chunk latency is recorded only at
+        ``depth=0`` (the only mode that can attribute wall time to
+        one chunk); the pipeline records ``dispatch_gap_s`` /
+        ``device_occupancy`` at any depth, and sims-per-sec plus the
+        deadline-margin gauge are recorded while a deadline is armed
+        (the enforced path drains, so the numbers are real execution
+        time)."""
         n = n_sim if n is None else n
         enforce = deadline is not None and not deadline.unlimited
+        pipe = pipeline if pipeline is not None else ChunkPipeline(
+            depth, runner="device_mcts")
+        if not owned and n > 0:
+            tree = copy_tree(tree)   # first donation eats our copy,
+            #                          never the caller's buffers
         ran = 0
         t_start = time.monotonic()
         for done in range(0, n, chunk):
             if ran and enforce and deadline.expired():
                 break
+            faults.barrier("search.chunk", done // chunk)
             k = min(chunk, n - done)
             # the chunk program is read off the ``search`` attribute
             # (not the closure) so tests/instrumentation can wrap it
             t0 = time.monotonic()
-            tree = search.run_sims(params_p, params_v, tree, k=k)
-            if enforce:
-                jax.block_until_ready(tree.n_nodes)
+            tree = search.run_sims_donated(params_p, params_v, tree,
+                                           k=k)
+            # the pipeline handle must be a FRESH array: the next
+            # chunk donates the tree itself, which would delete
+            # n_nodes out from under the retire's block
+            pipe.push(tree.n_nodes + 0)
+            if enforce and pipe.depth == 0:
                 _chunk_h.observe(time.monotonic() - t0)
             ran += k
         _sims_c.inc(ran)
         if enforce:
+            pipe.drain()
             elapsed = time.monotonic() - t_start
             if elapsed > 0:
                 _rate_h.observe(ran / elapsed)
             rem = deadline.remaining()
             if rem is not None:
                 _margin_g.set(rem)
+        else:
+            pipe.finish()
         return tree, ran
 
     def run_chunked(params_p, params_v, roots: GoState, chunk: int,
-                    tree: DeviceTree | None = None, deadline=None):
+                    tree: DeviceTree | None = None, deadline=None,
+                    depth: int | None = None,
+                    pipeline: ChunkPipeline | None = None,
+                    owned: bool = False):
         """Full search as ``chunk``-simulation compiled programs with
         the tree device-resident in between — THE way to drive this
         on watchdog-limited backends (the ~40s TPU worker limit);
@@ -435,11 +483,16 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         mid-search, in which case the stats reflect the simulations
         that fit. Pass ``tree`` to resume from a prepared tree (e.g.
         root priors mixed with exploration noise, or a reused
-        subtree) instead of ``init(roots)``."""
+        subtree) instead of ``init(roots)``; ``depth``/``pipeline``/
+        ``owned`` thread through to :func:`run_sims_chunked` (the
+        loop donates the tree slab — ``owned=True`` hands a passed
+        tree over)."""
         if tree is None:
             tree = search.init(params_p, params_v, roots)
+            owned = True             # init's output is loop-internal
         tree, ran = run_sims_chunked(params_p, params_v, tree, chunk,
-                                     deadline=deadline)
+                                     deadline=deadline, depth=depth,
+                                     pipeline=pipeline, owned=owned)
         search.last_ran = ran
         return search.root_stats(tree)
 
@@ -457,8 +510,15 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     # all three composed. init/run_sims are compile-tracked
     # (obs.jaxobs): an unexpected recompile — a new chunk size, a new
     # komi — surfaces as a named `compile` event.
+    # run_sims_donated is the chunk loop's program (tree slab donated
+    # in — see run_sims_chunked); wrap THAT attribute to intercept
+    # the loop's chunks. Its donates_buffers marks it unretryable
+    # (runtime.retries refuses to wrap it).
     search.init = jaxobs.track("device_mcts.init", jax.jit(init_tree))
     search.run_sims = jaxobs.track("device_mcts.run_sims", run_sims)
+    search.run_sims_donated = jaxobs.track(
+        "device_mcts.run_sims", run_sims_donated)
+    search.run_sims_donated.donates_buffers = True
     search.run_sims_chunked = run_sims_chunked
     search.root_stats = jax.jit(_root_stats)
     search.run_chunked = run_chunked
@@ -623,9 +683,8 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         head = jnp.take_along_axis(cand[:, :k], order, axis=-1)
         return jnp.concatenate([head, cand[:, k:]], axis=-1)
 
-    @functools.partial(jax.jit, static_argnames=("count", "k"))
-    def run_phase(params_p, params_v, tree: DeviceTree, g, cand, j0,
-                  count: int, k: int):
+    def _run_phase_impl(params_p, params_v, tree: DeviceTree, g, cand,
+                        j0, count: int, k: int):
         """``count`` scheduled simulations (one compiled program):
         sim ``j`` forces root candidate ``(j0 + j) % k``. Candidates
         beyond the sensible set (possible when fewer than m moves are
@@ -643,6 +702,9 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
 
         return lax.fori_loop(0, count, body, tree)
 
+    run_phase = functools.partial(
+        jax.jit, static_argnames=("count", "k"))(_run_phase_impl)
+
     def search_impl(params_p, params_v, roots: GoState, rng):
         tree, g, cand, logits = init(params_p, params_v, roots, rng)
         for k, v in schedule:        # static plan — unrolls into jit
@@ -655,7 +717,9 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     search = jax.jit(search_impl)
 
     def run_chunked(params_p, params_v, roots: GoState, rng,
-                    chunk: int, deadline=None):
+                    chunk: int, deadline=None,
+                    depth: int | None = None,
+                    pipeline: ChunkPipeline | None = None):
         """Phase-by-phase, ``chunk``-simulation compiled programs with
         the tree device-resident in between (the ~40s TPU worker
         watchdog); identical results to :func:`search` unless a
@@ -666,10 +730,22 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         (``g + σ(q̂)`` argmax — the same rule a completed phase
         applies, on a truncated schedule). The first chunk always
         runs; ``search.last_ran`` reports the real simulation count.
-        """
+
+        Pipelined like the PUCT loop (``runtime.pipeline``): the host
+        dispatches through a :class:`ChunkPipeline` (``depth`` chunks
+        in flight, default env/1) and each phase-chunk program
+        DONATES the tree slab (the tree is loop-internal — ``init``'s
+        output — so no defensive copy is needed; ``g``/``cand`` are
+        reused across phases and stay un-donated). The between-phase
+        rerank is a device-side dependency of the next phase, so it
+        needs no host sync; deadline expiry may leave up to ``depth``
+        chunks in flight — they complete and count, the overshoot
+        bound (docs/RESILIENCE.md)."""
         tree, g, cand, logits = init_j(params_p, params_v, roots, rng)
         enforce = deadline is not None and not deadline.unlimited
-        ran, out_of_time = 0, False
+        pipe = pipeline if pipeline is not None else ChunkPipeline(
+            depth, runner="gumbel")
+        ran, out_of_time, chunk_i = 0, False, 0
         t_start = time.monotonic()
         for k, v in schedule:
             total = k * v
@@ -677,15 +753,19 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                 if ran and enforce and deadline.expired():
                     out_of_time = True
                     break
+                faults.barrier("search.chunk", chunk_i)
+                chunk_i += 1
                 count = min(chunk, total - j0)
                 # read off the attribute (not the closure) so tests/
                 # instrumentation can wrap the compiled phase program
                 t0 = time.monotonic()
-                tree = search.run_phase(params_p, params_v, tree, g,
-                                        cand, jnp.int32(j0),
-                                        count=count, k=k)
-                if enforce:
-                    jax.block_until_ready(tree.n_nodes)
+                tree = search.run_phase_donated(
+                    params_p, params_v, tree, g, cand, jnp.int32(j0),
+                    count=count, k=k)
+                # fresh handle: the next chunk donates the tree (see
+                # the PUCT loop)
+                pipe.push(tree.n_nodes + 0)
+                if enforce and pipe.depth == 0:
                     _chunk_h.observe(time.monotonic() - t0)
                 ran += count
             # rerank even a truncated phase: the anytime ``best`` is
@@ -695,12 +775,15 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                 break
         _sims_c.inc(ran)
         if enforce:
+            pipe.drain()
             elapsed = time.monotonic() - t_start
             if elapsed > 0:
                 _rate_h.observe(ran / elapsed)
             rem = deadline.remaining()
             if rem is not None:
                 _margin_g.set(rem)
+        else:
+            pipe.finish()
         search.last_ran = ran
         visits, q = base.root_stats(tree)
         return visits, q, cand[:, 0], improved_j(tree, logits)
@@ -720,6 +803,14 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     search.init = init_j
     search.rerank = rerank_j
     search.run_phase = jaxobs.track("device_mcts.run_phase", run_phase)
+    # the chunk loop's program: run_phase with the tree slab donated
+    # in (g/cand are NOT donated — they live across phases); wrap
+    # THIS attribute to intercept the loop's chunks
+    search.run_phase_donated = jaxobs.track(
+        "device_mcts.run_phase",
+        functools.partial(jax.jit, static_argnames=("count", "k"),
+                          donate_argnums=(2,))(_run_phase_impl))
+    search.run_phase_donated.donates_buffers = True
     search.root_stats = base.root_stats
     search.improved_policy = improved_j
     search.run_chunked = run_chunked
@@ -765,9 +856,14 @@ class DeviceMCTSPlayer:
     sims/sec rate or a slow chunk stops the search where it is and
     the ANYTIME answer (argmax visits so far; the gumbel rerank of
     the surviving candidates) goes out instead of blowing the wall
-    clock. The floor is one chunk. ``last_deadline_hit`` /
-    ``deadline_hits`` report enforcement; ``last_n_sim`` then shows
-    the truncated count.
+    clock. The floor is one chunk; under the default pipelined
+    dispatch (``runtime.pipeline``, one chunk in flight while the
+    host decides) the hard stop may additionally let that one
+    in-flight chunk complete — its simulations count toward the
+    anytime answer and the overshoot is bounded by one chunk's wall
+    time (``ROCALPHAGO_PIPELINE_DEPTH=0`` restores the fully-sync
+    check). ``last_deadline_hit`` / ``deadline_hits`` report
+    enforcement; ``last_n_sim`` then shows the truncated count.
 
     ``sim_limit`` (int or None) caps the next searches' budget
     regardless of the clock — the degradation ladder's reduced-sims
@@ -982,12 +1078,19 @@ class DeviceMCTSPlayer:
             else:
                 tree = search.init(self.policy.params,
                                    self.value.params, roots)
+            # hand the tree over to the donating chunk loop
+            # (owned=True): a reused tree shares buffers with the
+            # carry, so the carry is dropped FIRST — if a transient
+            # fault aborts the search mid-loop (the resilient
+            # ladder's retry path), the next get_move must rebuild
+            # instead of walking a donated-away slab
+            self._carry = None
             # the clock owns the sim count: eff ≤ n_sim simulations
             # in chunk-sized compiled programs (same programs the
             # full budget runs — shrinking never recompiles)
             tree, ran = search.run_sims_chunked(
                 self.policy.params, self.value.params, tree,
-                self._chunk, n=eff, deadline=deadline)
+                self._chunk, n=eff, deadline=deadline, owned=True)
             planned = eff
             visits, _ = search.root_stats(tree)
             counts = np.asarray(jax.device_get(visits))[0]
@@ -1123,11 +1226,12 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         return tree._replace(prior=tree.prior.at[:, 0, :].set(mixed))
 
     def puct_search_noisy(params_p, params_v, states, rng):
-        """init → noise → the searcher's own chunk loop."""
+        """init → noise → the searcher's own chunk loop (the noisy
+        tree is ours alone — hand it over for donation)."""
         tree = search.init(params_p, params_v, states)
         tree = add_root_noise(tree, rng)
         return search.run_chunked(params_p, params_v, states,
-                                  sim_chunk, tree=tree)
+                                  sim_chunk, tree=tree, owned=True)
 
     # per-ply wall time of search self-play (the done-fetch below
     # syncs each ply, so the numbers are real)

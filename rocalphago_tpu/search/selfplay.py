@@ -45,6 +45,7 @@ from rocalphago_tpu.engine.jaxgo import (
 from rocalphago_tpu.features.planes import encode, needs_member, true_eyes
 from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime import faults
+from rocalphago_tpu.runtime.pipeline import ChunkPipeline
 
 
 def sensible_mask(cfg: GoConfig, state: GoState,
@@ -207,6 +208,21 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
     rng (the per-ply ``random.split`` chain is preserved across the
     segment boundary by threading the rng through the carry).
 
+    PIPELINED DISPATCH (``runtime.pipeline``): segments are driven
+    through a :class:`ChunkPipeline` (``depth`` in-flight segments,
+    default env/1; ``depth=0`` = fully synchronous pacing) and each
+    segment program DONATES its input ``GoState`` slab, so the
+    device-resident carry never exists twice. The ``stop_when_done``
+    done-poll never syncs the fresh dispatch at ANY depth: every
+    segment's done-scalar is computed on device at dispatch and the
+    host reads it from a RETIRED segment (already materialized). At
+    ``depth>=1`` the poll runs one segment behind, so up to ``depth``
+    extra segments may be dispatched onto all-done states — a proven
+    no-op (the engine freezes finished games; asserted in
+    ``tests/test_pipeline.py``) whose recorded rows are replaced by
+    the same zero padding the sync path writes. Results are therefore
+    bit-identical to the sync path at any depth.
+
     Pass ``mesh`` (a ``parallel.mesh.make_mesh`` mesh) to shard the
     game batch over the mesh's ``data`` axis — environment parallelism
     ACROSS devices, the multi-chip extension of the reference's
@@ -232,27 +248,48 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
                 f"width ({data_width})")
     ply = _make_ply(cfg, features, apply_a, apply_b, batch, temperature)
 
-    @functools.partial(jax.jit, static_argnames=("length",))
-    def segment(params_a, params_b, states, rng, offset, length):
+    def _segment_impl(params_a, params_b, states, rng, offset, length):
         return _scan_plies(ply, params_a, params_b, states, rng,
                            offset + jnp.arange(length))
+
+    # the chunk loop's program: the input GoState slab is DONATED so
+    # pipelined dispatch (runtime.pipeline) never holds two copies of
+    # the device-resident carry. The loop below owns every states
+    # value it passes (fresh/sharded/copied), so donation never eats
+    # a caller's buffers; donates_buffers marks the program
+    # unretryable (runtime.retries refuses to wrap it — retry the
+    # whole runner instead, which re-derives everything).
+    segment = functools.partial(
+        jax.jit, static_argnames=("length",),
+        donate_argnums=(2,))(_segment_impl)
+    segment.donates_buffers = True
+
+    # tiny per-segment done-reduction, dispatched WITH the segment so
+    # the host can later read it without syncing anything fresh
+    done_flag = jax.jit(lambda s: s.done.all())
+    copy_states = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
 
     finish = jax.jit(functools.partial(
         _finish, cfg, score_on_device=score_on_device, batch=batch))
 
-    # per-segment host wall time (real execution time under
-    # stop_when_done — its done-fetch syncs each segment — dispatch
-    # latency otherwise) + total plies dispatched
+    # per-segment host wall time (~real segment time when the
+    # pipeline paces the loop — each push waits for the previous
+    # segment — pure dispatch latency at depth>=1 only for the first
+    # segments) + total plies dispatched
     _seg_h = obs_registry.histogram("selfplay_segment_seconds")
     _plies_c = obs_registry.counter("selfplay_plies_total")
 
     def run(params_a, params_b, rng,
             initial_states: GoState | None = None,
             deadline: float | None = None,
-            stop_when_done: bool = False) -> SelfplayResult:
+            stop_when_done: bool = False,
+            depth: int | None = None,
+            pipeline: ChunkPipeline | None = None) -> SelfplayResult:
         """``initial_states`` (batched, defaults to fresh games) lets
         callers continue play from arbitrary positions — e.g. the
-        benchmark's mid-game probe segments.
+        benchmark's mid-game probe segments (the runner copies them
+        once before the first segment: segments donate their input
+        slab, and the caller keeps ownership of what it passed).
 
         ``deadline`` (absolute ``time.time()`` value): stop issuing
         further segments once the clock passes it — the in-flight
@@ -260,26 +297,53 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
         round-2 tunnel wedge postmortem); the result then has
         ``actions.shape[0] < max_moves`` and possibly-unfinished
         games. ``stop_when_done``: stop early once every game has
-        ended (two passes) — one scalar device fetch per segment; the
-        skipped tail is ZERO-PADDED (``live`` False) so the result
-        keeps the full ``[max_moves, B]`` shape — fixed shapes mean
-        the finish program compiles once however early games end.
-        Callers distinguish a deadline truncation from a done-exit
-        via ``final.done.all()``. Both default off, which preserves
-        the bit-identical-to-monolithic contract (under
+        ended (two passes) — the done-scalar is computed on device
+        per segment and read from a RETIRED segment (one segment
+        behind at ``depth>=1``, already materialized at any depth —
+        the host never blocks on the fresh dispatch); rows recorded
+        past the all-done segment are replaced by the ZERO padding
+        the sync path writes, so the result keeps the full
+        ``[max_moves, B]`` shape and stays bit-identical at every
+        depth. Callers distinguish a deadline truncation from a
+        done-exit via ``final.done.all()``. Both default off, which
+        preserves the bit-identical-to-monolithic contract (under
         ``stop_when_done`` the action rows after every game has
         ended are zeros where the monolithic scan would have recorded
         sampled-then-ignored moves; ``live``/``num_moves``/``final``
-        are unaffected)."""
+        are unaffected).
+
+        ``depth``/``pipeline``: the dispatch window (see
+        :class:`~rocalphago_tpu.runtime.pipeline.ChunkPipeline`);
+        pass ``pipeline`` to share one across calls (bench A/Bs read
+        its ``host_gap_frac``)."""
         states = (new_states(cfg, batch) if initial_states is None
                   else initial_states)
         if mesh is not None:
             states = meshlib.shard_batch(mesh, states)
             params_a = meshlib.replicate(mesh, params_a)
             params_b = meshlib.replicate(mesh, params_b)
+        elif initial_states is not None:
+            # segments donate their input slab; the caller keeps its
+            # states, so the first donation must eat OUR copy
+            states = copy_states(states)
+        pipe = pipeline if pipeline is not None else ChunkPipeline(
+            depth, runner="selfplay")
         acts = [jnp.zeros((0, batch), jnp.int32)]   # max_moves=0 parity
         lives = [jnp.zeros((0, batch), bool)]
         plies = 0
+        done_plies = None      # plies recorded when all games done
+
+        def _first_done(retired):
+            """Earliest retired segment whose done-scalar is True
+            (retire order = dispatch order; done is monotonic). Each
+            entry is ``(payload=plies, handle=done-scalar)``; the
+            handle is materialized — the fetch cannot sync anything
+            still in flight."""
+            for seg_plies, handle in retired:
+                if bool(jax.device_get(handle)):
+                    return seg_plies
+            return None
+
         for offset in range(0, max_moves, chunk):
             if deadline is not None and _time.time() > deadline:
                 # deliberately NOT zero-padded (unlike the
@@ -302,22 +366,45 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
             lives.append(live)
             plies = offset + length
             _plies_c.inc(length)
-            done_now = (stop_when_done and bool(jax.device_get(
-                states.done.all())))
+            handle = done_flag(states) if stop_when_done else rng
+            retired = pipe.push(handle, payload=plies)
             _seg_h.observe(_time.monotonic() - t0)
-            if done_now:
-                # zero-pad the skipped tail (see docstring): fixed
-                # output shapes keep the finish program at one compile
-                pad = max_moves - plies
-                acts.append(jnp.zeros((pad, batch), jnp.int32))
-                lives.append(jnp.zeros((pad, batch), bool))
-                break
+            if stop_when_done:
+                done_plies = _first_done(retired)
+                if done_plies is not None:
+                    break
+        if stop_when_done:
+            # drain both exits: the lagged extras are no-op segments
+            # (the result fetch would sync them anyway) and a shared
+            # pipeline must not leak this run's done-handles into the
+            # next run's retire stream
+            retired = pipe.drain()
+            if done_plies is None:
+                done_plies = _first_done(retired)
+        else:
+            pipe.finish()
+        if done_plies is not None:
+            # zero-pad from the first all-done segment (see
+            # docstring): rows recorded by lagged extra segments are
+            # dropped — those segments stepped frozen games (a no-op
+            # on `states`) and the sync path writes zeros here. Fixed
+            # output shapes keep the finish program at one compile.
+            actions_all = jnp.concatenate(acts)[:done_plies]
+            lives_all = jnp.concatenate(lives)[:done_plies]
+            pad = max_moves - done_plies
+            return finish(
+                states,
+                jnp.concatenate(
+                    [actions_all, jnp.zeros((pad, batch), jnp.int32)]),
+                jnp.concatenate(
+                    [lives_all, jnp.zeros((pad, batch), bool)]))
         return finish(states, jnp.concatenate(acts),
                       jnp.concatenate(lives))
 
     # the compiled per-segment program, exposed for benchmarks (flops
     # accounting via .lower().compile().cost_analysis()) — signature
-    # (params_a, params_b, states, rng, offset, length=K)
+    # (params_a, params_b, states, rng, offset, length=K). NOTE: it
+    # donates its `states` argument when executed.
     run.segment = segment
     return run
 

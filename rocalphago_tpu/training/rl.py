@@ -55,6 +55,7 @@ from rocalphago_tpu.io.metrics import MetricsLogger
 from rocalphago_tpu.models.nn_util import NeuralNetBase
 from rocalphago_tpu.obs import jaxobs, trace
 from rocalphago_tpu.obs import registry as obs_registry
+from rocalphago_tpu.runtime.pipeline import ChunkPipeline
 from rocalphago_tpu.parallel import mesh as meshlib
 from rocalphago_tpu.runtime import faults, retries
 from rocalphago_tpu.search.selfplay import (
@@ -231,14 +232,22 @@ def make_rl_iteration_chunked(cfg: jaxgo.GoConfig, features: tuple,
                                   temperature)
 
     @jaxobs.track("rl.replay_segment")
-    @functools.partial(jax.jit, static_argnames=("length",))
+    @functools.partial(jax.jit, static_argnames=("length",),
+                       donate_argnums=(2, 3))
     def replay_segment(params, z, states, grads, actions, live,
                        offset, length):
+        # states + grad accumulator are DONATED: both are
+        # loop-internal (built fresh each iteration, so the
+        # iteration-level retry wrapper stays valid) and donation
+        # keeps pipelined dispatch from doubling the params-shaped
+        # accumulator
         (states, grads), _ = lax.scan(
             lambda c, xs: (replay_ply(params, z, c, xs), None),
             (states, grads),
             (offset + jnp.arange(length), actions, live))
         return states, grads
+
+    replay_segment.donates_buffers = True
 
     update = jax.jit(functools.partial(_update_and_metrics, tx))
 
@@ -259,6 +268,9 @@ def make_rl_iteration_chunked(cfg: jaxgo.GoConfig, features: tuple,
         grads = jax.tree.map(jnp.zeros_like, params)
         live = result.live.astype(jnp.float32)
         plies = result.actions.shape[0]
+        # pipelined dispatch (runtime.pipeline): paces the host to
+        # `depth` in-flight segments and records gap/occupancy
+        pipe = ChunkPipeline(runner="rl.replay")
         with trace.span("rl.replay", plies=plies):
             for offset in range(0, plies, chunk):
                 length = min(chunk, plies - offset)
@@ -267,6 +279,10 @@ def make_rl_iteration_chunked(cfg: jaxgo.GoConfig, features: tuple,
                     result.actions[offset:offset + length],
                     live[offset:offset + length],
                     jnp.int32(offset), length)
+                # fresh scalar handle — the next segment donates
+                # `states`, so no leaf of it may be the handle
+                pipe.push(states.turn.sum())
+            pipe.finish()
 
         with trace.span("rl.update"):
             return update(state, grads, z, result.num_moves, key)
@@ -419,10 +435,13 @@ class RLTrainer:
             enabled=self.coord)
         final = {}
         # transient-failure re-dispatch: safe for the chunked
-        # (host-driven, nothing donated) iteration — it recomputes the
-        # identical result from the unchanged state. The monolithic
-        # jit DONATES the state buffers, so after a failed dispatch
-        # the input may already be invalid: no retry there.
+        # (host-driven) iteration — its chunk programs donate only
+        # loop-internal carries, rebuilt from the never-donated
+        # `state` each invocation, so it recomputes the identical
+        # result from the unchanged state (retries.retry refuses the
+        # donating chunk programs themselves). The monolithic jit
+        # DONATES the state buffers, so after a failed dispatch the
+        # input may already be invalid: no retry there.
         step = self._iteration
         if cfg.chunk:
             step = retries.retry(max_attempts=3, base_delay=1.0,

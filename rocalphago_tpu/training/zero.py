@@ -48,6 +48,7 @@ from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
 from rocalphago_tpu.obs import jaxobs, trace
 from rocalphago_tpu.parallel import mesh as meshlib
+from rocalphago_tpu.runtime.pipeline import ChunkPipeline
 from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
 from rocalphago_tpu.search.selfplay import sensible_mask
 
@@ -162,17 +163,24 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         return (vstep(states, actions_t, gd), grads_p, grads_v, stats)
 
     @jaxobs.track("zero.replay_segment")
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(4,))
     def replay_segment(policy_params, value_params, winners, finished,
                        carry, actions, live, visits):
         # segment length rides the xs shapes (one compile per distinct
-        # segment length — the fixed chunk plus at most one remainder)
+        # segment length — the fixed chunk plus at most one remainder).
+        # The carry (replay states + BOTH nets' grad accumulators) is
+        # DONATED: it is loop-internal (built fresh per iteration, so
+        # the iteration-level retry wrapper stays valid) and donating
+        # it keeps pipelined dispatch from doubling the params-shaped
+        # accumulators.
         def body(c, xs):
             return ply(policy_params, value_params, winners, finished,
                        c, xs), None
 
         carry, _ = lax.scan(body, carry, (actions, live, visits))
         return carry
+
+    replay_segment.donates_buffers = True
 
     @jaxobs.track("zero.apply_updates")
     @jax.jit
@@ -237,10 +245,18 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             states = meshlib.shard_batch(mesh, states)
         grads_p = jax.tree.map(jnp.zeros_like, state.policy_params)
         grads_v = jax.tree.map(jnp.zeros_like, state.value_params)
-        stats = (jnp.float32(0),) * 5
+        # five DISTINCT zero arrays, not one repeated: the replay
+        # segment donates the carry, and XLA rejects donating the
+        # same buffer twice
+        stats = tuple(jnp.float32(0) for _ in range(5))
         live_f = live.astype(jnp.float32)
         plies = actions.shape[0]
         carry = (states, grads_p, grads_v, stats)
+        # pipelined dispatch (runtime.pipeline): the pipeline paces
+        # the host to `depth` in-flight segments (device never idle,
+        # host never queueing unboundedly) and records the dispatch
+        # gap/occupancy telemetry
+        pipe = ChunkPipeline(runner="zero.replay")
         with trace.span("zero.replay", plies=plies):
             for offset in range(0, plies, replay_chunk):
                 sl = slice(offset, offset + replay_chunk)
@@ -248,6 +264,10 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                     state.policy_params, state.value_params, wf,
                     finished, carry, actions[sl], live_f[sl],
                     visits[sl])
+                # fresh handle (the next segment donates the carry,
+                # deleting its leaves out from under a retire)
+                pipe.push(carry[3][0] + 0.0)
+            pipe.finish()
         _, grads_p, grads_v, stats = carry
 
         num_moves = live.sum(axis=0, dtype=jnp.int32)
@@ -644,8 +664,13 @@ def run_training(argv=None) -> dict:
                 os.path.join(a.out_dir, f"{name}.json"), weights)
 
     # transient device/XLA failures re-dispatch the whole iteration:
-    # it is functional (state in, new state out; nothing donated), so
-    # a retry recomputes the identical result from the same state
+    # it is functional (state in, new state out), so a retry
+    # recomputes the identical result from the same state. The
+    # iteration's chunk programs donate their loop-internal carries,
+    # but those are rebuilt from `state` — which is never donated —
+    # on every invocation, so iteration-level retry stays valid
+    # (retries.retry refuses to wrap the donating chunk programs
+    # themselves; see runtime/retries.py)
     run_iteration = retries.retry(
         max_attempts=3, base_delay=1.0, logger=metrics.log)(iteration)
 

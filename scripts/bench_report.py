@@ -50,7 +50,7 @@ def load_records(path: str, date: str, platform: str | None):
                 continue
             key = (r["metric"], r.get("batch"), r.get("board"),
                    r.get("interpret"), r.get("lmbda"),
-                   r.get("devices"))
+                   r.get("devices"), r.get("pipeline_depth"))
             prev = latest.get(key)
             if prev is None or str(r.get("date")) >= str(prev.get("date")):
                 latest[key] = r
@@ -61,16 +61,19 @@ def load_records(path: str, date: str, platform: str | None):
 
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
-                "vs_baseline", "mfu"}
+                "vs_baseline", "mfu", "host_gap_frac"}
 
 
 def render_table(records) -> str:
     """MFU gets its own column (VERDICT r3 #3): benches that know
     their program's XLA-costed flops record ``mfu`` = achieved
     flops/s ÷ the chip's bf16 peak (see benchmarks/_harness.py);
-    '—' where a record has none (CPU runs, non-flops metrics)."""
-    lines = ["| metric | value | unit | MFU | config |",
-             "|---|---|---|---|---|"]
+    '—' where a record has none (CPU runs, non-flops metrics).
+    The host-gap column shows ``host_gap_frac`` — the fraction of
+    wall time the device had nothing in flight (the pipelined-vs-sync
+    dispatch A/B; ``pipeline_depth`` in config names the side)."""
+    lines = ["| metric | value | unit | MFU | host gap | config |",
+             "|---|---|---|---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -78,8 +81,10 @@ def render_table(records) -> str:
                  else f" (vs_baseline {r['vs_baseline']})")
         u = r.get("mfu")
         u = "—" if u in (None, "") else f"{100.0 * float(u):.1f}%"
+        gap = r.get("host_gap_frac")
+        gap = "—" if gap in (None, "") else f"{100.0 * float(gap):.2f}%"
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
-                     f" | {r.get('unit', '?')} | {u} | {cfg} |")
+                     f" | {r.get('unit', '?')} | {u} | {gap} | {cfg} |")
     return "\n".join(lines)
 
 

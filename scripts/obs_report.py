@@ -113,6 +113,56 @@ def render_registry(snap: dict) -> str:
     return "\n".join(lines) if lines else "(no registry snapshot)"
 
 
+_LABEL = None   # lazy-compiled label-extraction regex
+
+
+def _runner_label(key: str):
+    """``'dispatch_gap_s{runner="selfplay"}'`` -> ``'selfplay'``."""
+    global _LABEL
+    if _LABEL is None:
+        import re
+
+        _LABEL = re.compile(r'runner="([^"]*)"')
+    m = _LABEL.search(key)
+    return m.group(1) if m else key
+
+
+def render_dispatch(snap: dict) -> str:
+    """Occupancy/gap table per pipelined runner (runtime.pipeline):
+    the device-occupancy gauge next to the dispatch-gap histogram's
+    count/total/p99 — the 'was the device ever idle between chunks'
+    row that makes the pipelining win (or a sync regression) visible
+    in any run's metrics.jsonl."""
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    runners: dict = {}
+    for key, v in gauges.items():
+        if key.startswith("device_occupancy"):
+            runners.setdefault(_runner_label(key), {})["occ"] = v
+    for key, h in hists.items():
+        if key.startswith("dispatch_gap_s"):
+            runners.setdefault(_runner_label(key), {})["gap"] = h
+    if not runners:
+        return "(no pipelined runners recorded)"
+    width = max(len(r) for r in runners) + 2
+    lines = [f"{'runner':<{width}} {'occupancy':>9} {'gaps':>6} "
+             f"{'gap_total_s':>12} {'gap_p99_s':>10}"]
+    for name in sorted(runners):
+        r = runners[name]
+        occ = r.get("occ")
+        occ_s = "—" if occ is None else f"{100.0 * occ:.1f}%"
+        h = r.get("gap")
+        if h:
+            p99 = quantile_from_buckets(h, 0.99)
+            lines.append(f"{name:<{width}} {occ_s:>9} "
+                         f"{h['count']:>6} {h['sum']:>12.3f} "
+                         f"{_fmt_s(p99):>10}")
+        else:
+            lines.append(f"{name:<{width}} {occ_s:>9} {'—':>6} "
+                         f"{'—':>12} {'—':>10}")
+    return "\n".join(lines)
+
+
 def render_events(records) -> str:
     """Counts of the notable non-span events (compiles, stalls,
     degradations, retries) — the 'did anything unusual happen' row."""
@@ -139,6 +189,8 @@ def report(records, top: int | None = None) -> str:
     parts = ["## per-phase time breakdown (span records)", "",
              render_spans(stats), "",
              "## notable events", "", render_events(records), "",
+             "## dispatch pipeline (occupancy / host gaps)", "",
+             render_dispatch(reg or {}), "",
              "## metric registry (last snapshot)", "",
              render_registry(reg or {})]
     return "\n".join(parts)
@@ -163,12 +215,17 @@ FIXTURE = [
      "dur_s": 3.2, "calls": 1, "recompile": False},
     {"event": "registry", "snapshot": {
         "counters": {'serve_rung_total{rung="search"}': 41,
-                     'serve_rung_total{rung="policy"}': 1},
-        "gauges": {"device_mcts_deadline_margin_s": 0.42},
+                     'serve_rung_total{rung="policy"}': 1,
+                     'dispatch_chunks_total{runner="device_mcts"}': 96},
+        "gauges": {"device_mcts_deadline_margin_s": 0.42,
+                   'device_occupancy{runner="device_mcts"}': 0.983},
         "histograms": {"gtp_genmove_seconds": {
             "count": 42, "sum": 33.6,
             "buckets": {"0.5": 17, "1": 40, "2.5": 42,
-                        "+Inf": 42}}}}},
+                        "+Inf": 42}},
+            'dispatch_gap_s{runner="device_mcts"}': {
+                "count": 3, "sum": 0.021,
+                "buckets": {"0.005": 1, "0.01": 3, "+Inf": 3}}}}},
 ]
 
 
@@ -177,7 +234,7 @@ def selftest() -> int:
     print(out)
     needed = ("zero.selfplay", "zero.iteration", "76.2%",
               "serve_rung_total", "gtp_genmove_seconds", "compile=1",
-              "p99≲2.5")
+              "p99≲2.5", "dispatch pipeline", "98.3%")
     missing = [n for n in needed if n not in out]
     if missing:
         print(f"obs_report selftest FAILED: missing {missing}",

@@ -29,6 +29,13 @@ cd "$(dirname "$0")/.."
 # records land in metrics.jsonl, and `scripts/obs_report.py
 # --selftest` (the fixture render), so the report path cannot rot
 # silently. See docs/OBSERVABILITY.md.
+#
+# Pipelined dispatch: tests/test_pipeline.py is tier-1 —
+# bit-identical pipelined-vs-sync sweeps for PUCT/gumbel search,
+# chunked self-play (lagged done-poll) and a zero iteration, the
+# sync-gap-strictly-higher A/B, the donation/retry refusal, and the
+# step-on-done no-op lemma; the deadline-overshoot-at-depth tests
+# live in tests/test_serving_chaos.py. See docs/PERFORMANCE.md.
 ARGS=()
 TIER=(-m "not slow")
 for a in "$@"; do

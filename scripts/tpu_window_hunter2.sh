@@ -83,6 +83,12 @@ run() {
     return $step_rc
 }
 
+# NOTE: the devmcts*/selfplay*/headline steps now emit the pipelined
+# -vs-sync dispatch A/B (pipeline_depth + host_gap_frac fields in
+# results.jsonl / the headline JSON line; docs/PERFORMANCE.md) — no
+# extra steps needed, the A/B shares each step's compiled programs.
+# ROCALPHAGO_PIPELINE_DEPTH=0 forces the old sync dispatch hunt-wide.
+
 SPECS=benchmarks/tpu_extra_r3   # tiny 9x9 nets for the tournament smoke
 
 # spec JSONs reference sibling .flax.msgpack weight files — regenerate

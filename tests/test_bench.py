@@ -162,6 +162,10 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
         json.dumps({"metric": "m", "value": 9.0, "unit": "u",
                     "batch": 256, "platform": "tpu", "mfu": 0.1234,
                     "date": "2026-07-31T01:30:00"}),   # distinct cfg
+        json.dumps({"metric": "m", "value": 3.0, "unit": "u",
+                    "batch": 64, "platform": "tpu",
+                    "pipeline_depth": 1, "host_gap_frac": 0.0421,
+                    "date": "2026-07-31T02:00:00"}),   # dispatch A/B side
         json.dumps({"metric": "m", "value": 5.0, "unit": "u",
                     "batch": 64, "platform": "cpu",
                     "date": "2026-07-31T03:00:00"}),   # other platform
@@ -170,12 +174,17 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
                     "date": "2026-07-30T01:00:00"}),   # other day
     ]) + "\n")
     recs = bench_report.load_records(str(log), "2026-07-31", "tpu")
-    assert [(r["value"], r.get("batch")) for r in recs] \
-        == [(2.0, 64), (9.0, 256)]
+    # pipeline_depth is part of the config key: the depth-1 A/B side
+    # is a distinct row, not a newer duplicate of the depth-less one
+    assert sorted((r["value"], r.get("batch")) for r in recs) \
+        == [(2.0, 64), (3.0, 64), (9.0, 256)]
     table = bench_report.render_table(recs)
-    # MFU column: '—' when a record has none, percent when it does
-    assert "| m | 2.0 | u | — | batch=64 |" in table
-    assert "| m | 9.0 | u | 12.3% | batch=256 |" in table
+    # MFU and host-gap columns: '—' when a record has none,
+    # percent when it does
+    assert "| m | 2.0 | u | — | — | batch=64 |" in table
+    assert "| m | 9.0 | u | 12.3% | — | batch=256 |" in table
+    assert ("| m | 3.0 | u | — | 4.21% | batch=64, pipeline_depth=1 |"
+            in table)
 
     probe = tmp_path / "probe.log"
     probe.write_text(

@@ -197,6 +197,39 @@ def test_chaos_every_zero_barrier(specs, tmp_path):
         assert_same_run(baseline, out)
 
 
+def test_selfplay_chunk_barrier_once_per_chunk_under_pipelining():
+    """ISSUE 4: pipelined dispatch (one segment in flight) must not
+    move the fault-injection points — ``selfplay.chunk`` still fires
+    exactly once per dispatched segment, host-side, in dispatch
+    order. A 12-ply/chunk-4 run has exactly three chunk barriers: a
+    spec on hit 3 fires (the loop reached the third chunk with the
+    first two already dispatched), a spec on hit 4 never does."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.runtime import faults
+    from rocalphago_tpu.runtime.faults import InjectedFault
+    from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+    def fake_policy(params, planes):
+        return jnp.zeros((planes.shape[0], 25))
+
+    cfg = GoConfig(size=5)
+    run = make_selfplay_chunked(cfg, ("board", "ones"), fake_policy,
+                                fake_policy, batch=2, max_moves=12,
+                                chunk=4)
+    key = jax.random.key(0)
+    try:
+        faults.install("io_error@selfplay.chunk:3")
+        with pytest.raises(InjectedFault):
+            run(None, None, key)
+        faults.install("io_error@selfplay.chunk:4")
+        run(None, None, key)        # only 3 chunks: never fires
+    finally:
+        faults.install(None)
+
+
 @pytest.mark.slow
 def test_chaos_io_error_retried_in_run(specs, tmp_path):
     """A transient (injected) io_error during promotion is absorbed

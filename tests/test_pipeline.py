@@ -1,0 +1,438 @@
+"""Pipelined chunk dispatch (``runtime.pipeline``) — ISSUE 4.
+
+The contract under test: pipelining is a SCHEDULING change, not a
+semantics change. Every chunked hot loop must produce bit-identical
+results at ``depth=0`` (the old fully-sync pacing) and ``depth=1``
+(one chunk in flight while the host decides) — PUCT search, gumbel
+search, chunked self-play (including the lagged done-poll's
+extra-chunk no-op) and a full zero iteration — while the sync path's
+per-chunk host gap disappears (``host_gap_frac`` strictly lower
+pipelined than sync, the bench A/B's tier-1 twin). Donation rides
+along: the chunk programs donate their device-resident carries, and
+``runtime.retries`` must refuse to wrap them.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocalphago_tpu.engine import jaxgo
+from rocalphago_tpu.engine.jaxgo import GoConfig, new_states
+from rocalphago_tpu.runtime import retries
+from rocalphago_tpu.runtime.pipeline import (
+    DEPTH_ENV,
+    ChunkPipeline,
+    default_depth,
+)
+
+SIZE = 5
+N = SIZE * SIZE
+FEATS = ("board", "ones")
+VFEATS = FEATS + ("color",)
+CFG = GoConfig(size=SIZE)
+
+
+def fake_policy(params, planes):
+    return jnp.zeros((planes.shape[0], N))
+
+
+def fake_value(params, planes):
+    mine = planes[..., 0].sum(axis=(1, 2))
+    theirs = planes[..., 1].sum(axis=(1, 2))
+    return (mine - theirs) / N
+
+
+# ------------------------------------------------- ChunkPipeline unit
+
+
+def test_depth_semantics_and_retire_order():
+    """depth=0 retires every push immediately (sync); depth=1 keeps
+    one chunk pending and retires in dispatch order."""
+    sync = ChunkPipeline(depth=0)
+    out = sync.push(jnp.int32(1), payload="a")
+    assert [p for p, _ in out] == ["a"]
+    assert sync.pending() == 0
+
+    pipe = ChunkPipeline(depth=1)
+    assert pipe.push(jnp.int32(1), payload="a") == []
+    assert pipe.pending() == 1
+    out = pipe.push(jnp.int32(2), payload="b")
+    assert [p for p, _ in out] == ["a"]
+    assert pipe.pending() == 1
+    out = pipe.drain()
+    assert [p for p, _ in out] == ["b"]
+    assert pipe.pending() == 0
+    # retired handles are materialized — device_get cannot block on
+    # anything still in flight
+    assert int(jax.device_get(out[0][1])) == 2
+
+
+def test_gap_accounting_sync_counts_pipelined_does_not():
+    """Every sync chunk boundary is a gap (the device idles while the
+    host decides); a depth-1 window never empties mid-run, so its gap
+    count is exactly zero — the invariant behind the bench A/B's
+    'pipelined gap strictly lower'."""
+    sync = ChunkPipeline(depth=0)
+    for i in range(4):
+        sync.push(jnp.int32(i))
+        time.sleep(0.002)            # host "decision" time
+    sync.drain()
+    assert sync.gaps == 3            # one per inter-chunk boundary
+    assert sync.gap_s > 0.0
+    assert sync.host_gap_frac > 0.0
+
+    pipe = ChunkPipeline(depth=1)
+    for i in range(4):
+        pipe.push(jnp.int32(i))
+        time.sleep(0.002)
+    pipe.drain()
+    assert pipe.gaps == 0            # window never emptied mid-run
+    assert pipe.host_gap_frac == 0.0
+    assert pipe.host_gap_frac < sync.host_gap_frac
+
+
+def test_env_default_depth(monkeypatch):
+    monkeypatch.delenv(DEPTH_ENV, raising=False)
+    assert default_depth() == 1
+    monkeypatch.setenv(DEPTH_ENV, "0")
+    assert default_depth() == 0
+    assert ChunkPipeline().depth == 0
+    monkeypatch.setenv(DEPTH_ENV, "3")
+    assert ChunkPipeline().depth == 3
+    monkeypatch.setenv(DEPTH_ENV, "-1")
+    with pytest.raises(ValueError, match="must be >= 0"):
+        default_depth()
+    monkeypatch.setenv(DEPTH_ENV, "two")
+    with pytest.raises(ValueError, match="non-negative integer"):
+        default_depth()
+
+
+def test_reset_stats_refuses_inflight():
+    pipe = ChunkPipeline(depth=1)
+    pipe.push(jnp.int32(0))
+    with pytest.raises(RuntimeError, match="in flight"):
+        pipe.reset_stats()
+    pipe.drain()
+    pipe.reset_stats()
+    assert pipe.chunks == 0 and pipe.wall_s == 0.0
+
+
+def test_windows_survive_finish_and_reuse():
+    """A bench shares one pipeline across reps: finish() closes the
+    accounting window; the idle time BETWEEN windows is not a gap."""
+    pipe = ChunkPipeline(depth=0)
+    pipe.push(jnp.int32(0))
+    pipe.drain()
+    wall1 = pipe.wall_s
+    time.sleep(0.02)                 # inter-rep host time
+    pipe.push(jnp.int32(1))
+    pipe.drain()
+    assert pipe.gaps == 0            # no INTRA-window boundary idled
+    assert pipe.wall_s >= wall1
+    assert pipe.wall_s < 0.02 + 0.5  # the sleep is not in any window
+
+
+# ------------------------------------------- retries donation guard
+
+
+def test_retry_refuses_donating_callable():
+    def chunk_program(x):
+        return x
+
+    chunk_program.donates_buffers = True
+    with pytest.raises(ValueError, match="DONATED"):
+        retries.retry()(chunk_program)
+    with pytest.raises(ValueError, match="DONATED"):
+        retries.retry_call(chunk_program, 1)
+
+
+def test_retry_refuses_real_donating_chunk_programs():
+    """The actual chunk programs advertise donates_buffers (through
+    the jaxobs.track wrapper's attribute surface) and are refused."""
+    from rocalphago_tpu.search.device_mcts import make_device_mcts
+    from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+    search = make_device_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value, n_sim=4, max_nodes=8)
+    assert retries.donates(search.run_sims_donated)
+    assert not retries.donates(search.run_sims)
+    with pytest.raises(ValueError, match="DONATED"):
+        retries.retry()(search.run_sims_donated)
+
+    run = make_selfplay_chunked(CFG, FEATS, fake_policy, fake_policy,
+                                batch=2, max_moves=4, chunk=2)
+    assert retries.donates(run.segment)
+    with pytest.raises(ValueError, match="DONATED"):
+        retries.retry()(run.segment)
+    # the RUNNER is retryable — it rebuilds its donated carries from
+    # never-donated inputs on every invocation
+    assert not retries.donates(run)
+
+
+def test_transient_fault_on_donating_chunk_retries_via_runner():
+    """ISSUE 4 satellite: a transient fault mid-loop (after chunks
+    whose input slabs were already donated) must NOT be retried at
+    the chunk — the runner level retry recomputes the identical
+    result from the unchanged inputs."""
+    from rocalphago_tpu.runtime import faults
+    from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+    run = make_selfplay_chunked(CFG, FEATS, fake_policy, fake_policy,
+                                batch=2, max_moves=12, chunk=4)
+    key = jax.random.key(5)
+    want = run(None, None, key)
+    try:
+        faults.install("io_error@selfplay.chunk:2")
+        wrapped = retries.retry(max_attempts=2, base_delay=0.0,
+                                sleep=lambda s: None)(run)
+        got = wrapped(None, None, key)
+    finally:
+        faults.install(None)
+    np.testing.assert_array_equal(np.asarray(want.actions),
+                                  np.asarray(got.actions))
+    np.testing.assert_array_equal(np.asarray(want.final.board),
+                                  np.asarray(got.final.board))
+
+
+# ------------------------------------------------ step-on-done no-op
+
+
+def test_step_on_all_done_states_is_a_noop():
+    """The lagged done-poll's safety lemma: a segment dispatched onto
+    all-done states must change NOTHING (the engine freezes finished
+    games) — so an extra in-flight chunk past the done point leaves
+    ``final`` bit-identical."""
+    states = new_states(CFG, 3)
+    vstep = jax.vmap(lambda s, a: jaxgo.step(CFG, s, a))
+    for _ in range(2):               # two passes end every game
+        states = vstep(states, jnp.full((3,), N, jnp.int32))
+    assert bool(jax.device_get(states.done.all()))
+    before = jax.device_get(states)
+    stepped = vstep(states, jnp.zeros((3,), jnp.int32))
+    after = jax.device_get(stepped)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------- bit-identical depth sweeps
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(x)),
+                                      np.asarray(jax.device_get(y)))
+
+
+def test_puct_chunked_bit_identical_across_depths():
+    """PUCT chunk loop: monolithic == depth 0 == depth 1 == depth 2,
+    and the sync run's host gap is strictly above the pipelined
+    run's (the A/B acceptance, in-process)."""
+    from rocalphago_tpu.search.device_mcts import make_device_mcts
+
+    search = make_device_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value, n_sim=24, max_nodes=48)
+    roots = new_states(CFG, 2)
+    v_mono, q_mono = jax.device_get(search(None, None, roots))
+    pipes = {}
+    for depth in (0, 1, 2):
+        pipes[depth] = pipe = ChunkPipeline(depth=depth)
+        visits, q = jax.device_get(search.run_chunked(
+            None, None, roots, chunk=5, pipeline=pipe))
+        np.testing.assert_array_equal(v_mono, visits), depth
+        np.testing.assert_array_equal(q_mono, q), depth
+        assert search.last_ran == 24
+    assert pipes[0].host_gap_frac > pipes[1].host_gap_frac
+    assert pipes[0].gaps > 0 and pipes[1].gaps == 0
+
+
+def test_gumbel_chunked_bit_identical_across_depths():
+    from rocalphago_tpu.search.device_mcts import make_gumbel_mcts
+
+    search = make_gumbel_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value, n_sim=16, max_nodes=64,
+                              m_root=4)
+    roots = new_states(CFG, 2)
+    rng = jax.random.key(11)
+    ref = None
+    gaps = {}
+    for depth in (0, 1):
+        pipe = ChunkPipeline(depth=depth)
+        out = jax.device_get(search.run_chunked(
+            None, None, roots, rng, chunk=3, pipeline=pipe))
+        gaps[depth] = pipe
+        if ref is None:
+            ref = out
+        else:
+            for a, b in zip(ref, out):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+    assert gaps[0].host_gap_frac > gaps[1].host_gap_frac
+
+
+def test_chunked_selfplay_bit_identical_across_depths():
+    """Chunked self-play — plain, and with the lagged done-poll
+    (games end well before max_moves, so depth>=1 dispatches a
+    provably-no-op extra segment whose rows must come back as the
+    sync path's zero padding)."""
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.search.selfplay import (
+        make_selfplay,
+        make_selfplay_chunked,
+    )
+
+    policy = CNNPolicy(FEATS, board=SIZE, layers=1,
+                       filters_per_layer=2)
+    key = jax.random.key(3)
+    mono = make_selfplay(CFG, FEATS, policy.module.apply,
+                         policy.module.apply, batch=4, max_moves=25)
+    want_mono = mono(policy.params, policy.params, key)
+    chunked = make_selfplay_chunked(
+        CFG, FEATS, policy.module.apply, policy.module.apply,
+        batch=4, max_moves=25, chunk=10)
+    outs = {}
+    for depth in (0, 1, 2):
+        outs[depth] = chunked(policy.params, policy.params, key,
+                              depth=depth)
+        _assert_trees_equal(want_mono, outs[depth])
+
+    # done-poll path: 5x5 games end far before 200 plies; every depth
+    # must agree with depth 0 (which itself pads from the first
+    # all-done segment, exactly like the pre-pipeline runner)
+    long = make_selfplay_chunked(
+        CFG, FEATS, policy.module.apply, policy.module.apply,
+        batch=4, max_moves=200, chunk=10)
+    ref = long(policy.params, policy.params, key, stop_when_done=True,
+               depth=0)
+    assert bool(np.asarray(ref.final.done).all())
+    assert ref.actions.shape[0] == 200       # zero-padded full shape
+    n_plies = int(np.asarray(ref.num_moves).max())
+    assert n_plies < 150                     # the early-exit mattered
+    # rows past the last live ply are the zero padding
+    assert not np.asarray(ref.live)[n_plies:].any()
+    for depth in (1, 2):
+        got = long(policy.params, policy.params, key,
+                   stop_when_done=True, depth=depth)
+        _assert_trees_equal(ref, got)
+
+
+def test_zero_iteration_bit_identical_across_depths(monkeypatch):
+    """One full zero iteration (search self-play + replay + update)
+    at env depth 0 vs 1: identical metrics and identical updated
+    parameters — the whole trainer is pipelining-invariant."""
+    import optax
+
+    from rocalphago_tpu.training.zero import (
+        init_zero_state,
+        make_zero_iteration,
+    )
+
+    iteration = make_zero_iteration(
+        CFG, FEATS, VFEATS, fake_policy, fake_value,
+        optax.sgd(1e-2), optax.sgd(1e-2), batch=2, move_limit=6,
+        n_sim=4, max_nodes=8, sim_chunk=2, replay_chunk=2)
+    results = {}
+    for depth in (0, 1):
+        monkeypatch.setenv(DEPTH_ENV, str(depth))
+        state = init_zero_state({"w": jnp.ones((2,))},
+                                {"w": jnp.ones((2,))},
+                                optax.sgd(1e-2), optax.sgd(1e-2),
+                                seed=7)
+        new_state, metrics = iteration(state)
+        results[depth] = (jax.device_get(new_state),
+                          jax.device_get(metrics))
+    s0, m0 = results[0]
+    s1, m1 = results[1]
+    _assert_trees_equal(s0, s1)
+    assert set(m0) == set(m1)
+    for k in m0:
+        np.testing.assert_array_equal(np.asarray(m0[k]),
+                                      np.asarray(m1[k]))
+
+
+def test_rl_chunked_iteration_bit_identical_across_depths(monkeypatch):
+    """The chunked REINFORCE iteration (donating replay segments +
+    pipelined selfplay) at env depth 0 vs 1."""
+    import optax
+
+    from rocalphago_tpu.io.checkpoint import pack_rng
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.training.rl import (
+        RLState,
+        make_rl_iteration_chunked,
+    )
+
+    policy = CNNPolicy(FEATS, board=SIZE, layers=1,
+                       filters_per_layer=2)
+    tx = optax.sgd(1e-3)
+    iteration = make_rl_iteration_chunked(
+        CFG, FEATS, policy.module.apply, tx, batch=2, move_limit=10,
+        temperature=1.0, chunk=4)
+    results = {}
+    for depth in (0, 1):
+        monkeypatch.setenv(DEPTH_ENV, str(depth))
+        state = RLState(policy.params, tx.init(policy.params),
+                        jnp.int32(0), pack_rng(jax.random.key(9)))
+        new_state, metrics = iteration(state, policy.params)
+        results[depth] = (jax.device_get(new_state),
+                          jax.device_get(metrics))
+    _assert_trees_equal(results[0][0], results[1][0])
+    for k in results[0][1]:
+        np.testing.assert_array_equal(
+            np.asarray(results[0][1][k]),
+            np.asarray(results[1][1][k]))
+
+
+# -------------------------------------------- selfplay gap A/B
+
+
+def test_selfplay_pipelined_gap_strictly_lower():
+    """The bench A/B's tier-1 twin on the self-play runner: the sync
+    done-poll pays a host gap per segment; the pipelined runner's
+    window never empties."""
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+    policy = CNNPolicy(FEATS, board=SIZE, layers=1,
+                       filters_per_layer=2)
+    run = make_selfplay_chunked(
+        CFG, FEATS, policy.module.apply, policy.module.apply,
+        batch=4, max_moves=24, chunk=4)
+    key = jax.random.key(1)
+    run(policy.params, policy.params, key)   # compile
+    pipes = {d: ChunkPipeline(depth=d) for d in (0, 1)}
+    for d, pipe in pipes.items():
+        run(policy.params, policy.params, key, stop_when_done=True,
+            pipeline=pipe)
+    assert pipes[0].gaps > 0
+    assert pipes[1].gaps == 0
+    assert pipes[1].host_gap_frac < pipes[0].host_gap_frac
+
+
+# ------------------------------------------ donation memory contract
+
+
+def test_chunk_loop_donates_but_callers_keep_their_trees():
+    """run_sims_chunked donates the slab it loops on, yet a caller's
+    tree (owned=False, the default) survives — the loop's defensive
+    copy eats the first donation. With owned=True the caller's
+    buffers are consumed (donated away on this backend)."""
+    from rocalphago_tpu.search.device_mcts import make_device_mcts
+
+    search = make_device_mcts(CFG, FEATS, VFEATS, fake_policy,
+                              fake_value, n_sim=8, max_nodes=16)
+    roots = new_states(CFG, 2)
+    tree = search.init(None, None, roots)
+    out, ran = search.run_sims_chunked(None, None, tree, chunk=4)
+    assert ran == 8
+    # the input tree is still alive and reusable
+    out2, _ = search.run_sims_chunked(None, None, tree, chunk=4)
+    _assert_trees_equal(out, out2)
+
+    owned_tree = search.init(None, None, roots)
+    search.run_sims_chunked(None, None, owned_tree, chunk=4,
+                            owned=True)
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(jax.device_get(owned_tree.visits))

@@ -277,6 +277,33 @@ def test_hang_abandoned_by_watchdog(nets):
     assert serve.last_fallback["reason"] == "hang"
 
 
+def test_search_chunk_barrier_fault_rides_the_ladder(nets):
+    """ISSUE 4: the device chunk loops declare a per-chunk fault
+    barrier (``search.chunk``) that fires host-side, once per chunk,
+    in dispatch order — even with a chunk in flight (pipelined
+    dispatch). A transient fault there aborts a search whose tree
+    slab was DONATED into the in-flight chunk; the ladder's reduced
+    retry re-enters ``get_move``, which must rebuild from scratch
+    (the subtree carry is dropped before the donating loop) and
+    serve a legal move."""
+    from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
+
+    pol, val = nets
+    player = DeviceMCTSPlayer(val, pol, n_sim=8, sim_chunk=2)
+    engine = GTPEngine(ResilientPlayer(player, policy=pol))
+    ok(engine, "boardsize 5")
+    faults.install("io_error@search.chunk:2")   # mid-loop, chunk 2
+    before = engine.state.copy()
+    vertex = ok(engine, "genmove b")
+    assert_legal_vertex(engine, vertex, before)
+    serve = engine._serve
+    assert serve.served["reduced"] == 1
+    assert serve.last_fallback["reason"] == "transient_error"
+    # the carried subtree was invalidated before the faulted loop —
+    # the retried search rebuilt instead of walking donated buffers
+    ok(engine, "genmove w")                  # clean follow-up works
+
+
 # ------------------------------------------------------- health probes
 
 
@@ -372,29 +399,36 @@ def test_full_degraded_game_completes(nets, tmp_path):
 # --------------------------------------------------- deadline (anytime)
 
 
-def test_deadline_returns_anytime_answer(nets):
-    """ISSUE 2 deadline proof: with chunk wall time far above the
-    clock's prediction, ``get_move`` stops at the hard deadline and
-    serves argmax-visits-so-far — within deadline + one chunk's
-    slack, not the full planned budget."""
+@pytest.mark.parametrize("depth", (0, 1))
+def test_deadline_returns_anytime_answer(nets, monkeypatch, depth):
+    """ISSUE 2 deadline proof, at both dispatch depths (ISSUE 4):
+    with chunk wall time far above the clock's prediction,
+    ``get_move`` stops at the hard deadline and serves
+    argmax-visits-so-far — within deadline plus one chunk's slack
+    per in-flight chunk (the pipelined overshoot bound: sync slack +
+    at most ``depth`` extra chunks), not the full planned budget.
+
+    The chunk loop dispatches via the DONATING program attribute
+    (``run_sims_donated``) — that is the interception point."""
     import time
 
     from rocalphago_tpu.search.device_mcts import DeviceMCTSPlayer
 
+    monkeypatch.setenv("ROCALPHAGO_PIPELINE_DEPTH", str(depth))
     pol, val = nets
     player = DeviceMCTSPlayer(val, pol, n_sim=32, sim_chunk=2,
                               reuse=False)
     state = pygo.GameState(size=SIZE, komi=7.5)
     player.get_move(state)                   # pay the compiles
     cfg, search = player._searcher_for(7.5)
-    orig = search.run_sims
+    orig = search.run_sims_donated
     chunk_s = 0.08
 
     def slow_run_sims(*args, **kwargs):
         time.sleep(chunk_s)
         return orig(*args, **kwargs)
 
-    search.run_sims = slow_run_sims
+    search.run_sims_donated = slow_run_sims
     try:
         # pathological prediction: the clock thinks the full 32 sims
         # fit easily; really each 2-sim chunk costs ~80ms
@@ -405,13 +439,14 @@ def test_deadline_returns_anytime_answer(nets):
         move = player.get_move(state)
         elapsed = time.monotonic() - t0
     finally:
-        search.run_sims = orig
+        search.run_sims_donated = orig
     assert player.last_deadline_hit
     assert player.deadline_hits == 1
     assert player.last_n_sim < 32            # truncated plan
     assert player.last_n_sim >= 2            # one-chunk anytime floor
-    # hard deadline + one chunk's slack (+ host margin)
-    assert elapsed < 0.1 + 2 * chunk_s + 0.3
+    # hard deadline + one chunk's slack + one per in-flight chunk
+    # (+ host margin)
+    assert elapsed < 0.1 + (2 + depth) * chunk_s + 0.3
     assert move is None or state.is_legal(move)
 
 
@@ -508,20 +543,20 @@ def test_gumbel_deadline_anytime(nets):
     state = pygo.GameState(size=SIZE, komi=7.5)
     player.get_move(state)                   # compiles
     _, search = player._searcher_for(7.5, 16)
-    orig = search.run_phase
+    orig = search.run_phase_donated
 
     def slow_run_phase(*args, **kwargs):
         time.sleep(0.08)
         return orig(*args, **kwargs)
 
-    search.run_phase = slow_run_phase
+    search.run_phase_donated = slow_run_phase
     try:
         player._clock.rate = 1e9
         player._clock.note = lambda *a, **k: None
         player.set_move_time(0.1)
         move = player.get_move(state)
     finally:
-        search.run_phase = orig
+        search.run_phase_donated = orig
     assert player.last_deadline_hit
     planned = sum(k * v for k, v in search.schedule)
     assert player.last_n_sim < planned
