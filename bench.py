@@ -34,6 +34,78 @@ _DEADLINE_MARK = "_GRAFT_BENCH_BUDGET_S"
 # plies below which a 19×19 game is considered truncated for metric
 # honesty (real games run 200–400; see VERDICT r2 "weak" #1)
 FULL_GAME_PLIES = 250
+# a competing process burning more than this fraction of one core
+# during the sample window marks the measurement contended
+_HEAVY_CPU_FRAC = 0.5
+
+
+def _host_contention(sample_s: float = 0.25):
+    """``(load_1m, contended, heavy_pids)`` — bench-capture isolation
+    (VERDICT r5 weak #1: the round-5 headline regressed 15.06 → 1.81
+    games/min because a 300-iteration training run shared the single
+    core with the driver's capture). Samples /proc twice ``sample_s``
+    apart and flags any OTHER process that burned >50% of a core in
+    between; also reports the 1-minute load average. Best-effort:
+    returns ``(None, False, [])`` where /proc (or getloadavg) is
+    unavailable — a missing reading must never fail the bench."""
+    try:
+        load1 = round(os.getloadavg()[0], 2)
+    except (OSError, AttributeError):
+        load1 = None
+
+    def cpu_ticks():
+        ticks = {}
+        try:
+            pids = os.listdir("/proc")
+        except OSError:
+            return ticks
+        me = os.getpid()
+        for pid in pids:
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    # fields after the ")" delimiter: state is index 0,
+                    # utime/stime are indices 11/12
+                    parts = f.read().rsplit(") ", 1)[-1].split()
+                ticks[int(pid)] = int(parts[11]) + int(parts[12])
+            except (OSError, IndexError, ValueError):
+                continue
+        return ticks
+
+    before = cpu_ticks()
+    if not before:
+        return load1, False, []
+    time.sleep(sample_s)
+    after = cpu_ticks()
+    try:
+        hz = os.sysconf("SC_CLK_TCK")
+    except (OSError, ValueError, AttributeError):
+        hz = 100
+    heavy = sorted(
+        pid for pid, t in after.items()
+        if pid in before
+        and (t - before[pid]) / hz / sample_s > _HEAVY_CPU_FRAC)
+    return load1, bool(heavy), heavy
+
+
+def _honest_metric(metric: str, value: float, target: float, *,
+                   truncated: bool, includes_compile: bool,
+                   contended: bool):
+    """``(metric_name, vs_baseline)`` — the headline honesty rules in
+    one place (VERDICT r5 next-round #2): a truncated-game rate or a
+    contended-host capture reports under a SUFFIXED metric name, never
+    the headline's, and no compromised measurement (truncated,
+    compile-included, or contended) ever emits a ratio against the
+    full-game north star."""
+    name = metric
+    if truncated:
+        name += "_truncated"
+    if contended:
+        name += "_contended"
+    compromised = truncated or includes_compile or contended
+    return name, (None if compromised
+                  else round(value / max(target, 1e-9), 3))
 
 
 def _self_size_from_results():
@@ -106,14 +178,12 @@ def _measure() -> None:
         # so the CPU fallback must override the config too
         jax.config.update("jax_platforms", "cpu")
 
-    # persistent XLA compile cache: repeat bench runs skip the 20-40s
+    # persistent XLA compile cache (shared runtime helper, env knob
+    # ROCALPHAGO_COMPILE_CACHE): repeat bench runs skip the 20-40s
     # first-compile cost of the big self-play program
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.expanduser("~/.cache/jax_comp_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:  # noqa: BLE001 — older jax without the knobs
-        pass
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()
 
     from rocalphago_tpu.engine.jaxgo import GoConfig
     from rocalphago_tpu.models import CNNPolicy
@@ -294,13 +364,24 @@ def _measure() -> None:
         host_winners(cfg, boards)
         return valid
 
-    # compile rep (timed separately as a last-resort sample);
+    # compile rep — the UNTIMED warmup that keeps the headline row at
+    # includes_compile: false (it only enters the measurement as a
+    # last-resort sample when no post-compile rep fits the budget);
     # jax.device_get forces a host transfer, which waits for real
     # completion even on backends where block_until_ready returns
     # early (axon tunnel)
     tc0 = time.time()
     compile_valid = one(0)
     compile_dt = time.time() - tc0
+
+    # bench-capture isolation: sample host contention right before the
+    # measured reps (a competing heavy PID here poisoned the r5
+    # headline); the reading lands in the result line either way
+    load_1m, contended, heavy_pids = _host_contention()
+    if contended:
+        print(f"bench: host contended (load_1m={load_1m}, heavy "
+              f"pids {heavy_pids}) — measuring anyway, reporting "
+              "under the _contended metric name", file=sys.stderr)
 
     pipe.reset_stats()      # the compile rep pollutes gap accounting
 
@@ -348,17 +429,17 @@ def _measure() -> None:
     games_per_min = batch / dt * 60.0
     target = 200.0 * (n_dev / 16.0)  # north star prorated per chip
     truncated = max_moves < FULL_GAME_PLIES
+    # honesty rules (_honest_metric): truncated/contended runs report
+    # under suffixed names, and no compromised measurement (truncated,
+    # compile-included, contended) emits a north-star ratio
+    name, vs_baseline = _honest_metric(
+        METRIC, games_per_min, target, truncated=truncated,
+        includes_compile=includes_compile, contended=contended)
     line = {
-        # a truncated-game rate is NOT the headline metric — a capped
-        # game is several-fold shorter than a real one, so the number
-        # is published under its own name and never as
-        # selfplay_19x19_games_per_min (VERDICT r3 weak #1)
-        "metric": METRIC + ("_truncated" if truncated else ""),
+        "metric": name,
         "value": round(games_per_min, 2),
         "unit": "games/min",
-        # ...and never a ratio against the full-game north star
-        "vs_baseline": (None if truncated
-                        else round(games_per_min / target, 3)),
+        "vs_baseline": vs_baseline,
         "platform": platform,
         "n_devices": n_dev,
         "batch": batch,
@@ -366,11 +447,14 @@ def _measure() -> None:
         "chunk": chunk,
         "pipeline_depth": default_depth(),
         "host_gap_frac": round(pipe.host_gap_frac, 4),
+        "load_1m": load_1m,
     }
     if gap_frac_sync is not None:
         line["host_gap_frac_sync"] = gap_frac_sync
     if truncated:
         line["truncated"] = True
+    if contended:
+        line["contended"] = True
     if includes_compile:
         line["includes_compile"] = True
     print(json.dumps(line))

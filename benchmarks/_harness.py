@@ -18,21 +18,21 @@ import time
 import jax
 
 def enable_compile_cache() -> None:
-    """Persistent XLA compile cache (same knob bench.py uses): cost-
+    """Persistent XLA compile cache via the SHARED runtime helper
+    (``runtime.compilecache`` — the same knob every CLI entry point
+    now runs; ``ROCALPHAGO_COMPILE_CACHE`` overrides/disables): cost-
     analysis AOT compiles and the jit dispatch path then share one
     compile per program instead of paying the 20-40s TPU compile
     twice. Called from :func:`std_parser` (i.e. benchmark entry
     points only) — NOT at import time, because the test suite imports
     this module for :func:`harvest_chase_lanes` and must keep its own
-    cache configuration."""
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.expanduser("~/.cache/jax_comp_cache"))
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:  # noqa: BLE001 — older jax without the knobs
-        pass
+    cache configuration (the helper's first-config-wins rule also
+    protects that case)."""
+    from rocalphago_tpu.runtime.compilecache import (
+        enable_compile_cache as _enable,
+    )
+
+    _enable()
 
 
 # bf16 peak FLOP/s per chip by TPU generation (public spec sheets);

@@ -1,0 +1,130 @@
+"""Encode-path A/B harness: gating × phase-1 depth × chase formulation.
+
+The 48-plane encode is the self-play ceiling and the two ladder planes
+are ~93% of it (BENCH_RESULTS.md "Bottleneck analysis") — yet until
+this harness every encode knob was a platform heuristic. This measures
+each configuration of the three axes that matter and records one
+results.jsonl row per config, so the defaults in
+``features/ladders.py`` are set from numbers (the
+``jaxgo._dense_engine`` discipline):
+
+* **gating** — ``shared`` (the pooled, gated capture+escape chase of
+  ``ladders.ladder_planes``) vs ``split`` (the legacy per-plane
+  chases; ``$ROCALPHAGO_LADDER_GATE``);
+* **phase1** — the two-phase chase schedule's lockstep depth
+  (``$ROCALPHAGO_LADDER_PHASE1``; a value ≥ ladder depth recovers the
+  old single-phase FIXED-RUNG read — the baseline the gated/early-exit
+  path is judged against);
+* **impl** — ``xla`` (batch-lockstep while_loop) vs ``pallas`` (the
+  per-lane TPU kernel ``ops/chase.py``; ``interpret`` runs it in the
+  Pallas interpreter — correctness-only, not perf-comparable).
+
+Every row carries ``us_per_pos`` (per-position microseconds — the
+unit ``scripts/bench_report.py``'s encode column renders) plus the
+axis fields, and one ``encode_noladder`` row measures the same batch
+without the ladder planes so the ladder share of encode is a recorded
+number, not folklore. The env knobs are read at TRACE time, so each
+config traces a fresh program — the A/B never reuses a stale cached
+trace. TPU rows: the ``encode_*`` steps in
+``scripts/tpu_window_hunter2.sh`` run this harness per config in the
+next healthy window.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+from benchmarks._harness import (  # noqa: E402
+    random_game_states,
+    report,
+    std_parser,
+    timed,
+)
+
+
+def main() -> None:
+    import jax
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.features import DEFAULT_FEATURES
+    from rocalphago_tpu.features.planes import encode
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--gating", default="shared",
+                    help="comma list: shared,split")
+    ap.add_argument("--phase1", default="4",
+                    help="comma list of phase-1 depths (>= --depth "
+                         "recovers the single-phase fixed-rung read)")
+    ap.add_argument("--impl", default="xla",
+                    help="comma list: xla,pallas,interpret")
+    ap.add_argument("--depth", type=int, default=40)
+    ap.add_argument("--slots", type=int, default=None,
+                    help="ladder_chase_slots override (default: the "
+                         "encoder's measured default)")
+    ap.add_argument("--skip-noladder", action="store_true")
+    args = ap.parse_args()
+    batch = args.batch or (256 if jax.devices()[0].platform == "tpu"
+                           else 16)
+    cfg = GoConfig(size=args.board)
+
+    # mid-game positions: 120 random-legal plies — dense boards with
+    # real multi-ladder structure, the encode's stressed case
+    states = jax.block_until_ready(
+        random_game_states(cfg, batch, 120, jax.random.key(0)))
+
+    slot_kw = ({"ladder_chase_slots": args.slots}
+               if args.slots is not None else {})
+
+    def build(features):
+        # a fresh partial per config → a fresh trace, so the env
+        # knobs (read at trace time) really take effect per row
+        return jax.jit(jax.vmap(functools.partial(
+            encode, cfg, features=features,
+            ladder_depth=args.depth, **slot_kw)))
+
+    def measure(features):
+        enc = build(features)
+        return timed(lambda: jax.device_get(enc(states)),
+                     reps=args.reps, profile_dir=None)
+
+    if not args.skip_noladder:
+        no_ladder = tuple(f for f in DEFAULT_FEATURES
+                          if not f.startswith("ladder"))
+        dt = measure(no_ladder)
+        report("encode_noladder", batch / dt, "positions/s",
+               batch=batch, board=args.board,
+               us_per_pos=round(1e6 * dt / batch, 1))
+
+    impl_env = {"xla": "", "pallas": "1", "interpret": "interpret"}
+    for impl in args.impl.split(","):
+        if impl not in impl_env:
+            print(f"bench_encode: unknown impl {impl!r}",
+                  file=sys.stderr)
+            continue
+        for gating in args.gating.split(","):
+            for phase1 in (int(p) for p in args.phase1.split(",")):
+                os.environ["ROCALPHAGO_PALLAS_CHASE"] = impl_env[impl]
+                os.environ["ROCALPHAGO_LADDER_GATE"] = gating
+                os.environ["ROCALPHAGO_LADDER_PHASE1"] = str(phase1)
+                t0 = time.time()
+                try:
+                    dt = measure(DEFAULT_FEATURES)
+                except Exception as e:  # noqa: BLE001 — keep the sweep
+                    print(f"bench_encode: {impl}/{gating}/p{phase1} "
+                          f"failed after {time.time() - t0:.0f}s: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+                    continue
+                report("encode_ab", batch / dt, "positions/s",
+                       batch=batch, board=args.board,
+                       gating=gating, phase1=phase1, chase_impl=impl,
+                       us_per_pos=round(1e6 * dt / batch, 1),
+                       **({"slots": args.slots}
+                          if args.slots is not None else {}))
+
+
+if __name__ == "__main__":
+    main()

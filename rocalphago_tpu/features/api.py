@@ -7,11 +7,24 @@ Parity: ``AlphaGo/preprocessing/preprocess.py::Preprocess``
 reference's Theano NCHW, and states are the device engine's
 :class:`~rocalphago_tpu.engine.jaxgo.GoState` (use
 :func:`~rocalphago_tpu.engine.jaxgo.from_pygo` at host boundaries).
+
+Observability (docs/OBSERVABILITY.md): both jitted encode programs are
+compile-tracked (``jax_compiles_total{entry="encode.one"|"encode.batch"}``
+— the warm-cache smoke in ``tests/test_features.py`` pins that a
+repeat call compiles nothing), every call lands in the per-position
+encode-cost histogram ``encode_pos_us{board=...}`` plus the
+``encode_positions_total`` counter, and each call opens an ``encode``
+span so ``scripts/obs_report.py`` can show where encode time goes.
+Calls BLOCK on the result (``jax.block_until_ready``) — this API is
+the host boundary (GTP, host MCTS waves, data conversion), whose
+callers consume the tensor immediately, and blocking is what makes
+the per-position microseconds honest instead of dispatch latency.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 
@@ -22,6 +35,14 @@ from rocalphago_tpu.features.pyfeatures import (
     FEATURE_PLANES,
     output_planes,
 )
+from rocalphago_tpu.obs import jaxobs, trace
+from rocalphago_tpu.obs import registry as obs_registry
+
+#: per-position encode cost edges, MICROSECONDS (the headline CPU
+#: encode sits at ~10³–10⁴ µs/pos; a healthy chip should land 10¹–10²)
+ENCODE_US_EDGES = (10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+                   2500.0, 5000.0, 10000.0, 25000.0, 50000.0,
+                   100000.0, 250000.0, 1000000.0)
 
 
 class Preprocess:
@@ -38,21 +59,30 @@ class Preprocess:
     - ``ladder_lanes``: max candidate (move, prey) pairs examined per
       plane (default 16).
     - ``ladder_chase_slots``: max ladder chases actually *run* per
-      plane (default 4). Chases beyond capacity are SILENTLY dropped
-      in board row-major candidate order and their cells read the
-      conservative ``False`` (a truncated read never asserts a
-      capture or an escape). Real positions essentially never hold
-      >4 simultaneous live chases per color (randomized differential
-      bound: <0.3% of cells; ``tests/test_features.py``), but dense
-      whole-board ladder problems can — raise this (e.g. to 16) when
-      encoding such positions; cost is roughly linear in the chase
-      loop's width.
+      encode (default 6). When both ladder planes are requested the
+      capacity is SHARED between them (one pooled gated chase,
+      capture candidates first — ``ladders.ladder_planes``); a
+      single-plane encode gets the full capacity for that plane.
+      Chases beyond capacity are SILENTLY dropped in board row-major
+      candidate order and their cells read the conservative ``False``
+      (a truncated read never asserts a capture or an escape). Real
+      positions essentially never hold >4 simultaneous live chases
+      per color (randomized differential bound: <0.3% of cells;
+      ``tests/test_features.py``), but dense whole-board ladder
+      problems can — raise this (e.g. to 16) when encoding such
+      positions; cost is roughly linear in the chase loop's width.
+      MEASURED DEFAULT 6: the CPU A/B (``benchmarks/bench_encode.py``,
+      dense 19×19, shared/phase1=2) ran ~85 pos/s at 4 slots, ~74 at
+      6, ~69 at 8 — 6 trades ~13% against the fastest setting to keep
+      the POOLED capacity near the pre-overhaul per-plane total
+      (4 + 4) and dense-board truncation well inside the 1% oracle
+      bound (BENCH_RESULTS.md "Encode A/B").
     """
 
     def __init__(self, feature_list=DEFAULT_FEATURES,
                  cfg: GoConfig = GoConfig(),
                  ladder_depth: int = 40, ladder_lanes: int = 16,
-                 ladder_chase_slots: int = 4):
+                 ladder_chase_slots: int = 6):
         unknown = [f for f in feature_list if f not in FEATURE_PLANES]
         if unknown:
             raise KeyError(f"unknown features: {unknown}")
@@ -65,13 +95,29 @@ class Preprocess:
             encode, cfg, features=self.feature_list,
             ladder_depth=ladder_depth, ladder_lanes=ladder_lanes,
             ladder_chase_slots=ladder_chase_slots)
-        self._one = jax.jit(fn)
-        self._batch = jax.jit(jax.vmap(fn))
+        self._one = jaxobs.track("encode.one", jax.jit(fn))
+        self._batch = jaxobs.track("encode.batch",
+                                   jax.jit(jax.vmap(fn)))
+        board = str(cfg.size)
+        self._pos_us = obs_registry.histogram(
+            "encode_pos_us", edges=ENCODE_US_EDGES, board=board)
+        self._positions = obs_registry.counter(
+            "encode_positions_total", board=board)
+
+    def _timed(self, fn, arg, batch: int) -> jax.Array:
+        with trace.span("encode", board=self.cfg.size, batch=batch):
+            t0 = time.monotonic()
+            out = jax.block_until_ready(fn(arg))
+            dt = time.monotonic() - t0
+        self._pos_us.observe(dt * 1e6 / max(batch, 1))
+        self._positions.inc(batch)
+        return out
 
     def state_to_tensor(self, state: GoState) -> jax.Array:
         """One state → ``[1, size, size, F]`` float32."""
-        return self._one(state)[None]
+        return self._timed(self._one, state, 1)[None]
 
     def states_to_tensor(self, states: GoState) -> jax.Array:
         """Batched states (leading axis) → ``[B, size, size, F]``."""
-        return self._batch(states)
+        batch = int(jax.tree.leaves(states)[0].shape[0])
+        return self._timed(self._batch, states, batch)

@@ -18,7 +18,14 @@ branching doesn't map to XLA, so the TPU design (SURVEY.md §7 hard part
   chaser picks the wrong side; it remains an approximation vs the
   oracle's full branching on pathological shapes (tests use positions
   where both agree);
-* ko inside the read is ignored (as in the reference's reader).
+* ko inside the read is ignored (as in the reference's reader);
+* **shared, gated chase slots** — the full encoder reads BOTH planes
+  through :func:`ladder_planes`: one candidate analysis, slot entry
+  gated on a live undecided chase (prey back at exactly 2 liberties
+  after the opening), and one pooled rung loop whose lanes mix
+  capture (opponent) and escape (own) prey — the chase is
+  prey-color-agnostic. See docs/PERFORMANCE.md "Encode path" for the
+  gating model and the measured defaults.
 
 All functions are pure and vmap over games.
 """
@@ -51,13 +58,37 @@ def _phase1_depth() -> int:
     """Two-phase chase schedule knob (see _compacted_chase): phase 1
     reads all slots to this many rungs lockstep; still-live lanes
     then finish one at a time at 1/slots the loop width. Most lanes
-    settle within a few rungs (measured, random 19×19 mid-games: CPU
-    encode 2.5× faster at 4 than single-phase). Read from
-    ``$ROCALPHAGO_LADDER_PHASE1`` at TRACE time (same policy as
-    ``_chase_impl``) so on-chip A/B sweeps can flip it per run.
-    Floor 1: a while_loop body always runs once for live lanes, so a
-    "depth-0" phase 1 would still play a rung and over-read by one."""
-    return max(1, int(os.environ.get("ROCALPHAGO_LADDER_PHASE1", "4")))
+    settle within a few rungs. MEASURED DEFAULT 2 (the
+    ``jaxgo._dense_engine`` discipline): ``benchmarks/bench_encode.py``
+    CPU A/B on dense 19×19 mid-games, batch 16, shared gating —
+    depth 2 won both slot sweeps (91.0 pos/s vs 77.1 @ 1 / 81.4 @ 4
+    at 4 slots; 73.9 vs 73.3 / 71.3 at the default 6 — within the
+    run-to-run ~10% noise there), 8 pays extra lockstep rungs
+    whenever any lane runs deep (62.2), and 40 recovers the old
+    single-phase fixed-rung read (21.8 — the baseline; see
+    BENCH_RESULTS.md "Encode A/B"). TPU rows are queued in
+    ``scripts/tpu_window_hunter2.sh`` (``encode_*`` steps); revisit
+    when they land. Read from ``$ROCALPHAGO_LADDER_PHASE1`` at TRACE
+    time (same policy as ``_chase_impl``) so A/B sweeps can flip it
+    per run. Floor 1: a while_loop body always runs once for live
+    lanes, so a "depth-0" phase 1 would still play a rung and
+    over-read by one."""
+    return max(1, int(os.environ.get("ROCALPHAGO_LADDER_PHASE1", "2")))
+
+
+def _ladder_gating() -> str:
+    """Which slot-gating formulation :func:`ladder_planes` traces:
+    ``"shared"`` (default) pools BOTH planes' gated chase candidates
+    into ONE compacted slot set and ONE lockstep rung loop;
+    ``"split"`` keeps the legacy per-plane chases (two loops of
+    ``chase_slots`` each — the pre-overhaul formulation, kept as the
+    A/B baseline). MEASURED DEFAULT: shared wins the CPU A/B
+    (``benchmarks/bench_encode.py``; the two planes' rung loops merge,
+    so a deep chase pays its trips once instead of once per plane —
+    see BENCH_RESULTS.md "Encode A/B"). Read from
+    ``$ROCALPHAGO_LADDER_GATE`` at trace time."""
+    v = os.environ.get("ROCALPHAGO_LADDER_GATE", "shared")
+    return "split" if v in ("split", "0", "off") else "shared"
 
 
 def _place(cfg: GoConfig, board, gd: GroupData, action, color):
@@ -423,8 +454,11 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
     encoder's vmap every board pays every trip). Overflow beyond
     ``slots`` truncates — the same bounded-capacity contract as
     ``_candidate_lanes``; callers must map uncovered lanes to the
-    conservative plane value. Returns ``(captured [K], covered [K])``
-    where ``covered`` marks lanes whose chase actually ran."""
+    conservative plane value. Lanes may mix prey colors (the pooled
+    capture+escape set from :func:`ladder_planes`): the chase reads
+    each lane's prey color from its board. Returns ``(captured [K],
+    covered [K])`` where ``covered`` marks lanes whose chase actually
+    ran."""
     k = need_chase.shape[0]
     (slot_idx,) = jnp.nonzero(need_chase, size=slots, fill_value=k)
     valid = slot_idx < k
@@ -493,13 +527,25 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
 
 def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
                      legal, prey_libs: int, prey_is_opp: bool,
-                     lanes: int):
+                     lanes: int, analysis=None):
     """Compact (move, prey) pairs matching the precondition into K
-    lanes. Returns (move_pt [K], prey_pt [K], valid [K])."""
+    lanes. Returns (move_pt [K], prey_pt [K], valid [K]).
+
+    This is the first gating stage (docs/PERFORMANCE.md "Encode
+    path"): only strings at the exact ladder precondition — opponent
+    strings at 2 liberties (capture) or own strings in atari (escape)
+    — generate lanes at all. EXACT by the planes' definitions: a
+    ladder capture starts by filling one of a 2-liberty group's
+    liberties (a 1-liberty group is a plain capture, ≥3 can't be
+    laddered this ply), and a ladder escape extends an atari group at
+    its last liberty. Pass ``analysis`` (a
+    :func:`jaxgo.neighbor_analysis` result) to share one neighbor
+    lookup between both planes' enumerations."""
     n = cfg.num_points
     nbrs = neighbors_for(cfg.size)
-    nbr_color, nbr_root, uniq, _ = neighbor_analysis(
-        cfg, state.board, gd.labels)
+    if analysis is None:
+        analysis = neighbor_analysis(cfg, state.board, gd.labels)
+    nbr_color, nbr_root, uniq, _ = analysis
 
     want = -state.turn if prey_is_opp else state.turn
     cand = (legal[:, None] & uniq & (nbr_color == want)
@@ -513,15 +559,18 @@ def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
     return move_pt, prey_pt, valid
 
 
-def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
-                         legal, depth: int = 40, lanes: int = 16,
-                         chase_slots: int = 4) -> jax.Array:
-    """bool [N]: legal moves that ladder-capture an adjacent two-liberty
-    opponent group."""
-    n = cfg.num_points
+def _capture_opening(cfg: GoConfig, state: GoState, gd: GroupData,
+                     move_pt, prey_pt, valid):
+    """Vmapped capture opening over the candidate lanes: play the
+    chaser's first move, score the prey's forced response, and carry
+    the incremental labeling through both plies. Returns ``(boards
+    [K,N], labels [K,N], need_chase [K], direct [K])`` — the second
+    gating stage: ONLY lanes whose response leaves the prey back at
+    exactly 2 liberties (``respL == 2`` — a live, undecided chase)
+    enter the chase slots. Exact: ``respL <= 1`` is a capture decided
+    with no chase (``direct``), ``respL >= 3`` is a clean escape, and
+    both are classified here without consuming a slot."""
     me = state.turn
-    move_pt, prey_pt, valid = _candidate_lanes(
-        cfg, state, gd, legal, prey_libs=2, prey_is_opp=True, lanes=lanes)
 
     def opening(mv, pr, ok):
         board1, placed, cap0 = _place(cfg, state.board, gd, mv, me)
@@ -542,23 +591,18 @@ def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
         direct = ok & placed & (respL <= 1)   # captured with no chase
         return b2r, lab2, need_chase, direct
 
-    b2r, lab2, need_chase, direct = jax.vmap(opening)(
-        move_pt, prey_pt, valid)
-    chased, _ = _compacted_chase(cfg, b2r, lab2, prey_pt, need_chase,
-                                 depth, chase_slots)
-    captured = direct | (need_chase & chased)
-    return jnp.zeros((n,), jnp.bool_).at[move_pt].max(captured & valid)
+    return jax.vmap(opening)(move_pt, prey_pt, valid)
 
 
-def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
-                        legal, depth: int = 40, lanes: int = 16,
-                        chase_slots: int = 4) -> jax.Array:
-    """bool [N]: legal moves that rescue an own group in atari from a
-    ladder (extension at its last liberty that survives the read)."""
-    n = cfg.num_points
+def _escape_opening(cfg: GoConfig, state: GoState, gd: GroupData,
+                    move_pt, prey_pt, valid):
+    """Vmapped escape opening: extend the atari group at its last
+    liberty and recount. Second gating stage for the escape plane:
+    only extensions that land on exactly 2 liberties (``L == 2`` — an
+    undecided ladder) enter the chase slots; ``L >= 3`` is a decided
+    escape (``direct``), ``L <= 1`` a decided failure — both
+    classified slot-free."""
     me = state.turn
-    move_pt, prey_pt, valid = _candidate_lanes(
-        cfg, state, gd, legal, prey_libs=1, prey_is_opp=False, lanes=lanes)
 
     def opening(mv, pr, ok):
         board1, placed, cap0 = _place(cfg, state.board, gd, mv, me)
@@ -573,11 +617,124 @@ def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
         direct = ok & placed & (L >= 3)       # escaped with no chase
         return b1r, lab1, need_chase, direct
 
-    b1r, lab1, need_chase, direct = jax.vmap(opening)(
-        move_pt, prey_pt, valid)
+    return jax.vmap(opening)(move_pt, prey_pt, valid)
+
+
+def ladder_capture_plane(cfg: GoConfig, state: GoState, gd: GroupData,
+                         legal, depth: int = 40, lanes: int = 16,
+                         chase_slots: int = 6) -> jax.Array:
+    """bool [N]: legal moves that ladder-capture an adjacent two-liberty
+    opponent group. Single-plane entry point (tests, one-plane
+    encodes); the full encoder computes both planes through
+    :func:`ladder_planes`, which shares the candidate analysis and the
+    chase between them."""
+    n = cfg.num_points
+    move_pt, prey_pt, valid = _candidate_lanes(
+        cfg, state, gd, legal, prey_libs=2, prey_is_opp=True, lanes=lanes)
+    b2r, lab2, need_chase, direct = _capture_opening(
+        cfg, state, gd, move_pt, prey_pt, valid)
+    chased, _ = _compacted_chase(cfg, b2r, lab2, prey_pt, need_chase,
+                                 depth, chase_slots)
+    captured = direct | (need_chase & chased)
+    return jnp.zeros((n,), jnp.bool_).at[move_pt].max(captured & valid)
+
+
+def ladder_escape_plane(cfg: GoConfig, state: GoState, gd: GroupData,
+                        legal, depth: int = 40, lanes: int = 16,
+                        chase_slots: int = 6) -> jax.Array:
+    """bool [N]: legal moves that rescue an own group in atari from a
+    ladder (extension at its last liberty that survives the read).
+    Single-plane entry point — see :func:`ladder_capture_plane`."""
+    n = cfg.num_points
+    move_pt, prey_pt, valid = _candidate_lanes(
+        cfg, state, gd, legal, prey_libs=1, prey_is_opp=False, lanes=lanes)
+    b1r, lab1, need_chase, direct = _escape_opening(
+        cfg, state, gd, move_pt, prey_pt, valid)
     chased, covered = _compacted_chase(cfg, b1r, lab1, prey_pt,
                                        need_chase, depth, chase_slots)
     # overflow lanes (chase needed but no slot) must stay conservative
     # False — an unread escape is not asserted
     escaped = direct | (need_chase & covered & ~chased)
     return jnp.zeros((n,), jnp.bool_).at[move_pt].max(escaped & valid)
+
+
+def ladder_planes(cfg: GoConfig, state: GoState, gd: GroupData,
+                  legal, depth: int = 40, lanes: int = 16,
+                  chase_slots: int = 6):
+    """Both ladder planes from ONE shared read:
+    ``(ladder_capture [N], ladder_escape [N])``.
+
+    The encode-path overhaul (docs/PERFORMANCE.md "Encode path").
+    Ladder work scales with the number of GENUINELY CHASEABLE strings,
+    not with the board, via three gates and one shared loop:
+
+    1. **candidate gating** (:func:`_candidate_lanes`) — only strings
+       at the ladder precondition (opponent strings at 2 liberties /
+       own strings in atari) generate lanes; one
+       :func:`jaxgo.neighbor_analysis` serves both planes. Exact by
+       definition of the planes.
+    2. **slot gating** (the openings) — a lane consumes a chase slot
+       ONLY when its opening leaves a live, undecided chase (prey back
+       at exactly 2 liberties). Decided openings (direct capture,
+       clean escape, illegal move) are classified slot-free — exact,
+       because a prey at ≤1 liberties after the forced response is
+       captured outright and one at ≥3 can no longer be laddered by
+       the 2-ply reader.
+    3. **shared chase slots** — both planes' surviving candidates are
+       pooled into ONE ``chase_slots``-wide compacted chase (the chase
+       is prey-color-agnostic: :func:`_chase` reads the prey's color
+       from its board, so capture lanes — opponent prey — and escape
+       lanes — own prey — share lanes of the same ``lax.while_loop``).
+       One lockstep rung loop + one scalar deep tail replace the two
+       per-plane loops, so a deep ladder pays its trips once, not once
+       per plane. The loop EXITS EARLY the trip every pooled chase has
+       resolved (``_chase``'s ``done`` reduction — with zero live
+       chases it runs zero trips).
+
+    Truncation contract: capacity is SHARED — capture candidates fill
+    slots first (compaction order), escape candidates take what's
+    left; overflow beyond ``chase_slots`` reads the conservative False
+    on both planes (never a spurious capture or escape). With slots ≥
+    live chases the pooled read is BIT-IDENTICAL to the split
+    formulation (tests/test_features.py::TestSharedGating).
+
+    ``$ROCALPHAGO_LADDER_GATE=split`` traces the legacy per-plane
+    formulation instead (two independent ``chase_slots``-wide chases)
+    — the measured A/B baseline (``benchmarks/bench_encode.py``).
+    """
+    n = cfg.num_points
+    analysis = neighbor_analysis(cfg, state.board, gd.labels)
+    cap_mv, cap_pr, cap_ok = _candidate_lanes(
+        cfg, state, gd, legal, prey_libs=2, prey_is_opp=True,
+        lanes=lanes, analysis=analysis)
+    esc_mv, esc_pr, esc_ok = _candidate_lanes(
+        cfg, state, gd, legal, prey_libs=1, prey_is_opp=False,
+        lanes=lanes, analysis=analysis)
+    cap_b, cap_l, cap_need, cap_direct = _capture_opening(
+        cfg, state, gd, cap_mv, cap_pr, cap_ok)
+    esc_b, esc_l, esc_need, esc_direct = _escape_opening(
+        cfg, state, gd, esc_mv, esc_pr, esc_ok)
+
+    if _ladder_gating() == "split":
+        # legacy baseline: two independent chases, chase_slots each
+        cap_chased, _ = _compacted_chase(
+            cfg, cap_b, cap_l, cap_pr, cap_need, depth, chase_slots)
+        esc_chased, esc_cov = _compacted_chase(
+            cfg, esc_b, esc_l, esc_pr, esc_need, depth, chase_slots)
+    else:
+        chased, covered = _compacted_chase(
+            cfg, jnp.concatenate([cap_b, esc_b]),
+            jnp.concatenate([cap_l, esc_l]),
+            jnp.concatenate([cap_pr, esc_pr]),
+            jnp.concatenate([cap_need, esc_need]), depth, chase_slots)
+        cap_chased, esc_chased = chased[:lanes], chased[lanes:]
+        esc_cov = covered[lanes:]
+
+    captured = cap_direct | (cap_need & cap_chased)
+    # overflow lanes (chase needed but no slot) stay conservative
+    # False on both planes — an unread chase asserts nothing
+    escaped = esc_direct | (esc_need & esc_cov & ~esc_chased)
+    return (jnp.zeros((n,), jnp.bool_).at[cap_mv].max(
+                captured & cap_ok),
+            jnp.zeros((n,), jnp.bool_).at[esc_mv].max(
+                escaped & esc_ok))

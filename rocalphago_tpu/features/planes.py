@@ -19,6 +19,7 @@ Everything vmaps over games; no per-cell Python anywhere.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -122,7 +123,7 @@ def encode(cfg: GoConfig, state: GoState,
            features: tuple = None,
            ladder_depth: int = 40,
            ladder_lanes: int = 16,
-           ladder_chase_slots: int = 4,
+           ladder_chase_slots: int = 6,
            gd: "GroupData | None" = None) -> jax.Array:
     """Encode one game state → float32 ``[size, size, F]`` (NHWC).
 
@@ -131,6 +132,11 @@ def encode(cfg: GoConfig, state: GoState,
     (built with ``with_member`` if the candidate-simulation planes are
     requested) to share one flood fill with the caller's own analysis
     — the self-play ply does this (encode + sensibleness per ply).
+
+    When BOTH ladder planes are requested (the default set), they are
+    computed by ONE shared, gated read (:func:`ladders.ladder_planes`:
+    one candidate analysis, one pooled chase-slot set, one rung loop)
+    — the encode-path overhaul; see docs/PERFORMANCE.md "Encode path".
     """
     from rocalphago_tpu.features import ladders as _ladders
     from rocalphago_tpu.features.pyfeatures import (
@@ -157,6 +163,15 @@ def encode(cfg: GoConfig, state: GoState,
     else:
         legal = legal_mask(cfg, state, gd)[:n]
 
+    # both ladder planes ride one shared gated chase; a single-plane
+    # request keeps the cheaper per-plane read
+    lad_cap = lad_esc = None
+    lad_kw = dict(depth=ladder_depth, lanes=ladder_lanes,
+                  chase_slots=ladder_chase_slots)
+    if "ladder_capture" in features and "ladder_escape" in features:
+        lad_cap, lad_esc = _ladders.ladder_planes(
+            cfg, state, gd, legal, **lad_kw)
+
     out = []
     for name in features:
         if name == "board":
@@ -177,14 +192,14 @@ def encode(cfg: GoConfig, state: GoState,
         elif name == "liberties_after":
             f = _one_hot8(ci.libs_after, 1, legal)
         elif name == "ladder_capture":
-            cap = _ladders.ladder_capture_plane(
-                cfg, state, gd, legal, depth=ladder_depth,
-                lanes=ladder_lanes, chase_slots=ladder_chase_slots)
+            cap = (lad_cap if lad_cap is not None
+                   else _ladders.ladder_capture_plane(
+                       cfg, state, gd, legal, **lad_kw))
             f = cap.astype(jnp.float32)[:, None]
         elif name == "ladder_escape":
-            esc = _ladders.ladder_escape_plane(
-                cfg, state, gd, legal, depth=ladder_depth,
-                lanes=ladder_lanes, chase_slots=ladder_chase_slots)
+            esc = (lad_esc if lad_esc is not None
+                   else _ladders.ladder_escape_plane(
+                       cfg, state, gd, legal, **lad_kw))
             f = esc.astype(jnp.float32)[:, None]
         elif name == "sensibleness":
             f = (legal & ~true_eyes(cfg, state, me)).astype(
@@ -200,3 +215,24 @@ def encode(cfg: GoConfig, state: GoState,
         out.append(f)
     flat = jnp.concatenate(out, axis=-1)
     return flat.reshape(cfg.size, cfg.size, -1)
+
+
+def batched_encoder(cfg: GoConfig, features: tuple, **encode_kwargs):
+    """``(states, gd=None) -> planes [B, size, size, F]`` — the ONE
+    definition of the vmapped encode every fused hot loop uses (the
+    self-play ply, the device-search evaluation, the replay-gradient
+    plies, the rollout leg). Callers that already hold a per-ply
+    :func:`jaxgo.group_data` pass it to share the analysis (the
+    shared-gd convention); ``gd=None`` recomputes inside. Encoder
+    knobs (``ladder_depth``/``ladder_lanes``/``ladder_chase_slots``)
+    thread through ``encode_kwargs``, so a call-site A/B or a future
+    default change lands at every hot loop at once."""
+    one = functools.partial(encode, cfg, features=features,
+                            **encode_kwargs)
+    with_gd = jax.vmap(lambda s, g: one(s, gd=g))
+    no_gd = jax.vmap(lambda s: one(s))
+
+    def enc(states: GoState, gd=None) -> jax.Array:
+        return no_gd(states) if gd is None else with_gd(states, gd)
+
+    return enc

@@ -37,6 +37,25 @@ import math
 import sys
 
 
+def wilson_lower_bound(wins: float, n: int, z: float = 1.96) -> float:
+    """Lower edge of the Wilson score interval for a binomial win
+    rate: the smallest true rate plausibly consistent (at confidence
+    ``z``; default 95%) with ``wins`` observed wins in ``n`` decided
+    games. The zero-loop's evaluator gate promotes only when this
+    bound clears 0.5 (``training/zero.py``; VERDICT r5 next-round #4:
+    a 64-game 0.59 point estimate has a ~±0.12 CI — promotions on
+    such margins were coin flips). ``n <= 0`` returns 0.0 (no
+    evidence, no promotion). Fractional wins (draw = half) are fine.
+    """
+    if n <= 0:
+        return 0.0
+    p = min(max(wins / n, 0.0), 1.0)
+    z2 = z * z
+    center = p + z2 / (2.0 * n)
+    margin = z * math.sqrt(p * (1.0 - p) / n + z2 / (4.0 * n * n))
+    return max(0.0, (center - margin) / (1.0 + z2 / n))
+
+
 def read_games(paths) -> list[dict]:
     """Parse tournament JSONL logs; skips malformed lines."""
     games = []

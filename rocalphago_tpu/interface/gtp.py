@@ -684,6 +684,11 @@ def main(argv=None):
                          "become ? error replies (forfeits under "
                          "most controllers)")
     a = ap.parse_args(argv)
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+
+    # a restarted GTP engine replays the same compiles every launch —
+    # the persistent cache turns those into loads
+    enable_compile_cache()
     metrics = None
     if a.metrics:
         from rocalphago_tpu.io.metrics import MetricsLogger
@@ -691,9 +696,19 @@ def main(argv=None):
         metrics = MetricsLogger(a.metrics, echo=False)
         # genmove spans + compile events join the serving metrics
         trace.configure(metrics)
-    run_gtp(make_player(a), metrics=metrics,
-            resilient=not a.no_resilient,
-            hang_timeout_s=a.genmove_timeout)
+    try:
+        run_gtp(make_player(a), metrics=metrics,
+                resilient=not a.no_resilient,
+                hang_timeout_s=a.genmove_timeout)
+    finally:
+        # end-of-session registry snapshot (same idiom as the
+        # trainers): obs_report's encode/dispatch sections read their
+        # histograms from this event, so a serving run's metrics file
+        # is reportable too — not just queryable live via
+        # rocalphago-stats
+        from rocalphago_tpu.obs import registry as obs_registry
+
+        obs_registry.log_to(metrics)
 
 
 if __name__ == "__main__":

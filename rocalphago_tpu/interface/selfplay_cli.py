@@ -121,6 +121,9 @@ def main(argv=None):
     ap.add_argument("--noise-frac", type=float, default=0.25,
                     help="root-noise mix fraction ε")
     a = ap.parse_args(argv)
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()      # before any compile (env-tunable)
     if a.gumbel and not a.search_sims:
         raise SystemExit("--gumbel requires --search-sims")
     if a.dirichlet_alpha and not a.search_sims:

@@ -7,7 +7,11 @@ lockstep loop over every (board × chase-slot) lane — one 40-rung
 ladder anywhere in the batch makes every lane pay 40 trips. This
 kernel gives each lane its OWN loop in its own grid cell: inactive
 lanes exit after one trip, boards in VMEM, zero HBM traffic between
-rungs.
+rungs. Lanes arrive pre-gated: since the encode-path overhaul, the
+planes pool BOTH features' slot-gated candidates into one lane set
+(``ladders.ladder_planes``) — lanes mix capture (opponent) and escape
+(own) prey, which this kernel has always supported because each
+lane's prey color is read from its own board (``prey_color`` below).
 
 Mosaic-dictated design (lessons from ``ops/labels.py`` on real v5e:
 no in-kernel reshapes, no sub-word vector compares, no gathers or

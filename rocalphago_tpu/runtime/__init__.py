@@ -19,9 +19,15 @@ survive the hardware (docs/RESILIENCE.md):
   (the play-side enforcer behind the GTP engine's anytime genmove);
 * :mod:`.pipeline` — pipelined chunk dispatch (keep a compiled chunk
   in flight while the host decides), the scheduling layer every
-  chunked hot loop drives its per-chunk host decisions through.
+  chunked hot loop drives its per-chunk host decisions through;
+* :mod:`.compilecache` — one shared persistent-XLA-compile-cache
+  setup (``ROCALPHAGO_COMPILE_CACHE``) called by every CLI entry
+  point, so repeat runs stop paying the 20–40s TPU compiles.
 """
 
+from rocalphago_tpu.runtime.compilecache import (  # noqa: F401
+    enable_compile_cache,
+)
 from rocalphago_tpu.runtime.atomic import (  # noqa: F401
     atomic_write_bytes,
     atomic_write_json,

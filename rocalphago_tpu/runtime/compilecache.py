@@ -1,0 +1,61 @@
+"""Shared persistent-XLA-compile-cache setup for every entry point.
+
+The round-5 headline regression was partly ``includes_compile: true``:
+the driver's bench capture paid a cold 20–40s compile because only
+``bench.py`` and the test suite configured JAX's persistent
+compilation cache — the trainers, the self-play CLI and the GTP
+server each recompiled their programs from scratch on every launch.
+This helper is the one place that knob lives now; every CLI calls
+:func:`enable_compile_cache` at startup, so repeat runs of the SAME
+program (the common operational case: resumed trainers, re-launched
+benches, restarted GTP engines) skip compile entirely.
+
+Env knob ``ROCALPHAGO_COMPILE_CACHE``:
+
+* unset (default) → ``~/.cache/jax_comp_cache``;
+* a path → that directory;
+* ``0`` / ``off`` / ``none`` → disabled (no config touched).
+
+First configuration wins: if the process has already pinned a cache
+directory (the test suite's conftest, an operator's explicit
+``jax.config`` call), the helper leaves it alone — re-pointing the
+cache mid-process would split one run's compiles across two caches.
+
+Note the JAX CPU backend does not serialize executables to this cache
+(measured no-op — scripts/test.sh); the payoff is on TPU, where the
+big self-play/search programs cost 20–40s each to compile.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV = "ROCALPHAGO_COMPILE_CACHE"
+DEFAULT_DIR = "~/.cache/jax_comp_cache"
+_OFF = ("0", "off", "none", "disable", "disabled")
+
+
+def enable_compile_cache(min_compile_secs: int = 5) -> str | None:
+    """Point JAX's persistent compilation cache at the configured
+    directory; returns the active cache dir (existing or newly set),
+    or None when disabled/unavailable. Safe to call from any entry
+    point, any number of times."""
+    raw = os.environ.get(ENV)
+    if raw is not None and raw.strip().lower() in _OFF:
+        return None
+    import jax
+
+    try:
+        current = jax.config.jax_compilation_cache_dir
+    except AttributeError:      # very old jax: no such config at all
+        return None
+    if current:
+        return current          # first configuration wins
+    path = os.path.expanduser(raw or DEFAULT_DIR)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+    except Exception:  # noqa: BLE001 — older jax without the knobs
+        return None
+    return path

@@ -56,7 +56,7 @@ from rocalphago_tpu.engine.jaxgo import (
     step,
     winner,
 )
-from rocalphago_tpu.features.planes import encode, needs_member
+from rocalphago_tpu.features.planes import batched_encoder, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.obs import jaxobs
 from rocalphago_tpu.obs import registry as obs_registry
@@ -133,8 +133,7 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     vgd = jax.vmap(lambda s: group_data(
         cfg, s.board, with_member=needs_member(value_features),
         with_zxor=cfg.enforce_superko, labels=s.labels))
-    venc = jax.vmap(lambda s, g: encode(cfg, s, features=value_features,
-                                        gd=g))
+    venc = batched_encoder(cfg, value_features)
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
     vterm = jax.vmap(functools.partial(_terminal_value, cfg))

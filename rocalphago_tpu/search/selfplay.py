@@ -42,7 +42,11 @@ from rocalphago_tpu.engine.jaxgo import (
     vgroup_data,
     winner,
 )
-from rocalphago_tpu.features.planes import encode, needs_member, true_eyes
+from rocalphago_tpu.features.planes import (
+    batched_encoder,
+    needs_member,
+    true_eyes,
+)
 from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.runtime.pipeline import ChunkPipeline
@@ -88,8 +92,7 @@ def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
     n = cfg.num_points
     vgd = vgroup_data(cfg, with_member=needs_member(features),
                       with_zxor=cfg.enforce_superko)
-    enc = jax.vmap(
-        lambda s, g: encode(cfg, s, features=features, gd=g))
+    enc = batched_encoder(cfg, features)
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
 
@@ -458,7 +461,7 @@ def make_device_rollout(cfg: GoConfig, features: tuple, apply_fn: Callable,
     n = cfg.num_points
     vgd = vgroup_data(cfg, with_member=needs_member(features),
                       with_zxor=cfg.enforce_superko)
-    enc = jax.vmap(lambda s, g: encode(cfg, s, features=features, gd=g))
+    enc = batched_encoder(cfg, features)
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
 

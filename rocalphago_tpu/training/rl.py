@@ -63,7 +63,7 @@ from rocalphago_tpu.search.selfplay import (
     play_games,
     sensible_mask,
 )
-from rocalphago_tpu.features.planes import encode
+from rocalphago_tpu.features.planes import batched_encoder
 
 
 @dataclasses.dataclass
@@ -101,7 +101,7 @@ def _make_replay_ply(cfg: jaxgo.GoConfig, features: tuple, apply_fn,
     (one scan) and the chunked iteration (host-driven segments)."""
     n = cfg.num_points
     half = batch // 2
-    enc = jax.vmap(functools.partial(encode, cfg, features=features))
+    enc = batched_encoder(cfg, features)
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
 
@@ -504,6 +504,9 @@ class RLTrainer:
 
 def run_training(argv=None) -> dict:
     """CLI parity with the reference RL trainer."""
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()      # before any compile (env-tunable)
     # multi-host bring-up (DCN); no-op for single-process runs
     meshlib.distributed_init()
     ap = argparse.ArgumentParser(

@@ -62,13 +62,15 @@ def _make_value_ply(cfg: jaxgo.GoConfig, features: tuple,
     recording + SL/random/RL action switch), parameterized over params
     and the per-game random plies ``U`` so both the monolithic scan
     and the chunked runner trace the identical computation."""
-    from rocalphago_tpu.features.planes import encode, needs_member
+    from rocalphago_tpu.features.planes import (
+        batched_encoder,
+        needs_member,
+    )
 
     n = cfg.num_points
     vgd = jaxgo.vgroup_data(cfg, with_member=needs_member(features),
                             with_zxor=cfg.enforce_superko)
-    enc = jax.vmap(
-        lambda s, g: encode(cfg, s, features=features, gd=g))
+    enc = batched_encoder(cfg, features)
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
 

@@ -388,6 +388,11 @@ class SLTrainer:
 
 def run_training(argv=None) -> dict:
     """CLI parity with the reference trainer."""
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+
+    # persistent compile cache before any compile (ROCALPHAGO_COMPILE_
+    # CACHE): repeat/resumed runs skip the cold program compiles
+    enable_compile_cache()
     # multi-host bring-up (DCN) before any backend touch; no-op for
     # single-process runs (SURVEY.md §7 step 7)
     meshlib.distributed_init()

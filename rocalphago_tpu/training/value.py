@@ -321,6 +321,9 @@ class ValueTrainer:
 
 def run_training(argv=None) -> dict:
     """CLI parity with the reference value trainer."""
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()      # before any compile (env-tunable)
     # multi-host bring-up (DCN); no-op for single-process runs
     meshlib.distributed_init()
     ap = argparse.ArgumentParser(

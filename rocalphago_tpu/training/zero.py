@@ -43,7 +43,7 @@ import optax
 from jax import lax
 
 from rocalphago_tpu.engine import jaxgo
-from rocalphago_tpu.features.planes import encode, needs_member
+from rocalphago_tpu.features.planes import batched_encoder, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
 from rocalphago_tpu.obs import jaxobs, trace
@@ -92,8 +92,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
     vgd = jax.vmap(lambda s: jaxgo.group_data(
         cfg, s.board, with_member=needs_member(value_features),
         with_zxor=cfg.enforce_superko, labels=s.labels))
-    venc = jax.vmap(lambda s, g: encode(
-        cfg, s, features=value_features, gd=g))
+    venc = batched_encoder(cfg, value_features)
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
 
@@ -304,7 +303,12 @@ class ZeroGate:
     Matches are raw-policy (no search): cheap — a gate costs about
     one search-free self-play batch — and it targets exactly the
     regression round 4 measured, which was in *raw* strength (the
-    search-backed 260-vs-80 match was level at 4–4).
+    search-backed 260-vs-80 match was level at 4–4). Promotion is
+    statistically honest (:meth:`decide`): besides the point-estimate
+    ``threshold``, the candidate's decided-game win rate must carry a
+    Wilson 95% lower bound ≥ 0.5 — marginal 64-game results
+    (0.56–0.62, most of round 5's recorded promotions) no longer
+    promote on noise.
 
     Multi-host: ``pool_dir`` must live on a filesystem shared by all
     processes (the same requirement ``rl.OpponentPool`` documents).
@@ -347,6 +351,22 @@ class ZeroGate:
         return {"wins_a": wins_a, "wins_b": decided - wins_a,
                 "draws": draws,
                 "win_rate_a": wins_a / max(decided, 1)}
+
+    def decide(self, result: dict) -> tuple:
+        """``(promoted, wilson_lb)`` from a :meth:`match` result —
+        the statistically honest promotion rule (VERDICT r5 #4): the
+        candidate needs BOTH the point-estimate threshold AND a
+        Wilson 95% lower bound ≥ 0.5 on its decided-game win rate.
+        At the default 64-game budget the bound refuses exactly the
+        coin-flip promotions round 5 recorded (a 0.59 observed rate
+        has lb ≈ 0.47; clearing 0.5 needs ~0.625+). Gate events log
+        the bound so every promotion carries its confidence."""
+        from rocalphago_tpu.interface.elo import wilson_lower_bound
+
+        decided = result["wins_a"] + result["wins_b"]
+        lb = wilson_lower_bound(result["wins_a"], decided)
+        return (result["win_rate_a"] >= self.threshold
+                and lb >= 0.5), lb
 
     # ---- best-pair snapshots ------------------------------------
 
@@ -455,8 +475,10 @@ def run_training(argv=None) -> dict:
     from rocalphago_tpu.models.nn_util import NeuralNetBase
     from rocalphago_tpu.obs import registry as obs_registry
     from rocalphago_tpu.runtime import faults, retries
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
     from rocalphago_tpu.runtime.watchdog import Watchdog
 
+    enable_compile_cache()      # before any compile (env-tunable)
     ap = argparse.ArgumentParser(
         description="AlphaZero-style training: device-MCTS self-play "
                     "+ visit-distribution policy targets")
@@ -518,7 +540,9 @@ def run_training(argv=None) -> dict:
                          "split)")
     ap.add_argument("--gate-threshold", type=float, default=0.55,
                     help="decided-game win rate the candidate needs "
-                         "to be promoted to self-play duty")
+                         "to be promoted to self-play duty (a Wilson "
+                         "95%% lower bound >= 0.5 is additionally "
+                         "required — marginal wins don't promote)")
     ap.add_argument("--gate-temperature", type=float, default=1.0,
                     help="sampling temperature for gate/ladder match "
                          "play")
@@ -717,13 +741,14 @@ def run_training(argv=None) -> dict:
                     gkey, lkey = jax.random.split(
                         jax.random.fold_in(gate_root, it))
                     r = gate.match(state.policy_params, best_p, gkey)
-                    promoted = r["win_rate_a"] >= gate.threshold
+                    promoted, wilson_lb = gate.decide(r)
                     if promoted:
                         best_p, best_v = (state.policy_params,
                                           state.value_params)
                         gate.promote(best_p, best_v, it + 1)
                     metrics.log("gate", iteration=it,
-                                promoted=promoted, **r)
+                                promoted=promoted,
+                                wilson_lb=round(wilson_lb, 4), **r)
                     # ladder probe: the (possibly new) incumbent vs a
                     # sampled past best — the monotonicity evidence
                     # round 4 lacked

@@ -50,7 +50,11 @@ def load_records(path: str, date: str, platform: str | None):
                 continue
             key = (r["metric"], r.get("batch"), r.get("board"),
                    r.get("interpret"), r.get("lmbda"),
-                   r.get("devices"), r.get("pipeline_depth"))
+                   r.get("devices"), r.get("pipeline_depth"),
+                   # encode A/B axes (bench_encode.py): every
+                   # gating/phase1/impl side is its own row
+                   r.get("gating"), r.get("phase1"),
+                   r.get("chase_impl"))
             prev = latest.get(key)
             if prev is None or str(r.get("date")) >= str(prev.get("date")):
                 latest[key] = r
@@ -61,7 +65,7 @@ def load_records(path: str, date: str, platform: str | None):
 
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
-                "vs_baseline", "mfu", "host_gap_frac"}
+                "vs_baseline", "mfu", "host_gap_frac", "us_per_pos"}
 
 
 def render_table(records) -> str:
@@ -71,9 +75,13 @@ def render_table(records) -> str:
     '—' where a record has none (CPU runs, non-flops metrics).
     The host-gap column shows ``host_gap_frac`` — the fraction of
     wall time the device had nothing in flight (the pipelined-vs-sync
-    dispatch A/B; ``pipeline_depth`` in config names the side)."""
-    lines = ["| metric | value | unit | MFU | host gap | config |",
-             "|---|---|---|---|---|---|"]
+    dispatch A/B; ``pipeline_depth`` in config names the side). The
+    µs/pos column renders ``us_per_pos`` — the encode A/B's
+    per-position cost (``benchmarks/bench_encode.py``), keyed by the
+    gating/phase1/impl fields that stay visible in config."""
+    lines = ["| metric | value | unit | MFU | host gap | µs/pos "
+             "| config |",
+             "|---|---|---|---|---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -83,8 +91,11 @@ def render_table(records) -> str:
         u = "—" if u in (None, "") else f"{100.0 * float(u):.1f}%"
         gap = r.get("host_gap_frac")
         gap = "—" if gap in (None, "") else f"{100.0 * float(gap):.2f}%"
+        upp = r.get("us_per_pos")
+        upp = "—" if upp in (None, "") else f"{float(upp):g}"
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
-                     f" | {r.get('unit', '?')} | {u} | {gap} | {cfg} |")
+                     f" | {r.get('unit', '?')} | {u} | {gap} | {upp}"
+                     f" | {cfg} |")
     return "\n".join(lines)
 
 

@@ -163,6 +163,49 @@ def render_dispatch(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_encode(stats: dict, snap: dict) -> str:
+    """Encode-path table (the encode overhaul's observability leg):
+    per-board per-position cost from the ``encode_pos_us`` histograms
+    that ``features/api.py::Preprocess`` records on every host-boundary
+    encode, next to the encode span totals and the encode entry
+    points' compile counts — 'where does encode time go and did it
+    recompile' in one place."""
+    hists = {k: h for k, h in snap.get("histograms", {}).items()
+             if k.startswith("encode_pos_us")}
+    counters = snap.get("counters", {})
+    if not hists:
+        return "(no encode records)"
+    lines = [f"{'board':<8} {'positions':>10} {'p50_us':>10} "
+             f"{'p99_us':>10}"]
+    for key in sorted(hists):
+        h = hists[key]
+        label = _runner_label(key)
+        if 'board="' in key:
+            import re
+
+            m = re.search(r'board="([^"]*)"', key)
+            label = m.group(1) if m else key
+        p50 = quantile_from_buckets(h, 0.5)
+        p99 = quantile_from_buckets(h, 0.99)
+        lines.append(f"{label:<8} {h['count']:>10} "
+                     f"{('≲' + format(p50, 'g')) if p50 else '—':>10} "
+                     f"{('≲' + format(p99, 'g')) if p99 else '—':>10}")
+    compiles = {k: v for k, v in counters.items()
+                if k.startswith("jax_compiles_total")
+                and 'entry="encode' in k}
+    if compiles:
+        lines.append("compiles: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(compiles.items())))
+    spans = {p: s for p, s in stats.items()
+             if p.rsplit("/", 1)[-1] == "encode"}
+    if spans:
+        total = sum(s["total_s"] for s in spans.values())
+        count = sum(s["count"] for s in spans.values())
+        lines.append(f"encode spans: {count} calls, "
+                     f"{total:.3f}s total")
+    return "\n".join(lines)
+
+
 def render_events(records) -> str:
     """Counts of the notable non-span events (compiles, stalls,
     degradations, retries) — the 'did anything unusual happen' row."""
@@ -191,6 +234,8 @@ def report(records, top: int | None = None) -> str:
              "## notable events", "", render_events(records), "",
              "## dispatch pipeline (occupancy / host gaps)", "",
              render_dispatch(reg or {}), "",
+             "## encode path (per-position cost / compiles)", "",
+             render_encode(stats, reg or {}), "",
              "## metric registry (last snapshot)", "",
              render_registry(reg or {})]
     return "\n".join(parts)
@@ -216,7 +261,9 @@ FIXTURE = [
     {"event": "registry", "snapshot": {
         "counters": {'serve_rung_total{rung="search"}': 41,
                      'serve_rung_total{rung="policy"}': 1,
-                     'dispatch_chunks_total{runner="device_mcts"}': 96},
+                     'dispatch_chunks_total{runner="device_mcts"}': 96,
+                     'jax_compiles_total{entry="encode.batch"}': 1,
+                     'encode_positions_total{board="19"}': 128},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
                    'device_occupancy{runner="device_mcts"}': 0.983},
         "histograms": {"gtp_genmove_seconds": {
@@ -225,7 +272,11 @@ FIXTURE = [
                         "+Inf": 42}},
             'dispatch_gap_s{runner="device_mcts"}': {
                 "count": 3, "sum": 0.021,
-                "buckets": {"0.005": 1, "0.01": 3, "+Inf": 3}}}}},
+                "buckets": {"0.005": 1, "0.01": 3, "+Inf": 3}},
+            'encode_pos_us{board="19"}': {
+                "count": 128, "sum": 940800.0,
+                "buckets": {"5000": 60, "10000": 126, "25000": 128,
+                            "+Inf": 128}}}}},
 ]
 
 
@@ -234,7 +285,9 @@ def selftest() -> int:
     print(out)
     needed = ("zero.selfplay", "zero.iteration", "76.2%",
               "serve_rung_total", "gtp_genmove_seconds", "compile=1",
-              "p99≲2.5", "dispatch pipeline", "98.3%")
+              "p99≲2.5", "dispatch pipeline", "98.3%",
+              "encode path", "≲25000",
+              'jax_compiles_total{entry="encode.batch"}=1')
     missing = [n for n in needed if n not in out]
     if missing:
         print(f"obs_report selftest FAILED: missing {missing}",

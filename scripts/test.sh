@@ -30,6 +30,16 @@ cd "$(dirname "$0")/.."
 # --selftest` (the fixture render), so the report path cannot rot
 # silently. See docs/OBSERVABILITY.md.
 #
+# Encode parity (tests/test_features.py, tier-1): the gated shared
+# chase (`ladders.ladder_planes`) is pinned bit-identical to the
+# legacy split formulation at capacity (TestSharedGating), sound on
+# the adversarial edge/corner-ladder family, dense-19×19-bounded
+# (≤1%) vs the pyfeatures oracle, and a warm second encode is
+# asserted compile-free via the obs counters
+# (test_warm_encode_compiles_nothing). The overflow/truncation and
+# two-phase-equivalence sweeps are @slow. See docs/PERFORMANCE.md
+# "Encode path".
+#
 # Pipelined dispatch: tests/test_pipeline.py is tier-1 —
 # bit-identical pipelined-vs-sync sweeps for PUCT/gumbel search,
 # chunked self-play (lagged done-poll) and a zero iteration, the

@@ -59,7 +59,7 @@ def main() -> int:
     from rocalphago_tpu.engine import jaxgo
     from rocalphago_tpu.engine.jaxgo import GoConfig
     from rocalphago_tpu.features import DEFAULT_FEATURES
-    from rocalphago_tpu.features.planes import encode
+    from rocalphago_tpu.features.planes import batched_encoder
     from rocalphago_tpu.models import CNNPolicy
     from rocalphago_tpu.search.selfplay import (
         make_selfplay_chunked,
@@ -110,8 +110,7 @@ def main() -> int:
         with_zxor=cfg.enforce_superko, labels=s.labels))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
-    venc = jax.vmap(lambda s, g: encode(
-        cfg, s, features=DEFAULT_FEATURES, gd=g))
+    venc = batched_encoder(cfg, DEFAULT_FEATURES)
 
     def ply_fn(stage):
         n = cfg.num_points

@@ -107,8 +107,17 @@ make_specs() {
 }
 make_specs
 
+# encode_* steps: the encode-path A/B (benchmarks/bench_encode.py;
+# docs/PERFORMANCE.md "Encode path") — one config per step so a short
+# window still banks a decidable pair. They supersede the old
+# ladder1-8 bench_preprocess steps: bench_encode records the same
+# sweep WITH the gating axis and a per-position-µs field that
+# bench_report renders. The CPU sides are already in results.jsonl;
+# these rows decide the TPU defaults.
 STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
-preprocess chase_xla chase_pls ladder1 ladder2 ladder4 ladder8 \
+preprocess chase_xla chase_pls encode_base encode_shared4 \
+encode_shared1 encode_shared2 encode_shared8 encode_split4 \
+encode_pallas \
 devmcts9 devmcts_gumbel selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
 train_trace preprocess_trace tournament headline_sized headline"
@@ -144,10 +153,13 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             preprocess)  run preprocess  python benchmarks/bench_preprocess.py --reps 2 ;;
             chase_xla)   run chase_xla   python benchmarks/bench_chase.py --reps 2 ;;
             chase_pls)   run chase_pls   env ROCALPHAGO_PALLAS_CHASE=1 python benchmarks/bench_chase.py --reps 2 ;;
-            ladder1)     run ladder1     env ROCALPHAGO_LADDER_PHASE1=1 python benchmarks/bench_preprocess.py --reps 2 ;;
-            ladder2)     run ladder2     env ROCALPHAGO_LADDER_PHASE1=2 python benchmarks/bench_preprocess.py --reps 2 ;;
-            ladder4)     run ladder4     env ROCALPHAGO_LADDER_PHASE1=4 python benchmarks/bench_preprocess.py --reps 2 ;;
-            ladder8)     run ladder8     env ROCALPHAGO_LADDER_PHASE1=8 python benchmarks/bench_preprocess.py --reps 2 ;;
+            encode_base)    run encode_base    python benchmarks/bench_encode.py --gating split --phase1 40 --reps 2 ;;
+            encode_shared4) run encode_shared4 python benchmarks/bench_encode.py --gating shared --phase1 4 --skip-noladder --reps 2 ;;
+            encode_shared1) run encode_shared1 python benchmarks/bench_encode.py --gating shared --phase1 1 --skip-noladder --reps 2 ;;
+            encode_shared2) run encode_shared2 python benchmarks/bench_encode.py --gating shared --phase1 2 --skip-noladder --reps 2 ;;
+            encode_shared8) run encode_shared8 python benchmarks/bench_encode.py --gating shared --phase1 8 --skip-noladder --reps 2 ;;
+            encode_split4)  run encode_split4  python benchmarks/bench_encode.py --gating split --phase1 4 --skip-noladder --reps 2 ;;
+            encode_pallas)  run encode_pallas  python benchmarks/bench_encode.py --gating shared --phase1 4 --impl pallas --skip-noladder --reps 2 ;;
             devmcts9)    run devmcts9    python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
             devmcts_gumbel) run devmcts_gumbel python benchmarks/bench_device_mcts.py --board 9 --sims 32 --gumbel --reps 2 ;;
             bisect)      run bisect      python scripts/tpu_crash_bisect.py --log "$LOG/bisect.jsonl" ;;

@@ -15,6 +15,54 @@ import sys
 import pytest
 
 
+def test_honest_metric_suffixes(monkeypatch):
+    """The headline honesty rules (VERDICT r5 #2) in one table: a
+    truncated or contended run reports under a suffixed metric name,
+    and NO compromised measurement (truncated, compile-included,
+    contended) emits a vs_baseline ratio — the exact hole that let
+    round 5 publish 1.81 games/min at vs_baseline 0.145 with
+    includes_compile true."""
+    monkeypatch.syspath_prepend(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    m = bench.METRIC
+    ok = bench._honest_metric(m, 10.0, 12.5, truncated=False,
+                              includes_compile=False, contended=False)
+    assert ok == (m, 0.8)
+    name, vs = bench._honest_metric(m, 10.0, 12.5, truncated=True,
+                                    includes_compile=False,
+                                    contended=False)
+    assert name == m + "_truncated" and vs is None
+    name, vs = bench._honest_metric(m, 10.0, 12.5, truncated=False,
+                                    includes_compile=False,
+                                    contended=True)
+    assert name == m + "_contended" and vs is None
+    name, vs = bench._honest_metric(m, 10.0, 12.5, truncated=False,
+                                    includes_compile=True,
+                                    contended=False)
+    assert name == m and vs is None     # honest name, no ratio
+    name, vs = bench._honest_metric(m, 10.0, 12.5, truncated=True,
+                                    includes_compile=True,
+                                    contended=True)
+    assert name == m + "_truncated_contended" and vs is None
+
+
+def test_host_contention_reading(monkeypatch):
+    """_host_contention returns a usable (load, flag, pids) triple on
+    this platform and never raises — a missing /proc reading must not
+    fail the bench."""
+    monkeypatch.syspath_prepend(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    load1, contended, heavy = bench._host_contention(sample_s=0.05)
+    assert load1 is None or load1 >= 0.0
+    assert isinstance(contended, bool)
+    assert isinstance(heavy, list)
+    assert os.getpid() not in heavy     # never flags itself
+
+
 @pytest.mark.slow
 def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
     monkeypatch.setenv("_GRAFT_BENCH_FORCE_ADAPTIVE", "1")
@@ -25,6 +73,10 @@ def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
         os.path.abspath(__file__))))
     import bench
 
+    # pin the contention sample: another process busy on the shared
+    # CI box must not rename this run's metric under the test
+    monkeypatch.setattr(bench, "_host_contention",
+                        lambda sample_s=0.25: (0.1, False, []))
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench._measure()
@@ -34,6 +86,7 @@ def test_adaptive_bench_measure_runs_and_reports(monkeypatch):
     # metric name — never the full-game headline's — and no ratio
     # against the full-game north star (VERDICT r2/r3)
     assert rec["metric"] == bench.METRIC + "_truncated"
+    assert rec["load_1m"] == 0.1 and "contended" not in rec
     assert rec["unit"] == "games/min"
     assert rec["value"] > 0
     assert rec["batch"] in (16, 8)        # a probed candidate won
@@ -53,6 +106,8 @@ def test_fixed_override_ignored_off_tpu(monkeypatch):
         os.path.abspath(__file__))))
     import bench
 
+    monkeypatch.setattr(bench, "_host_contention",
+                        lambda sample_s=0.25: (0.1, False, []))
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench._measure()
@@ -172,18 +227,32 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
         json.dumps({"metric": "m", "value": 7.0, "unit": "u",
                     "batch": 64, "platform": "tpu",
                     "date": "2026-07-30T01:00:00"}),   # other day
+        json.dumps({"metric": "encode_ab", "value": 100.0, "unit": "u",
+                    "batch": 16, "platform": "tpu", "gating": "shared",
+                    "phase1": 4, "chase_impl": "xla",
+                    "us_per_pos": 123.4,
+                    "date": "2026-07-31T01:00:00"}),   # encode A/B side
+        json.dumps({"metric": "encode_ab", "value": 50.0, "unit": "u",
+                    "batch": 16, "platform": "tpu", "gating": "split",
+                    "phase1": 4, "chase_impl": "xla",
+                    "us_per_pos": 246.8,
+                    "date": "2026-07-31T01:05:00"}),   # distinct gating
     ]) + "\n")
     recs = bench_report.load_records(str(log), "2026-07-31", "tpu")
-    # pipeline_depth is part of the config key: the depth-1 A/B side
-    # is a distinct row, not a newer duplicate of the depth-less one
+    # pipeline_depth (and the encode gating/phase1/impl axes) are part
+    # of the config key: each A/B side is a distinct row, not a newer
+    # duplicate of its sibling
     assert sorted((r["value"], r.get("batch")) for r in recs) \
-        == [(2.0, 64), (3.0, 64), (9.0, 256)]
+        == [(2.0, 64), (3.0, 64), (9.0, 256), (50.0, 16), (100.0, 16)]
     table = bench_report.render_table(recs)
-    # MFU and host-gap columns: '—' when a record has none,
-    # percent when it does
-    assert "| m | 2.0 | u | — | — | batch=64 |" in table
-    assert "| m | 9.0 | u | 12.3% | — | batch=256 |" in table
-    assert ("| m | 3.0 | u | — | 4.21% | batch=64, pipeline_depth=1 |"
+    # MFU / host-gap / µs-per-pos columns: '—' when a record has
+    # none, the value when it does
+    assert "| m | 2.0 | u | — | — | — | batch=64 |" in table
+    assert "| m | 9.0 | u | 12.3% | — | — | batch=256 |" in table
+    assert ("| m | 3.0 | u | — | 4.21% | — | "
+            "batch=64, pipeline_depth=1 |" in table)
+    assert ("| encode_ab | 100.0 | u | — | — | 123.4 | "
+            "batch=16, chase_impl=xla, gating=shared, phase1=4 |"
             in table)
 
     probe = tmp_path / "probe.log"

@@ -147,3 +147,19 @@ def test_bootstrap_sparse_anchor_still_rates_others():
     ci = elo.bootstrap_ci(games, anchor="Z", n_boot=80, seed=7)
     assert ci["A"] is not None
     assert ci["B"] is not None
+
+
+def test_wilson_lower_bound_gate_semantics():
+    """The statistically-honest gate bound (VERDICT r5 #4): the
+    zero-loop promotes only when the Wilson 95% lower bound on the
+    candidate's decided-game win rate clears 0.5 — at the 64-game
+    budget that refuses exactly the marginal 0.56–0.62 results round
+    5 promoted on noise."""
+    wlb = elo.wilson_lower_bound
+    assert wlb(0, 0) == 0.0                 # no evidence, no bound
+    assert wlb(38, 64) < 0.5                # 0.594 — the coin flip
+    assert wlb(45, 64) >= 0.5               # 0.703 — decisive
+    # evidence tightens the bound: same rate, more games, higher lb
+    assert wlb(38, 64) < wlb(380, 640)
+    assert 0.0 <= wlb(64, 64) <= 1.0
+    assert wlb(32, 64, z=1.96) > wlb(32, 64, z=2.58)

@@ -328,6 +328,30 @@ def test_ladder_write_spec_never_clobbers_pool(tmp_path):
     assert spec["weights_file"] == os.path.abspath(str(weights))
 
 
+# -------------------------------------------------- compile cache
+
+def test_compile_cache_env_off_and_first_config_wins(monkeypatch):
+    """runtime/compilecache.py: the shared persistent-cache helper
+    every CLI entry point calls is env-disableable
+    (``ROCALPHAGO_COMPILE_CACHE=off``) and NEVER re-points an
+    already-configured cache — the suite's conftest pins one, which
+    is exactly the first-config-wins case the helper must respect
+    (re-pointing mid-process would split one run's compiles across
+    two caches)."""
+    import jax
+
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+
+    for off in ("0", "off", "NONE", "disabled", " Off "):
+        monkeypatch.setenv("ROCALPHAGO_COMPILE_CACHE", off)
+        assert enable_compile_cache() is None
+    pinned = jax.config.jax_compilation_cache_dir
+    assert pinned                   # conftest configured the suite's
+    monkeypatch.setenv("ROCALPHAGO_COMPILE_CACHE", "/tmp/elsewhere")
+    assert enable_compile_cache() == pinned
+    assert jax.config.jax_compilation_cache_dir == pinned
+
+
 # -------------------------------------------------------- deadline
 
 def test_deadline_semantics():

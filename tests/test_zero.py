@@ -114,6 +114,29 @@ def test_zero_cli_trains_saves_and_resumes(tmp_path, nets):
             / "best.00000.policy.msgpack").exists()
 
 
+def test_zero_gate_decide_requires_wilson_bound():
+    """Promotion needs BOTH the point-estimate threshold AND a Wilson
+    95% lower bound >= 0.5 on the decided-game win rate (VERDICT r5
+    #4). ``decide`` reads only ``self.threshold``, so the rule is
+    testable without building the match machinery."""
+    from rocalphago_tpu.training.zero import ZeroGate
+
+    g = object.__new__(ZeroGate)
+    g.threshold = 0.55
+
+    def result(wa, wb):
+        return {"wins_a": wa, "wins_b": wb,
+                "win_rate_a": wa / max(wa + wb, 1)}
+
+    promoted, lb = g.decide(result(38, 26))     # 0.594 at 64 games:
+    assert not promoted and lb < 0.5            # round 5's coin flip
+    promoted, lb = g.decide(result(45, 19))     # 0.703: decisive
+    assert promoted and lb >= 0.5
+    g.threshold = 0.75                          # the point threshold
+    promoted, _ = g.decide(result(45, 19))      # still gates on top
+    assert not promoted
+
+
 def test_zero_gate_match_and_promotion(tmp_path, nets):
     """ZeroGate mechanics: an even match reports a sane tally; a
     promotion writes a loadable best-pair snapshot; sample() draws
