@@ -28,6 +28,19 @@ config traces a fresh program — the A/B never reuses a stale cached
 trace. TPU rows: the ``encode_*`` steps in
 ``scripts/tpu_window_hunter2.sh`` run this harness per config in the
 next healthy window.
+
+TRAJECTORY rows (``--trajectory``, PR 6): self-play and MCTS visit
+SUCCESSIVE positions, so the batched mid-game measurement above is
+the wrong model for the sequential hot paths — this mode replays a
+real random-game tail position by position and A/Bs the incremental
+encoder (``features/incremental.py``, ``encode_incr`` rows, cache
+carried ply to ply) against the from-scratch encode (
+``encode_scratch``), µs/pos each; ``encode_incr`` additionally
+records the speedup as ``vs_baseline`` (incr rate ÷ scratch rate).
+``--traj-batch`` adds the batched-lockstep pair
+(``encode_incr_batched`` / ``encode_scratch_batched``) — the numbers
+behind ``selfplay.incremental_default``'s measured default. TPU rows:
+``encode_incr*`` hunter steps.
 """
 
 from __future__ import annotations
@@ -44,6 +57,165 @@ from benchmarks._harness import (  # noqa: E402
     std_parser,
     timed,
 )
+
+
+def _game_tail(cfg, skip: int, plies: int, rng_key):
+    """One REAL game's successive positions (uniform random legal
+    policy, the same move model as ``random_game_states``): a host
+    list of ``plies`` single GoStates, positions ``skip+1 .. skip+plies``
+    of the game — the sequential stream the incremental encoder is
+    built for."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine.jaxgo import (
+        group_data,
+        legal_mask,
+        new_state,
+        step,
+    )
+
+    @jax.jit
+    def run(rng):
+        def ply(carry, _):
+            state, rng = carry
+            rng, sub = jax.random.split(rng)
+            gd = group_data(cfg, state.board,
+                            with_zxor=cfg.enforce_superko,
+                            labels=state.labels)
+            legal = legal_mask(cfg, state, gd)[:-1]
+            logits = jnp.where(legal, 0.0, -1e30)
+            action = jnp.where(
+                legal.any(), jax.random.categorical(sub, logits),
+                cfg.num_points).astype(jnp.int32)
+            new = step(cfg, state, action, gd)
+            return (new, rng), new
+
+        _, states = jax.lax.scan(ply, (new_state(cfg), rng),
+                                 length=skip + plies)
+        return jax.tree.map(lambda x: x[skip:], states)
+
+    stacked = jax.block_until_ready(run(rng_key))
+    return [jax.tree.map(lambda x, i=i: x[i], stacked)
+            for i in range(plies)]
+
+
+def _trajectory_ab(cfg, args) -> None:
+    """Sequential (and optionally batched-lockstep) trajectory A/B —
+    see the module docstring's TRAJECTORY paragraph."""
+    import functools
+
+    import jax
+
+    from rocalphago_tpu.features import incremental as incr
+    from rocalphago_tpu.features.planes import encode
+    from benchmarks._harness import random_game_states
+
+    slot_kw = ({"ladder_chase_slots": args.slots}
+               if args.slots is not None else {})
+    plies = args.traj_plies
+    states_seq = _game_tail(cfg, args.traj_skip, plies,
+                            jax.random.key(0))
+
+    enc = jax.jit(functools.partial(
+        encode, cfg, ladder_depth=args.depth, **slot_kw))
+    step_fn = jax.jit(lambda s, c: incr.encode_step(
+        cfg, s, c, ladder_depth=args.depth, **slot_kw))
+    cache0 = incr.init_cache(cfg)
+
+    def run_scratch():
+        out = None
+        for st in states_seq:
+            out = enc(st)
+        return jax.device_get(out)
+
+    def run_incr():
+        # cache cold at the tail start each rep (honest: the warmup
+        # ply is in the average, amortized over the tail)
+        cache, out = cache0, None
+        for st in states_seq:
+            out, cache = step_fn(st, cache)
+        return jax.device_get(out)
+
+    dt_s = timed(run_scratch, reps=args.reps)
+    rate_s = plies / dt_s
+    report("encode_scratch", rate_s, "positions/s",
+           board=args.board, plies=plies,
+           us_per_pos=round(1e6 * dt_s / plies, 1))
+    dt_i = timed(run_incr, reps=args.reps)
+    report("encode_incr", plies / dt_i, "positions/s",
+           baseline=rate_s, board=args.board, plies=plies,
+           us_per_pos=round(1e6 * dt_i / plies, 1))
+
+    if not args.traj_batch:
+        return
+    from rocalphago_tpu.features.planes import batched_encoder
+    from rocalphago_tpu.features import DEFAULT_FEATURES
+
+    b = args.traj_batch
+    mid = jax.block_until_ready(random_game_states(
+        cfg, b, args.traj_skip, jax.random.key(1)))
+    benc = jax.jit(batched_encoder(cfg, DEFAULT_FEATURES, **slot_kw))
+    bdenc = jax.jit(incr.batched_delta_encoder(
+        cfg, DEFAULT_FEATURES, **slot_kw))
+    caches0 = incr.init_caches(cfg, b)
+    actions = _random_action_stepper(cfg, b)
+
+    def run_batch(encoder, with_cache):
+        def go():
+            states, caches, out = mid, caches0, None
+            rng = jax.random.key(2)
+            for _ in range(plies):
+                if with_cache:
+                    out, caches = encoder(states, caches)
+                else:
+                    out = encoder(states)
+                states, rng = actions(states, rng)
+            return jax.device_get(out)
+
+        return go
+
+    dt_bs = timed(run_batch(benc, False), reps=args.reps)
+    rate_bs = b * plies / dt_bs
+    report("encode_scratch_batched", rate_bs, "positions/s",
+           batch=b, board=args.board, plies=plies,
+           us_per_pos=round(1e6 * dt_bs / (b * plies), 1))
+    dt_bi = timed(run_batch(bdenc, True), reps=args.reps)
+    report("encode_incr_batched", b * plies / dt_bi, "positions/s",
+           baseline=rate_bs, batch=b, board=args.board, plies=plies,
+           us_per_pos=round(1e6 * dt_bi / (b * plies), 1))
+
+
+def _random_action_stepper(cfg, batch: int):
+    """Jitted ``(states, rng) -> (states', rng')`` — one uniform
+    random-legal lockstep ply (the batched trajectory's move model)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from rocalphago_tpu.engine.jaxgo import (
+        legal_mask,
+        step,
+        vgroup_data,
+    )
+
+    vstep = jax.vmap(functools.partial(step, cfg))
+    vlegal = jax.vmap(functools.partial(legal_mask, cfg))
+    vgd = vgroup_data(cfg, with_zxor=cfg.enforce_superko)
+
+    @jax.jit
+    def go(states, rng):
+        rng, sub = jax.random.split(rng)
+        gd = vgd(states)
+        legal = vlegal(states, gd)[:, :-1]
+        logits = jnp.where(legal, 0.0, -1e30)
+        action = jnp.where(
+            legal.any(-1), jax.random.categorical(sub, logits, axis=-1),
+            cfg.num_points).astype(jnp.int32)
+        return vstep(states, action, gd), rng
+
+    return go
 
 
 def main() -> None:
@@ -66,10 +238,25 @@ def main() -> None:
                     help="ladder_chase_slots override (default: the "
                          "encoder's measured default)")
     ap.add_argument("--skip-noladder", action="store_true")
+    ap.add_argument("--trajectory", action="store_true",
+                    help="sequential-trajectory A/B: encode_incr vs "
+                         "encode_scratch over a real game tail "
+                         "(µs/pos), instead of the batched axes sweep")
+    ap.add_argument("--traj-plies", type=int, default=80,
+                    help="tail length (positions encoded per rep)")
+    ap.add_argument("--traj-skip", type=int, default=40,
+                    help="opening plies skipped before the tail")
+    ap.add_argument("--traj-batch", type=int, default=0,
+                    help="also run the batched-lockstep trajectory "
+                         "pair at this game batch (0 = skip)")
     args = ap.parse_args()
     batch = args.batch or (256 if jax.devices()[0].platform == "tpu"
                            else 16)
     cfg = GoConfig(size=args.board)
+
+    if args.trajectory:
+        _trajectory_ab(cfg, args)
+        return
 
     # mid-game positions: 120 random-legal plies — dense boards with
     # real multi-ladder structure, the encode's stressed case

@@ -74,8 +74,16 @@ def main() -> None:
             cfg, net.feature_list, net.module.apply, net.module.apply,
             batch, args.plies, chunk=args.plies,
             score_on_device=False)
+        from rocalphago_tpu.features.incremental import init_caches
+        from rocalphago_tpu.search.selfplay import incremental_default
+
+        # the segment's carry layout follows the encode-incr knob:
+        # a cold cache slab when the delta path is traced in, None
+        # for the from-scratch encoder
+        caches0 = (init_caches(cfg, batch) if incremental_default()
+                   else None)
         flops = program_flops(
-            run.segment, net.params, net.params, states,
+            run.segment, net.params, net.params, states, caches0,
             jax.random.key(0), jnp.int32(0), length=args.plies)
 
         def once():

@@ -27,6 +27,7 @@ import functools
 import time
 
 import jax
+import numpy as np
 
 from rocalphago_tpu.engine.jaxgo import GoConfig, GoState
 from rocalphago_tpu.features.planes import encode
@@ -43,6 +44,50 @@ from rocalphago_tpu.obs import registry as obs_registry
 ENCODE_US_EDGES = (10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
                    2500.0, 5000.0, 10000.0, 25000.0, 50000.0,
                    100000.0, 250000.0, 1000000.0)
+
+
+def observe_incremental(prev_stats, new_stats, positions=None):
+    """Fold one incremental-encode step's device-side stat delta into
+    the process obs registry (host boundaries only — the stats vector
+    lives on device as part of the ``EncodeCache`` carry and callers
+    snapshot it where they already sync).
+
+    ``prev_stats``/``new_stats`` are the cache's int32 ``stats``
+    vectors (``incremental.STAT_FIELDS`` layout) before and after the
+    step; ``prev_stats=None`` means a fresh cache (all-zero baseline).
+    Returns ``new_stats`` as a host array for the caller to carry.
+    Counters: ``encode_delta_total`` (positions through the delta
+    path — the from-scratch sibling is ``encode_full_total``) and
+    ``encode_incr_<field>_total`` per stat field, the inputs of
+    ``scripts/obs_report.py``'s incremental hit-rate line."""
+    from rocalphago_tpu.features import incremental as _incr
+
+    # batched caches carry one stats vector per game — fold to totals
+    cur = np.asarray(jax.device_get(new_stats), np.int64) \
+        .reshape(-1, len(_incr.STAT_FIELDS)).sum(axis=0)
+    prev = (np.zeros_like(cur) if prev_stats is None
+            else np.asarray(prev_stats, np.int64))
+    if positions is None:   # default: the cache's own encode count
+        positions = int(cur[_incr.STAT_ENCODES]
+                        - prev[_incr.STAT_ENCODES])
+    if positions > 0:
+        obs_registry.counter("encode_delta_total").inc(positions)
+    for i, field in enumerate(_incr.STAT_FIELDS):
+        if field == "encodes":
+            continue        # encode_delta_total already counts these
+        d = int(cur[i] - prev[i])
+        if d > 0:
+            obs_registry.counter(f"encode_incr_{field}_total").inc(d)
+    return cur
+
+
+def count_cache_reset(reason: str) -> None:
+    """Count one incremental-encode cache invalidation at a host
+    boundary (``encode_cache_resets_total{reason=...}``): new games,
+    rewinds/undo, board switches — the explicit full-re-encode
+    fallbacks of the delta path."""
+    obs_registry.counter("encode_cache_resets_total",
+                         reason=reason).inc()
 
 
 class Preprocess:
@@ -103,6 +148,17 @@ class Preprocess:
             "encode_pos_us", edges=ENCODE_US_EDGES, board=board)
         self._positions = obs_registry.counter(
             "encode_positions_total", board=board)
+        self._full = obs_registry.counter("encode_full_total")
+        # incremental (delta) encode state — see :meth:`advance`:
+        # the jitted encode_step program (built on first use), the
+        # carried EncodeCache, and the last snapshot of its on-device
+        # stats vector (host side, for per-call registry deltas)
+        self._lad_kw = dict(ladder_depth=ladder_depth,
+                            ladder_lanes=ladder_lanes,
+                            ladder_chase_slots=ladder_chase_slots)
+        self._delta_step = None
+        self._cache = None
+        self._cache_stats = None
 
     def _timed(self, fn, arg, batch: int) -> jax.Array:
         with trace.span("encode", board=self.cfg.size, batch=batch):
@@ -115,9 +171,76 @@ class Preprocess:
 
     def state_to_tensor(self, state: GoState) -> jax.Array:
         """One state → ``[1, size, size, F]`` float32."""
+        self._full.inc()
         return self._timed(self._one, state, 1)[None]
 
     def states_to_tensor(self, states: GoState) -> jax.Array:
         """Batched states (leading axis) → ``[B, size, size, F]``."""
         batch = int(jax.tree.leaves(states)[0].shape[0])
+        self._full.inc(batch)
         return self._timed(self._batch, states, batch)
+
+    # ------------------------------------------------- incremental API
+
+    def reset_cache(self, reason: str = "new_game") -> None:
+        """Drop the incremental-encode carry (explicit full-re-encode
+        fallback): call on new games, rewinds/undo, or any history
+        jump the caller knows about. NOT required for correctness —
+        :meth:`advance` diffs boards and invalidates stale ladder
+        verdicts by footprint, so a carried cache is always
+        bit-identical — but an explicit reset keeps reuse stats
+        honest and is counted per ``reason``
+        (``encode_cache_resets_total{reason=...}``)."""
+        if self._cache is not None:
+            count_cache_reset(reason)
+        self._cache = None
+        self._cache_stats = None
+
+    def advance(self, state: GoState, move=None) -> jax.Array:
+        """Opt-in STATEFUL encode for sequential host-boundary callers
+        → ``[1, size, size, F]`` float32, bit-identical to
+        :meth:`state_to_tensor` at every call.
+
+        Successive positions share almost all of their expensive
+        ladder analysis; ``advance`` carries an
+        :class:`~rocalphago_tpu.features.incremental.EncodeCache`
+        across calls and re-runs the pooled ladder chase only for
+        lanes whose recorded read footprint intersects the board
+        delta (docs/PERFORMANCE.md "Incremental encode").
+
+        ``move=None`` (the common form): encode ``state`` itself —
+        the caller already stepped the engine. ``move`` (flat index,
+        ``N`` = pass): step ``state`` by ``move`` on device and encode
+        the successor (:func:`incremental.encode_delta`); the caller
+        keeps its own engine state.
+
+        A cold or reset cache re-encodes from scratch by construction
+        (every lane refreshes); correctness never depends on the
+        cache matching the position — see :meth:`reset_cache`."""
+        from rocalphago_tpu.features import incremental as _incr
+
+        if self._delta_step is None:
+            step_fn = functools.partial(
+                _incr.encode_step, self.cfg,
+                features=self.feature_list, **self._lad_kw)
+            self._delta_step = jaxobs.track(
+                "encode.delta",
+                jax.jit(lambda s, c: step_fn(s, c)))
+        if move is not None:
+            from rocalphago_tpu.engine.jaxgo import step as _step
+
+            state = _step(self.cfg, state,
+                          jax.numpy.asarray(move, jax.numpy.int32))
+        if self._cache is None:
+            self._cache = _incr.init_cache(self.cfg)
+        with trace.span("encode", board=self.cfg.size, batch=1,
+                        delta=True):
+            t0 = time.monotonic()
+            planes, self._cache = self._delta_step(state, self._cache)
+            planes = jax.block_until_ready(planes)
+            dt = time.monotonic() - t0
+        self._pos_us.observe(dt * 1e6)
+        self._positions.inc()
+        self._cache_stats = observe_incremental(
+            self._cache_stats, self._cache.stats)
+        return planes[None]

@@ -313,8 +313,92 @@ def _escaper_response_fast(cfg: GoConfig, b1, prey_pt, prey_color,
     return preyL1, respL, b2
 
 
+def _chase_read_region(cfg: GoConfig, board, labels, core):
+    """Sound over-approximation of the board cells a chase's (or an
+    opening's) analysis can read, radiating from the accumulated
+    ``core`` — the union over plies of the prey's group mask plus
+    every cell the simulation played on or captured.
+
+    This is the dependency footprint of the incremental encoder's
+    per-lane cache (``features/incremental.py``): a cached opening
+    outcome / chase verdict stays valid exactly while no cell of its
+    recorded region changes — the standard memoization-with-read-set
+    induction (each ply of a re-run read would see only unchanged
+    cells, so it makes identical decisions). Crucially it is evaluated
+    ONCE per recorded lane against the ENCODE-TIME board — not per
+    rung against the simulation boards — which is sound because the
+    simulation's own moves are all in ``core``: a group on a simulated
+    board is original groups bridged by played cells, so "groups
+    touching X on the simulated board" is covered by "groups touching
+    ``dilate(X ∪ core)`` on the real board" plus ``core`` itself.
+
+    The reads fall into three rings, each covered by derivation (the
+    2-ply response algebra of :func:`_escaper_response_full` reads at
+    most 2 steps from the prey/played cells, whole adjacent groups'
+    liberty counts, and the counter-capture ring around those groups —
+    see docs/PERFORMANCE.md "Incremental encode"):
+
+    * ``dilate²(core)`` — liberty points (1 step), both chaser
+      options' neighborhoods (2 steps); simulated-merge bridging needs
+      no extra step because the bridging played cells are themselves
+      in ``core``, putting every bridged group a single step away;
+    * WHOLE groups with a stone in that region plus their own halo
+      (liberty counts are group-global: a far merge or liberty change
+      flips them);
+    * the counter-capture machinery can play at a liberty of any such
+      group (1 step) and read around it (2 steps) — one more
+      group-and-halo pass over ``dilate²`` of the first ring.
+
+    Over-approximation only costs reuse, never correctness."""
+    return _chase_read_regions(cfg, board, labels, core[None, :])[0]
+
+
+def _chase_read_regions(cfg: GoConfig, board, labels, cores):
+    """Batched :func:`_chase_read_region`: ``cores`` bool [W, N] →
+    footprints bool [W, N], all lanes against the one shared board.
+
+    This runs on EVERY recording ply of the incremental encoder, so
+    it is written for CPU op-dispatch cost, not elegance: the 2-D
+    dilations are batched pad+slice shifts (no vmap), and the
+    whole-group reads ("any core cell in group ρ?") are ONE f32
+    matmul against the label one-hot table instead of a vmapped
+    scatter-max per lane — bitwise the same result (distinct labels
+    hit distinct columns; > 0 recovers the OR), an order of magnitude
+    fewer op dispatches."""
+    n = cfg.num_points
+    size = cfg.size
+    w = cores.shape[0]
+
+    def dilate(m, k):
+        m2 = m.reshape(w, size, size)
+        for _ in range(k):
+            p = jnp.pad(m2, ((0, 0), (1, 1), (1, 1)))
+            m2 = (m2 | p[:, 2:, 1:-1] | p[:, :-2, 1:-1]
+                  | p[:, 1:-1, 2:] | p[:, 1:-1, :-2])
+        return m2.reshape(w, n)
+
+    stones = board != 0
+    # [N, N+1] one-hot of each stone's group root (empty cells hit the
+    # sentinel column n, which no real read consults)
+    label_oh = (jnp.where(stones, labels, n)[:, None]
+                == jnp.arange(n + 1)[None, :]).astype(jnp.float32)
+
+    def groups_touching(region):
+        touched = (region & stones[None, :]).astype(jnp.float32) \
+            @ label_oh                                   # [W, N+1]
+        return (jnp.take(touched, labels, axis=1) > 0.5) \
+            & stones[None, :]
+
+    region = dilate(cores, 2)
+    grp1 = groups_touching(region)
+    ring = dilate(region | grp1, 2)
+    grp2 = groups_touching(ring)
+    return ring | grp2 | dilate(grp2, 1)
+
+
 def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
-           enabled=True, return_state: bool = False):
+           enabled=True, return_state: bool = False,
+           collect_core: bool = False, core0=None):
     """Chaser to move against a two-liberty prey; True if prey is
     ladder-captured. Each iteration = one full rung (chaser move +
     forced escaper response).
@@ -341,7 +425,19 @@ def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
     ``vmap`` over candidate lanes, where the while_loop runs until
     EVERY lane converges: without the gate, empty/garbage lanes chase
     to full ``depth`` on every call, making typical positions pay the
-    worst case."""
+    worst case.
+
+    ``collect_core=True`` additionally accumulates the chase's read
+    CORE (bool [N]; seeded from ``core0``): the union over rungs of
+    the prey's group mask plus every cell the rung changed (played
+    stones and captures) — pure ORs of masks each rung computes
+    anyway, so collection is ~free. The caller expands the final core
+    ONCE with :func:`_chase_read_region` into the dependency footprint
+    the incremental encoder's verdict cache invalidates on (see that
+    function's soundness note for why a single end-of-chase expansion
+    against the encode-time board covers every rung's reads). Appended
+    to the return tuple (``captured, core`` / ``captured, unresolved,
+    board, labels, core``)."""
     n = cfg.num_points
     nbrs = neighbors_for(cfg.size)
     prey_color = board0[prey_pt].astype(jnp.int8)
@@ -353,6 +449,8 @@ def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
         captured: jax.Array
         rung: jax.Array
         settled: jax.Array      # done by OUTCOME (vs the depth cap)
+        core: jax.Array         # bool [N] accumulated read core
+        #   (all-False and never updated unless collect_core)
 
     def option_outcome(board, gd, prey_mask, lib_pt, enabled):
         """Chaser fills ``lib_pt``; returns (outcome, relabeling
@@ -409,6 +507,18 @@ def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
             cfg, board1, labels1, resp_pt, prey_color, resp_cap,
             advance & resp_made)
 
+        core = c.core
+        if collect_core:
+            # this rung's reads radiate from the prey (masked to
+            # stones — a dead prey's sentinel root would select every
+            # empty cell; the rung then stops on prey_pt alone) and
+            # from the cells it changed (played stones + captures =
+            # the rung's board diff)
+            add = ((prey_mask & (board != 0))
+                   | (jnp.arange(n) == prey_pt)
+                   | (board2 != board))
+            core = jnp.where(~c.done, core | add, core)
+
         out_of_depth = c.rung + 1 >= depth
         return Carry(
             board=board2,
@@ -417,16 +527,22 @@ def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
             captured=jnp.where(c.done, c.captured, o == _CAPTURED),
             rung=c.rung + 1,
             settled=c.settled | (~c.done & (o != _CONTINUE)),
+            core=core,
         )
 
+    core_init = (jnp.zeros((n,), jnp.bool_) if core0 is None
+                 else jnp.asarray(core0))
     init = Carry(board0, labels0, ~jnp.asarray(enabled, jnp.bool_),
                  jnp.bool_(False), jnp.int32(0),
-                 ~jnp.asarray(enabled, jnp.bool_))
+                 ~jnp.asarray(enabled, jnp.bool_), core_init)
     final = lax.while_loop(lambda c: ~c.done, body, init)
     captured = final.captured & jnp.asarray(enabled, jnp.bool_)
     if not return_state:
-        return captured
+        return (captured, final.core) if collect_core else captured
     unresolved = ~final.settled & jnp.asarray(enabled, jnp.bool_)
+    if collect_core:
+        return captured, unresolved, final.board, final.labels, \
+            final.core
     return captured, unresolved, final.board, final.labels
 
 

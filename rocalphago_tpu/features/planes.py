@@ -47,16 +47,74 @@ class CandidateInfo(NamedTuple):
     legal: jax.Array            # bool  [N] board moves only (no pass)
 
 
+@functools.lru_cache(maxsize=None)
+def _packed_consts(size: int):
+    """Trace-time constants of the packed-bitmap board representation
+    (bit ``c % 32`` of word ``c // 32`` is cell ``c``): per-cell word
+    index / bit value, the packed identity rows, and the not-col-0 /
+    not-col-last masks the E/W bitstream shifts use."""
+    import numpy as np
+
+    n = size * size
+    w = (n + 31) // 32
+    cells = np.arange(n)
+    word = cells // 32
+    bit = np.uint32(1) << (cells % 32).astype(np.uint32)
+    eye = np.zeros((n, w), np.uint32)
+    eye[cells, word] = bit
+    notcol0 = np.zeros((w,), np.uint32)
+    notcol_last = np.zeros((w,), np.uint32)
+    for c in cells:
+        if c % size != 0:
+            notcol0[c // 32] |= np.uint32(1) << np.uint32(c % 32)
+        if c % size != size - 1:
+            notcol_last[c // 32] |= np.uint32(1) << np.uint32(c % 32)
+    # numpy, not jnp: these are cached across jit traces, and a jnp
+    # constant materialized inside one trace may not escape to another
+    return (word.astype(np.int32), bit, eye, notcol0, notcol_last)
+
+
+def _packed_shift(x: jax.Array, k: int) -> jax.Array:
+    """Shift packed bitstreams (uint32 [..., W]) toward HIGHER cell
+    indices by ``k`` bits (negative = lower), zero-filled; requires
+    ``0 < |k| < 32``."""
+    if k > 0:
+        prev = jnp.concatenate(
+            [jnp.zeros_like(x[..., :1]), x[..., :-1]], axis=-1)
+        return (x << k) | (prev >> (32 - k))
+    nxt = jnp.concatenate(
+        [x[..., 1:], jnp.zeros_like(x[..., :1])], axis=-1)
+    return (x >> -k) | (nxt << (32 + k))
+
+
+def _packed_dilate(size: int, x: jax.Array) -> jax.Array:
+    """Packed-bitmap 4-neighborhood dilation: self ∪ N/S (bitstream
+    shift by ±size, falls off the ends) ∪ E/W (shift by ±1, row edges
+    masked so file-a/file-last never wrap)."""
+    _, _, _, notcol0, notcol_last = _packed_consts(size)
+    return (x
+            | _packed_shift(x, size) | _packed_shift(x, -size)
+            | (_packed_shift(x, 1) & notcol0)
+            | (_packed_shift(x, -1) & notcol_last))
+
+
 def candidate_info(cfg: GoConfig, state: GoState,
                    gd: GroupData) -> CandidateInfo:
     """Exact capture/merge/liberty analysis of every candidate move.
 
-    Requires ``gd`` built with ``with_member=True``.
+    The merged-group / captured-point bitmaps are PACKED (uint32 words
+    over cells, built straight from ``gd.labels`` by one scatter-add —
+    distinct bits of a word never collide, so add IS bitwise-or);
+    dilation is bitstream shifts and the liberty count a population
+    count. The dense [N, 4, N] member gather + boolean reductions this
+    replaces were ~70% of the whole non-ladder encode on CPU
+    (sequential profile, PR 6); ``gd.member`` is no longer read.
     """
     n = cfg.num_points
-    nbrs = neighbors_for(cfg.size)
     board, me = state.board, state.turn
     empty = board == 0
+    word, bitval, eye_p, _, _ = _packed_consts(cfg.size)
+    w = eye_p.shape[-1]
 
     nbr_color, nbr_root, uniq, _ = neighbor_analysis(cfg, board, gd.labels)
 
@@ -66,18 +124,23 @@ def candidate_info(cfg: GoConfig, state: GoState,
     capture_size = (cap_k * gd.sizes[nbr_root]).sum(axis=1)
     own_size_after = 1 + (own_k * gd.sizes[nbr_root]).sum(axis=1)
 
-    # member rows of the ≤4 neighbor groups: [N, 4, N]
-    nbr_member = gd.member[nbr_root]
-    eye = jnp.eye(n, dtype=jnp.bool_)
-    merged = eye | (nbr_member & own_k[:, :, None]).any(axis=1)   # [N, N]
-    cap_pts = (nbr_member & cap_k[:, :, None]).any(axis=1)        # [N, N]
-    new_empty = (empty[None, :] & ~eye) | cap_pts
+    # packed member rows per group (row N = the empty sentinel = 0)
+    member_p = jnp.zeros((n + 1, w), jnp.uint32).at[gd.labels, word].add(
+        jnp.where(~empty, bitval, jnp.uint32(0)))
+    member_p = member_p.at[n].set(jnp.uint32(0))
+    nbr_member_p = member_p[nbr_root]                    # [N, 4, W]
+    own_sel = jnp.where(own_k[:, :, None], nbr_member_p, jnp.uint32(0))
+    cap_sel = jnp.where(cap_k[:, :, None], nbr_member_p, jnp.uint32(0))
+    merged = (eye_p | own_sel[:, 0] | own_sel[:, 1]
+              | own_sel[:, 2] | own_sel[:, 3])           # [N, W]
+    cap_pts = cap_sel[:, 0] | cap_sel[:, 1] | cap_sel[:, 2] | cap_sel[:, 3]
 
-    # dilate merged group: q ∈ D[p] iff q ∈ M[p] or a neighbor of q is
-    merged_pad = jnp.concatenate(
-        [merged, jnp.zeros((n, 1), jnp.bool_)], axis=1)
-    dilated = merged | merged_pad[:, nbrs].any(axis=2)
-    libs_after = (dilated & new_empty).sum(axis=1).astype(jnp.int32)
+    empty_p = jnp.zeros((w,), jnp.uint32).at[word].add(
+        jnp.where(empty, bitval, jnp.uint32(0)))
+    new_empty = (empty_p[None, :] & ~eye_p) | cap_pts
+    dilated = _packed_dilate(cfg.size, merged)
+    libs_after = jax.lax.population_count(
+        dilated & new_empty).sum(axis=1).astype(jnp.int32)
 
     legal = legal_mask(cfg, state, gd)[:n]
     return CandidateInfo(capture_size.astype(jnp.int32),
@@ -113,64 +176,62 @@ def _one_hot8(value: jax.Array, lo: int, active: jax.Array) -> jax.Array:
 
 def needs_member(features: tuple) -> bool:
     """Whether these features require ``group_data(with_member=True)``
-    (the candidate-simulation planes) — callers precomputing a shared
-    ``gd`` for :func:`encode` must match this."""
+    — callers precomputing a shared ``gd`` for :func:`encode` must
+    match this. Always False since :func:`candidate_info` switched to
+    packed bitmaps built straight from ``gd.labels``: no plane reads
+    the dense ``gd.member`` rows anymore (superko's zxor is the only
+    remaining consumer, and ``group_data`` handles that itself). Kept
+    as the single source of truth for the convention."""
+    del features
+    return False
+
+
+def needs_candidates(features: tuple) -> bool:
+    """Whether these features need :func:`candidate_info` (the
+    per-candidate-move capture/merge/liberty analysis)."""
     return any(f in ("capture_size", "self_atari_size",
                      "liberties_after") for f in features)
 
 
-def encode(cfg: GoConfig, state: GoState,
-           features: tuple = None,
-           ladder_depth: int = 40,
-           ladder_lanes: int = 16,
-           ladder_chase_slots: int = 6,
-           gd: "GroupData | None" = None) -> jax.Array:
-    """Encode one game state → float32 ``[size, size, F]`` (NHWC).
-
-    ``features`` is a tuple of plane-group names (static under jit);
-    default is the full 48-plane AlphaGo set. Pass a precomputed ``gd``
-    (built with ``with_member`` if the candidate-simulation planes are
-    requested) to share one flood fill with the caller's own analysis
-    — the self-play ply does this (encode + sensibleness per ply).
-
-    When BOTH ladder planes are requested (the default set), they are
-    computed by ONE shared, gated read (:func:`ladders.ladder_planes`:
-    one candidate analysis, one pooled chase-slot set, one rung loop)
-    — the encode-path overhaul; see docs/PERFORMANCE.md "Encode path".
-    """
-    from rocalphago_tpu.features import ladders as _ladders
-    from rocalphago_tpu.features.pyfeatures import (
-        DEFAULT_FEATURES,
-        FEATURE_PLANES,
-    )
-
-    if features is None:
-        features = DEFAULT_FEATURES
+def encode_analysis(cfg: GoConfig, state: GoState, features: tuple,
+                    gd: "GroupData | None" = None):
+    """The per-state analysis every encode variant shares:
+    ``(gd, ci, legal)`` — group data (built with member rows iff the
+    candidate-simulation planes need them), the candidate-move info
+    (None when unneeded) and the board-move legality mask. Factored
+    out so the incremental encoder (:mod:`features.incremental`) and
+    the from-scratch :func:`encode` analyse identically — bit-identity
+    between the two paths starts here."""
     n = cfg.num_points
-    board, me = state.board, state.turn
-    empty = board == 0
-    has_stone = ~empty
-
-    need_member = needs_member(features)
     if gd is None:
-        gd = group_data(cfg, board, with_member=need_member,
+        gd = group_data(cfg, state.board,
+                        with_member=needs_member(features),
                         with_zxor=cfg.enforce_superko,
                         labels=state.labels)
     ci = None
-    if need_member:
+    if needs_candidates(features):
         ci = candidate_info(cfg, state, gd)
         legal = ci.legal
     else:
         legal = legal_mask(cfg, state, gd)[:n]
+    return gd, ci, legal
 
-    # both ladder planes ride one shared gated chase; a single-plane
-    # request keeps the cheaper per-plane read
-    lad_cap = lad_esc = None
-    lad_kw = dict(depth=ladder_depth, lanes=ladder_lanes,
-                  chase_slots=ladder_chase_slots)
-    if "ladder_capture" in features and "ladder_escape" in features:
-        lad_cap, lad_esc = _ladders.ladder_planes(
-            cfg, state, gd, legal, **lad_kw)
+
+def assemble_planes(cfg: GoConfig, state: GoState, features: tuple,
+                    gd: "GroupData", ci, legal, lad_cap, lad_esc,
+                    lad_kw: dict) -> jax.Array:
+    """Stack the requested plane groups → ``[size, size, F]``. The
+    ladder planes are passed in when both were computed by a shared
+    read (``ladder_planes`` / the incremental cached read); a
+    single-plane request falls back to the per-plane reader here.
+    Shared verbatim by :func:`encode` and ``features/incremental.py``
+    so the two paths cannot drift plane-by-plane."""
+    from rocalphago_tpu.features import ladders as _ladders
+
+    n = cfg.num_points
+    board, me = state.board, state.turn
+    empty = board == 0
+    has_stone = ~empty
 
     out = []
     for name in features:
@@ -215,6 +276,48 @@ def encode(cfg: GoConfig, state: GoState,
         out.append(f)
     flat = jnp.concatenate(out, axis=-1)
     return flat.reshape(cfg.size, cfg.size, -1)
+
+
+def encode(cfg: GoConfig, state: GoState,
+           features: tuple = None,
+           ladder_depth: int = 40,
+           ladder_lanes: int = 16,
+           ladder_chase_slots: int = 6,
+           gd: "GroupData | None" = None) -> jax.Array:
+    """Encode one game state → float32 ``[size, size, F]`` (NHWC).
+
+    ``features`` is a tuple of plane-group names (static under jit);
+    default is the full 48-plane AlphaGo set. Pass a precomputed ``gd``
+    (built with ``with_member`` if the candidate-simulation planes are
+    requested) to share one flood fill with the caller's own analysis
+    — the self-play ply does this (encode + sensibleness per ply).
+
+    When BOTH ladder planes are requested (the default set), they are
+    computed by ONE shared, gated read (:func:`ladders.ladder_planes`:
+    one candidate analysis, one pooled chase-slot set, one rung loop)
+    — the encode-path overhaul; see docs/PERFORMANCE.md "Encode path".
+    Sequential callers (self-play, MCTS root advance) should prefer
+    the delta sibling ``features/incremental.py::encode_step``, which
+    produces bit-identical planes while reusing prior ladder-chase
+    verdicts across successive positions.
+    """
+    from rocalphago_tpu.features import ladders as _ladders
+    from rocalphago_tpu.features.pyfeatures import DEFAULT_FEATURES
+
+    if features is None:
+        features = DEFAULT_FEATURES
+    gd, ci, legal = encode_analysis(cfg, state, features, gd)
+
+    # both ladder planes ride one shared gated chase; a single-plane
+    # request keeps the cheaper per-plane read
+    lad_cap = lad_esc = None
+    lad_kw = dict(depth=ladder_depth, lanes=ladder_lanes,
+                  chase_slots=ladder_chase_slots)
+    if "ladder_capture" in features and "ladder_escape" in features:
+        lad_cap, lad_esc = _ladders.ladder_planes(
+            cfg, state, gd, legal, **lad_kw)
+    return assemble_planes(cfg, state, features, gd, ci, legal,
+                           lad_cap, lad_esc, lad_kw)
 
 
 def batched_encoder(cfg: GoConfig, features: tuple, **encode_kwargs):

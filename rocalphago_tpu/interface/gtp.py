@@ -198,7 +198,7 @@ class GTPEngine:
 
     # ------------------------------------------------------------ setup
 
-    def _new_game(self):
+    def _new_game(self, reason: str = "clear_board"):
         from rocalphago_tpu.search.players import reset_player
 
         self.state = pygo.GameState(size=self.size, komi=self.komi)
@@ -206,7 +206,10 @@ class GTPEngine:
         self._time_left = {}      # fresh game, fresh clocks
         self._time_spent = {}
         self._genmoves = {}
-        reset_player(self.player)
+        # reason labels the player's cache/carry invalidation
+        # (encode_cache_resets_total{reason=...} — the incremental
+        # encoder's explicit full-re-encode fallbacks)
+        reset_player(self.player, reason=reason)
 
     def _player_board(self):
         """Fixed board size the wrapped player's nets were built for
@@ -226,7 +229,7 @@ class GTPEngine:
         if net_board is not None and size != net_board:
             raise ValueError("unacceptable size")
         self.size = size
-        self._new_game()
+        self._new_game(reason="boardsize")
         return ""
 
     def cmd_clear_board(self, args):
@@ -366,6 +369,11 @@ class GTPEngine:
         self.state = self._undo_stack.pop()
         # a komi set after the snapshot must survive the undo
         self.state.komi = self.komi
+        # rewinds are a history jump: the device player's subtree
+        # walk detects it on its own (turns_played decreased), and
+        # the incremental-encode cache stays CORRECT either way
+        # (board-diff invalidation) — no reset needed here, the next
+        # root encode simply refreshes what the jump dirtied
         return ""
 
     # ------------------------------------------------------ observation

@@ -52,8 +52,9 @@ from jax.experimental import pallas as pl
 _CAPTURED, _CONTINUE, _ESCAPED = 0, 1, 2
 
 
-def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref, *,
-                  size: int, depth: int):
+def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref,
+                  *maybe_core_ref, size: int, depth: int,
+                  collect_core: bool = False):
     n = size * size
     SENT = jnp.int32(n)           # empty/off-board label sentinel
     BIG = jnp.int32(4 * n)        # "no point" index sentinel
@@ -304,32 +305,44 @@ def _chase_kernel(board_ref, labels_ref, prey_ref, out_ref, *,
                                   advance)
         board2, labels2 = relabel(board1, labels1, resp_pt, prey_color,
                                   resp_cap, advance & resp_made)
-        return board2, labels2, o
+        # this rung's read-core contribution — mirror of the XLA
+        # chase's collect_core accumulation (ladders._chase): the
+        # prey's stones, the prey point itself, and every cell the
+        # rung changed (played stones + captures = the board diff)
+        add = ((prey_mask & (board != 0)) | (prey_oh > 0)
+               | (board2 != board))
+        return board2, labels2, o, add
 
     def cond(state):
-        _, _, done, _, r = state
+        _, _, done, _, _, r = state
         return ~done & (r < depth)
 
     def body(state):
-        board, labels, done, captured, r = state
-        board2, labels2, o = rung(board, labels)
+        board, labels, done, captured, core, r = state
+        board2, labels2, o, add = rung(board, labels)
         return (board2, labels2,
                 done | (o != _CONTINUE),
                 jnp.where(done, captured, o == _CAPTURED),
+                core | (~done & add),
                 r + 1)
 
     enabled = prey_oh.sum() > 0
-    init = (board0, labels0, ~enabled, jnp.bool_(False), jnp.int32(0))
-    _, _, _, captured, _ = jax.lax.while_loop(cond, body, init)
+    init = (board0, labels0, ~enabled, jnp.bool_(False),
+            jnp.zeros((1, 1, n), jnp.bool_), jnp.int32(0))
+    _, _, _, captured, core, _ = jax.lax.while_loop(cond, body, init)
     out_ref[...] = jnp.broadcast_to(
         (captured & enabled).astype(jnp.int32), (1, 1, n))
+    if collect_core:
+        maybe_core_ref[0][...] = (core & enabled).astype(jnp.int32)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("size", "depth", "interpret"))
+                   static_argnames=("size", "depth", "interpret",
+                                    "collect_core"))
 def pallas_chase(boards: jax.Array, labels: jax.Array,
                  prey_onehot: jax.Array, size: int, depth: int = 40,
-                 interpret: bool = False) -> jax.Array:
+                 interpret: bool = False,
+                 collect_core: bool = False) -> jax.Array:
     """Batched ladder chase: for each lane ``i``, is the group at
     ``prey_onehot[i]`` (one-hot over the flat board; all-zero =
     disabled lane) ladder-captured with the chaser to move?
@@ -339,20 +352,36 @@ def pallas_chase(boards: jax.Array, labels: jax.Array,
     Returns bool ``[L]``. Semantics identical to
     ``vmap(ladders._chase)``; each lane runs its own grid cell, so
     trip counts are per-lane, not batch-lockstep.
+
+    ``collect_core=True`` additionally returns the per-lane read CORE
+    (bool ``[L, N]``) — the same accumulation as the XLA chase's
+    ``collect_core`` (union over rungs of the prey's group mask plus
+    every cell each rung changed), i.e. the seed the incremental
+    encoder's footprint expansion (``ladders._chase_read_region``)
+    radiates from. Return becomes ``(captured [L], core [L, N])``.
+    Collection is a few extra vector ORs per rung — the lanes' own
+    while loops and VMEM residency are unchanged.
     """
     lanes, n = boards.shape
     if n != size * size:
         raise ValueError(f"boards have {n} points, size² is {size * size}")
-    kernel = functools.partial(_chase_kernel, size=size, depth=depth)
+    kernel = functools.partial(_chase_kernel, size=size, depth=depth,
+                               collect_core=collect_core)
     spec = pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0))
+    shape = jax.ShapeDtypeStruct((lanes, 1, n), jnp.int32)
+    out_specs = [spec, spec] if collect_core else spec
+    out_shape = [shape, shape] if collect_core else shape
     out = pl.pallas_call(
         kernel,
         grid=(lanes,),
         in_specs=[spec, spec, spec],
-        out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((lanes, 1, n), jnp.int32),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(boards.astype(jnp.int32)[:, None, :],
       labels.astype(jnp.int32)[:, None, :],
       prey_onehot.astype(jnp.int32)[:, None, :])
+    if collect_core:
+        captured, core = out
+        return captured[:, 0, 0] > 0, core[:, 0, :] > 0
     return out[:, 0, 0] > 0

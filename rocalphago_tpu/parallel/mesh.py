@@ -30,21 +30,50 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def cpu_collectives_available() -> bool:
+    """Whether this jaxlib ships gloo TCP collectives for the CPU
+    backend. Without them a multi-process CPU bring-up constructs a
+    client whose collectives raise ``Multiprocess computations aren't
+    implemented on the CPU backend`` at the first cross-process op —
+    the capability the CPU DCN test keys its skip on."""
+    try:
+        import jaxlib.xla_extension as _xe
+
+        return hasattr(_xe, "make_gloo_tcp_collectives")
+    except Exception:  # noqa: BLE001 — capability probe must not raise
+        return False
+
+
 def distributed_init(coordinator: str | None = None,
                      num_processes: int | None = None,
                      process_id: int | None = None) -> None:
     """Multi-host bring-up (DCN). No-op for single-process runs.
 
     On Cloud TPU pods the arguments are auto-detected from the
-    environment; pass them explicitly elsewhere.
+    environment; pass them explicitly elsewhere. Multi-process CPU
+    runs (the localhost DCN test, CPU-only actor fleets) need a real
+    collectives transport — the default CPU client has none and fails
+    at the first cross-process op — so gloo is selected here whenever
+    the installed jaxlib ships it.
     """
+    multiproc = (num_processes is not None and num_processes > 1
+                 or coordinator is not None
+                 or int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1)
+    if not multiproc:
+        return
+    if cpu_collectives_available():
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jax without the knob
+            pass
     if num_processes is not None and num_processes > 1 or (
             coordinator is not None):
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id)
-    elif int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
+    else:
         jax.distributed.initialize()
 
 

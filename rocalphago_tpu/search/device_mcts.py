@@ -56,6 +56,10 @@ from rocalphago_tpu.engine.jaxgo import (
     step,
     winner,
 )
+from rocalphago_tpu.features.incremental import (
+    batched_delta_encoder,
+    init_caches,
+)
 from rocalphago_tpu.features.planes import batched_encoder, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.obs import jaxobs
@@ -134,18 +138,14 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         cfg, s.board, with_member=needs_member(value_features),
         with_zxor=cfg.enforce_superko, labels=s.labels))
     venc = batched_encoder(cfg, value_features)
+    denc = batched_delta_encoder(cfg, value_features)
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
     vterm = jax.vmap(functools.partial(_terminal_value, cfg))
 
-    def eval_batch(params_p, params_v, states: GoState):
-        """One fused NN evaluation of a [B]-batched GoState:
-        ``(priors f32 [B, A], values f32 [B])``. Priors are a masked
-        softmax over sensible moves; the pass action gets probability
-        1 exactly when no sensible move exists. Values are the value
-        net's output where live, the terminal outcome where done."""
-        gd = vgd(states)
-        planes = venc(states, gd)                      # [B, s, s, Fv]
+    def _eval_from(params_p, params_v, states: GoState, gd, planes):
+        """The NN half of :func:`eval_batch`, on precomputed analysis
+        + planes (shared with the delta-encode root path)."""
         sens = vsens(states, gd)                       # [B, N]
         logits = policy_apply(params_p,
                               planes[..., :n_policy_planes])
@@ -161,7 +161,17 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         values = jnp.where(states.done, vterm(states), values)
         return priors, values
 
-    def init_tree(params_p, params_v, roots: GoState) -> DeviceTree:
+    def eval_batch(params_p, params_v, states: GoState):
+        """One fused NN evaluation of a [B]-batched GoState:
+        ``(priors f32 [B, A], values f32 [B])``. Priors are a masked
+        softmax over sensible moves; the pass action gets probability
+        1 exactly when no sensible move exists. Values are the value
+        net's output where live, the terminal outcome where done."""
+        gd = vgd(states)
+        planes = venc(states, gd)                      # [B, s, s, Fv]
+        return _eval_from(params_p, params_v, states, gd, planes)
+
+    def _assemble_tree(roots: GoState, root_priors) -> DeviceTree:
         batch = roots.board.shape[0]
         # node-state slab: every slot starts as a fresh state (cheap,
         # valid shapes), root state written into slot 0
@@ -170,7 +180,6 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             new_states(cfg, m))
         slab = jax.vmap(_set_state, in_axes=(0, None, 0))(
             slab, 0, roots)
-        root_priors, _ = eval_batch(params_p, params_v, roots)
         prior = jnp.zeros((batch, m, num_actions), jnp.float32) \
             .at[:, 0, :].set(root_priors)
         return DeviceTree(
@@ -184,6 +193,23 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             n_nodes=jnp.ones((batch,), jnp.int32),
             root=jnp.zeros((batch,), jnp.int32),
         )
+
+    def init_tree(params_p, params_v, roots: GoState) -> DeviceTree:
+        root_priors, _ = eval_batch(params_p, params_v, roots)
+        return _assemble_tree(roots, root_priors)
+
+    def init_tree_cached(params_p, params_v, roots: GoState, caches):
+        """:func:`init_tree` with the root planes through the
+        incremental encoder (``features/incremental.py``): serving
+        advances the root ONE move per ``get_move``, so successive
+        root encodes reuse the previous move's ladder-chase verdicts.
+        Bit-identical priors (the delta path's contract); returns
+        ``(tree, caches')`` — the caller carries the cache across
+        moves (``DeviceMCTSPlayer._enc_cache``)."""
+        gd = vgd(roots)
+        planes, caches = denc(roots, caches, gd)
+        priors, _ = _eval_from(params_p, params_v, roots, gd, planes)
+        return _assemble_tree(roots, priors), caches
 
     def _select_action(prior_n, visits_n, value_n):
         """PUCT argmax over one node's edges ([A] arrays).
@@ -514,6 +540,12 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     # the loop's chunks. Its donates_buffers marks it unretryable
     # (runtime.retries refuses to wrap it).
     search.init = jaxobs.track("device_mcts.init", jax.jit(init_tree))
+    # incremental-root sibling: (params_p, params_v, roots, caches) →
+    # (tree, caches') — the GTP/DeviceMCTSPlayer root advance carries
+    # the cache across moves; make_caches builds the cold carry
+    search.init_cached = jaxobs.track(
+        "device_mcts.init_cached", jax.jit(init_tree_cached))
+    search.make_caches = functools.partial(init_caches, cfg)
     search.run_sims = jaxobs.track("device_mcts.run_sims", run_sims)
     search.run_sims_donated = jaxobs.track(
         "device_mcts.run_sims", run_sims_donated)
@@ -615,12 +647,10 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                             max_nodes=max_nodes, c_puct=c_puct)
     neg = jnp.float32(jnp.finfo(jnp.float32).min)
 
-    def init(params_p, params_v, roots: GoState, rng):
-        """-> (tree, g f32 [B, A], cand i32 [B, m], logits f32 [B, A])
-        — the tree with root priors, the gumbel-perturbed root logits,
-        the ranked candidate actions, and the raw (noise-free) masked
-        logits the improved-policy target is built from."""
-        tree = base.init(params_p, params_v, roots)
+    def _root_draw(tree: DeviceTree, rng):
+        """Gumbel-top-k root candidate draw off an initialized tree:
+        ``(tree, g, cand, logits)`` — shared by the from-scratch and
+        incremental-root inits."""
         root_prior = tree.prior[:, 0, :]
         logits = jnp.where(root_prior > 0, jnp.log(
             jnp.maximum(root_prior, 1e-38)), neg)
@@ -628,6 +658,24 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         g = jnp.where(root_prior > 0, logits + gumbel, neg)
         _, cand = lax.top_k(g, m)
         return tree, g, cand.astype(jnp.int32), logits
+
+    def init(params_p, params_v, roots: GoState, rng):
+        """-> (tree, g f32 [B, A], cand i32 [B, m], logits f32 [B, A])
+        — the tree with root priors, the gumbel-perturbed root logits,
+        the ranked candidate actions, and the raw (noise-free) masked
+        logits the improved-policy target is built from."""
+        tree = base.init(params_p, params_v, roots)
+        return _root_draw(tree, rng)
+
+    def init_cached(params_p, params_v, roots: GoState, rng, caches):
+        """:func:`init` with the root encode through the incremental
+        path (``base.init_cached``) → ``(tree, g, cand, logits,
+        caches')``. Gumbel rebuilds its tree every move by design, so
+        the root encode is per-move serving cost — exactly the
+        successive-positions pattern the delta cache pays for."""
+        tree, caches = base.init_cached(params_p, params_v, roots,
+                                        caches)
+        return _root_draw(tree, rng) + (caches,)
 
     def _sigma_completed(tree: DeviceTree):
         """σ(completed q̂) over every root action — the Gumbel value
@@ -718,7 +766,8 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     def run_chunked(params_p, params_v, roots: GoState, rng,
                     chunk: int, deadline=None,
                     depth: int | None = None,
-                    pipeline: ChunkPipeline | None = None):
+                    pipeline: ChunkPipeline | None = None,
+                    caches=None):
         """Phase-by-phase, ``chunk``-simulation compiled programs with
         the tree device-resident in between (the ~40s TPU worker
         watchdog); identical results to :func:`search` unless a
@@ -740,7 +789,16 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         needs no host sync; deadline expiry may leave up to ``depth``
         chunks in flight — they complete and count, the overshoot
         bound (docs/RESILIENCE.md)."""
-        tree, g, cand, logits = init_j(params_p, params_v, roots, rng)
+        if caches is None:
+            tree, g, cand, logits = init_j(params_p, params_v, roots,
+                                           rng)
+        else:
+            # incremental root encode; the refreshed carry comes back
+            # on search.last_caches (same convention as last_ran) —
+            # the return tuple stays (visits, q, best, pi)
+            tree, g, cand, logits, caches = init_cached_j(
+                params_p, params_v, roots, rng, caches)
+        search.last_caches = caches
         enforce = deadline is not None and not deadline.unlimited
         pipe = pipeline if pipeline is not None else ChunkPipeline(
             depth, runner="gumbel")
@@ -788,6 +846,8 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         return visits, q, cand[:, 0], improved_j(tree, logits)
 
     init_j = jax.jit(init)
+    init_cached_j = jaxobs.track("device_mcts.init_cached",
+                                 jax.jit(init_cached))
     rerank_j = jax.jit(rerank, static_argnames=("k",))
     improved_j = jax.jit(improved_policy)
 
@@ -800,6 +860,9 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
     _sims_c = obs_registry.counter("device_mcts_sims_total")
 
     search.init = init_j
+    search.init_cached = init_cached_j
+    search.make_caches = base.make_caches
+    search.last_caches = None   # refreshed carry from run_chunked
     search.rerank = rerank_j
     search.run_phase = jaxobs.track("device_mcts.run_phase", run_phase)
     # the chunk loop's program: run_phase with the tree slab donated
@@ -875,7 +938,8 @@ class DeviceMCTSPlayer:
                  max_nodes: int | None = None, c_puct: float = 5.0,
                  sim_chunk: int = 8, gumbel: bool = False,
                  m_root: int = 16, seed: int = 0,
-                 reuse: bool = True):
+                 reuse: bool = True,
+                 incremental: bool | None = None):
         self.policy = policy_net
         self.value = value_net
         self.board = policy_net.board
@@ -899,6 +963,22 @@ class DeviceMCTSPlayer:
         self._reuse = reuse and not gumbel
         self._carry = None
         self.reuses = 0     # observability: # of reused searches
+        # incremental ROOT encode (features/incremental.py): serving
+        # advances the root one move per get_move, so the root
+        # planes' ladder chases are re-run only where the one-move
+        # board delta touched their recorded footprints. Default ON
+        # for this sequential path (env ROCALPHAGO_ENCODE_INCR
+        # forces either way); bit-identical priors, so search results
+        # never depend on the cache. The cache rides across komi
+        # changes (planes don't read komi) and any position jump
+        # (board-diff invalidation is the correctness mechanism);
+        # reset() drops it per game for honest reuse stats.
+        from rocalphago_tpu.features import incremental as _incr
+
+        self._incr = (_incr.enabled(default=True)
+                      if incremental is None else incremental)
+        self._enc_cache = None
+        self._enc_stats = None
         # GTP time control (see class docstring): shared clock, rate
         # samples keyed per searcher so each key's compile-bearing
         # first run never pollutes the sims/sec EMA
@@ -932,9 +1012,17 @@ class DeviceMCTSPlayer:
         """Nominal per-move simulation budget (uncapped)."""
         return self._n_sim
 
-    def reset(self) -> None:
-        """Forget cross-move search state (new game)."""
+    def reset(self, reason: str = "new_game") -> None:
+        """Forget cross-move search state (new game): the carried
+        subtree and the incremental-encode cache (counted per
+        ``reason`` — ``encode_cache_resets_total{reason=...}``)."""
         self._carry = None
+        if self._enc_cache is not None:
+            from rocalphago_tpu.features.api import count_cache_reset
+
+            count_cache_reset(reason)
+        self._enc_cache = None
+        self._enc_stats = None
 
     def set_move_time(self, seconds) -> None:
         """Per-move wall budget in seconds (None = no clock). The GTP
@@ -1060,9 +1148,14 @@ class DeviceMCTSPlayer:
         t0 = time.monotonic()
         if self._gumbel:
             self._rng, sub = jax.random.split(self._rng)
+            if self._incr and self._enc_cache is None:
+                self._enc_cache = search.make_caches(1)
             visits, _, best, _ = search.run_chunked(
                 self.policy.params, self.value.params, roots, sub,
-                self._chunk, deadline=deadline)
+                self._chunk, deadline=deadline,
+                caches=self._enc_cache if self._incr else None)
+            if self._incr:
+                self._enc_cache = search.last_caches
             action = int(jax.device_get(best)[0])
             counts = np.asarray(jax.device_get(visits))[0]
             # a halving plan really runs its schedule total, not eff
@@ -1074,6 +1167,15 @@ class DeviceMCTSPlayer:
                     if self._reuse else None)
             if tree is not None:
                 self.reuses += 1
+            elif self._incr:
+                # incremental root encode: one move past the last
+                # encoded root in serving, so the cached ladder
+                # verdicts mostly survive the one-stone board delta
+                if self._enc_cache is None:
+                    self._enc_cache = search.make_caches(1)
+                tree, self._enc_cache = search.init_cached(
+                    self.policy.params, self.value.params, roots,
+                    self._enc_cache)
             else:
                 tree = search.init(self.policy.params,
                                    self.value.params, roots)
@@ -1097,6 +1199,13 @@ class DeviceMCTSPlayer:
             if self._reuse:
                 self._carry = (komi, state.size, state.turns_played,
                                tree)
+        if self._incr and self._enc_cache is not None:
+            from rocalphago_tpu.features.api import observe_incremental
+
+            # get_move is fully synced by the visits fetch above, so
+            # the 6-int stats snapshot costs one tiny transfer
+            self._enc_stats = observe_incremental(
+                self._enc_stats, self._enc_cache.stats)
         self.last_deadline_hit = ran < planned
         self.deadline_hits += int(self.last_deadline_hit)
         dt = time.monotonic() - t0

@@ -160,13 +160,29 @@ def player_board(player) -> int | None:
     return board
 
 
-def reset_player(player) -> None:
-    """Clear any per-game search state (new game starting)."""
+def reset_player(player, reason: str = "new_game") -> None:
+    """Clear any per-game search state (new game starting).
+
+    ``reason`` labels the reset for players that count their
+    cache invalidations (``DeviceMCTSPlayer.reset`` →
+    ``encode_cache_resets_total{reason=...}``); players with a
+    plain ``reset()`` just ignore it."""
+    import inspect
+
+    def _reset(fn):
+        try:
+            sig = inspect.signature(fn)
+            if "reason" in sig.parameters:
+                return fn(reason=reason)
+        except (TypeError, ValueError):
+            pass
+        return fn()
+
     mcts = getattr(player, "mcts", None)
     if mcts is not None and hasattr(mcts, "reset"):
-        mcts.reset()
+        _reset(mcts.reset)
     if hasattr(player, "reset") and callable(player.reset):
-        player.reset()      # e.g. DeviceMCTSPlayer's carried tree
+        _reset(player.reset)    # e.g. DeviceMCTSPlayer's carried tree
     if hasattr(player, "_tree_history"):
         player._tree_history = None
 

@@ -42,6 +42,10 @@ from rocalphago_tpu.engine.jaxgo import (
     vgroup_data,
     winner,
 )
+from rocalphago_tpu.features.incremental import (
+    batched_delta_encoder,
+    init_caches,
+)
 from rocalphago_tpu.features.planes import (
     batched_encoder,
     needs_member,
@@ -50,6 +54,29 @@ from rocalphago_tpu.features.planes import (
 from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.runtime.pipeline import ChunkPipeline
+
+
+def incremental_default() -> bool:
+    """Whether the batched self-play ply loop carries the incremental
+    encode cache (``features/incremental.py``) — env knob
+    ``ROCALPHAGO_ENCODE_INCR``, read at TRACE time like the ladder
+    knobs so benchmarks can A/B it per traced program.
+
+    MEASURED DEFAULT off for the BATCHED loop: under ``vmap`` the
+    delta path's gating conds lower to selects that execute both
+    branches, so its win is confined to cached ladder verdicts
+    shortening the batch-lockstep rung loop, against the footprint
+    bookkeeping it adds every ply (``bench_encode.py --trajectory
+    --traj-batch`` records the A/B; BENCH_RESULTS.md "Incremental
+    encode"). The SEQUENTIAL single-state paths
+    (``Preprocess.advance``, the ``DeviceMCTSPlayer`` root advance,
+    ``bench_encode --trajectory``) default ON instead — there the
+    host-branch gating really skips the opening/chase blocks and
+    measures ~2× µs/pos on dense 19×19 random tails. Results are
+    bit-identical either way (``tests/test_incremental.py``)."""
+    from rocalphago_tpu.features import incremental as _incr
+
+    return _incr.enabled(default=False)
 
 
 def sensible_mask(cfg: GoConfig, state: GoState,
@@ -80,28 +107,41 @@ def _half_swap(x: jax.Array, swap: jax.Array) -> jax.Array:
 
 
 def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
-              apply_b: Callable, batch: int, temperature: float):
+              apply_b: Callable, batch: int, temperature: float,
+              incremental: bool = False):
     """Shared scan body for :func:`play_games` and
     :func:`make_selfplay_chunked`: one ply of lockstep two-net
     self-play, parameterized over net params so the chunked runner can
     trace it in a standalone jit. Owns the even-batch invariant: the
-    half-batch color split slices at ``batch // 2``."""
+    half-batch color split slices at ``batch // 2``.
+
+    ``incremental``: encode each ply through the delta path
+    (:func:`~rocalphago_tpu.features.incremental.batched_delta_encoder`)
+    with a per-game :class:`EncodeCache` threaded through the scan
+    carry — bit-identical planes, cached ladder verdicts across
+    successive plies. The ply then takes and returns ``caches``
+    (``None`` and pass-through when off, so both runners carry one
+    pytree slot either way)."""
     if batch % 2:
         raise ValueError(
             f"batch must be even (half-and-half color split), got {batch}")
     n = cfg.num_points
     vgd = vgroup_data(cfg, with_member=needs_member(features),
                       with_zxor=cfg.enforce_superko)
-    enc = batched_encoder(cfg, features)
+    enc = (batched_delta_encoder(cfg, features) if incremental
+           else batched_encoder(cfg, features))
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
 
-    def ply(params_a, params_b, states, rng, t):
+    def ply(params_a, params_b, states, caches, rng, t):
         rng, sub = jax.random.split(rng)
         # one loop-free analysis per ply, shared by the encoder, the
         # sensibleness mask and the rules step
         gd = vgd(states)
-        planes = enc(states, gd)
+        if incremental:
+            planes, caches = enc(states, caches, gd)
+        else:
+            planes = enc(states, gd)
         # which half faces net A this ply (see module docstring)
         swap = (t % 2) == 1
         rolled = _half_swap(planes, swap)
@@ -120,21 +160,23 @@ def _make_ply(cfg: GoConfig, features: tuple, apply_a: Callable,
 
         live = ~states.done
         new = vstep(states, action, gd)
-        return new, rng, action, live
+        return new, caches, rng, action, live
 
     return ply
 
 
-def _scan_plies(ply, params_a, params_b, states, rng, ts):
+def _scan_plies(ply, params_a, params_b, states, caches, rng, ts):
     """Scan ``ply`` over the ply indices ``ts``; returns
-    ``(states, rng, actions [T,B], live [T,B])``."""
+    ``(states, caches, rng, actions [T,B], live [T,B])``."""
     def body(carry, t):
-        states, rng = carry
-        new, rng, action, live = ply(params_a, params_b, states, rng, t)
-        return (new, rng), (action, live)
+        states, caches, rng = carry
+        new, caches, rng, action, live = ply(
+            params_a, params_b, states, caches, rng, t)
+        return (new, caches, rng), (action, live)
 
-    (states, rng), (actions, live) = lax.scan(body, (states, rng), ts)
-    return states, rng, actions, live
+    (states, caches, rng), (actions, live) = lax.scan(
+        body, (states, caches, rng), ts)
+    return states, caches, rng, actions, live
 
 
 def _finish(cfg: GoConfig, final, actions, live,
@@ -156,7 +198,8 @@ def play_games(cfg: GoConfig, features: tuple,
                apply_b: Callable, params_b,
                rng: jax.Array, batch: int, max_moves: int = 500,
                temperature: float = 1.0,
-               score_on_device: bool = True) -> SelfplayResult:
+               score_on_device: bool = True,
+               incremental: bool | None = None) -> SelfplayResult:
     """Play ``batch`` lockstep games of net A vs net B.
 
     First half of the batch: A is Black; second half: B is Black
@@ -164,23 +207,35 @@ def play_games(cfg: GoConfig, features: tuple,
     reference's RL trainer does). ``apply_*`` map (params, planes
     [B',s,s,F]) → logits [B', N]. Fully jit-compatible; wrap in
     ``jax.jit`` with static ``cfg/features/batch/max_moves``.
+
+    ``incremental`` (default: the ``ROCALPHAGO_ENCODE_INCR`` knob,
+    :func:`incremental_default`): thread the delta-encode cache
+    through the ply scan — bit-identical results, ladder-chase
+    verdicts reused across successive plies.
     """
+    if incremental is None:
+        incremental = incremental_default()
     states = new_states(cfg, batch)
-    ply = _make_ply(cfg, features, apply_a, apply_b, batch, temperature)
-    final, _, actions, live = _scan_plies(
-        ply, params_a, params_b, states, rng, jnp.arange(max_moves))
+    caches = init_caches(cfg, batch) if incremental else None
+    ply = _make_ply(cfg, features, apply_a, apply_b, batch,
+                    temperature, incremental=incremental)
+    final, _, _, actions, live = _scan_plies(
+        ply, params_a, params_b, states, caches, rng,
+        jnp.arange(max_moves))
     return _finish(cfg, final, actions, live, score_on_device, batch)
 
 
 def make_selfplay(cfg: GoConfig, features: tuple, apply_a: Callable,
                   apply_b: Callable, batch: int, max_moves: int = 500,
-                  temperature: float = 1.0):
+                  temperature: float = 1.0,
+                  incremental: bool | None = None):
     """Jitted ``(params_a, params_b, rng) -> SelfplayResult`` closure."""
 
     @jax.jit
     def run(params_a, params_b, rng):
         return play_games(cfg, features, apply_a, params_a, apply_b,
-                          params_b, rng, batch, max_moves, temperature)
+                          params_b, rng, batch, max_moves, temperature,
+                          incremental=incremental)
 
     return run
 
@@ -190,7 +245,8 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
                           batch: int, max_moves: int = 500,
                           chunk: int = 100, temperature: float = 1.0,
                           score_on_device: bool = True,
-                          mesh=None):
+                          mesh=None,
+                          incremental: bool | None = None):
     """Chunked variant of :func:`make_selfplay` for backends that kill
     long-running programs.
 
@@ -240,6 +296,8 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     import time as _time
+    if incremental is None:
+        incremental = incremental_default()
     meshlib = None
     if mesh is not None:
         from rocalphago_tpu.parallel import mesh as meshlib
@@ -249,13 +307,16 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
             raise ValueError(
                 f"batch {batch} must be a multiple of 2x the data-axis "
                 f"width ({data_width})")
-    ply = _make_ply(cfg, features, apply_a, apply_b, batch, temperature)
+    ply = _make_ply(cfg, features, apply_a, apply_b, batch,
+                    temperature, incremental=incremental)
 
-    def _segment_impl(params_a, params_b, states, rng, offset, length):
-        return _scan_plies(ply, params_a, params_b, states, rng,
-                           offset + jnp.arange(length))
+    def _segment_impl(params_a, params_b, states, caches, rng, offset,
+                      length):
+        return _scan_plies(ply, params_a, params_b, states, caches,
+                           rng, offset + jnp.arange(length))
 
-    # the chunk loop's program: the input GoState slab is DONATED so
+    # the chunk loop's program: the input GoState slab (and the
+    # incremental-encode cache slab riding with it) is DONATED so
     # pipelined dispatch (runtime.pipeline) never holds two copies of
     # the device-resident carry. The loop below owns every states
     # value it passes (fresh/sharded/copied), so donation never eats
@@ -264,7 +325,7 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
     # whole runner instead, which re-derives everything).
     segment = functools.partial(
         jax.jit, static_argnames=("length",),
-        donate_argnums=(2,))(_segment_impl)
+        donate_argnums=(2, 3))(_segment_impl)
     segment.donates_buffers = True
 
     # tiny per-segment done-reduction, dispatched WITH the segment so
@@ -321,8 +382,14 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
         its ``host_gap_frac``)."""
         states = (new_states(cfg, batch) if initial_states is None
                   else initial_states)
+        # delta-encode carry: cold per run (the runner owns it — the
+        # first segment's encodes all refresh, which IS the
+        # from-scratch read; warm reuse accrues across segments)
+        caches = init_caches(cfg, batch) if incremental else None
         if mesh is not None:
             states = meshlib.shard_batch(mesh, states)
+            if caches is not None:
+                caches = meshlib.shard_batch(mesh, caches)
             params_a = meshlib.replicate(mesh, params_a)
             params_b = meshlib.replicate(mesh, params_b)
         elif initial_states is not None:
@@ -362,9 +429,9 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
             faults.barrier("selfplay.chunk", offset)
             length = min(chunk, max_moves - offset)
             t0 = _time.monotonic()
-            states, rng, actions, live = segment(
-                params_a, params_b, states, rng, jnp.int32(offset),
-                length)
+            states, caches, rng, actions, live = segment(
+                params_a, params_b, states, caches, rng,
+                jnp.int32(offset), length)
             acts.append(actions)
             lives.append(live)
             plies = offset + length
@@ -404,11 +471,50 @@ def make_selfplay_chunked(cfg: GoConfig, features: tuple,
         return finish(states, jnp.concatenate(acts),
                       jnp.concatenate(lives))
 
+    def warmup(params_a, params_b):
+        """Compile-and-once-execute the EXACT programs a full
+        ``run()`` dispatches — the chunk-length segment, the
+        remainder segment (when ``max_moves % chunk``), the
+        done-scalar reduction and the full-shape finish program — so
+        a subsequent timed rep pays zero compiles (the headline
+        bench's untimed-warmup discipline, at a couple of segments'
+        cost instead of a whole game's; BENCH_r05's compile leak was
+        the full-rep warmup eating the budget the timed rep needed).
+        Returns the measured post-compile wall seconds of one
+        chunk-length segment (the caller's rep-time estimator)."""
+        states = new_states(cfg, batch)
+        caches = init_caches(cfg, batch) if incremental else None
+        rng = jax.random.key(0)
+        lengths = [min(chunk, max_moves)]
+        rem = max_moves % chunk
+        if max_moves > chunk and rem:
+            lengths.append(rem)
+        seg_s = None
+        for length in lengths:
+            # compile pass, then one timed pass for the estimator
+            states, caches, rng, actions, live = segment(
+                params_a, params_b, states, caches, rng,
+                jnp.int32(0), length)
+            jax.block_until_ready(actions)
+            if length == lengths[0]:
+                t0 = _time.monotonic()
+                states, caches, rng, actions, live = segment(
+                    params_a, params_b, states, caches, rng,
+                    jnp.int32(0), length)
+                jax.block_until_ready(actions)
+                seg_s = _time.monotonic() - t0
+        jax.device_get(done_flag(states))
+        jax.device_get(finish(
+            states, jnp.zeros((max_moves, batch), jnp.int32),
+            jnp.zeros((max_moves, batch), bool)).winners)
+        return seg_s
+
     # the compiled per-segment program, exposed for benchmarks (flops
     # accounting via .lower().compile().cost_analysis()) — signature
-    # (params_a, params_b, states, rng, offset, length=K). NOTE: it
-    # donates its `states` argument when executed.
+    # (params_a, params_b, states, caches, rng, offset, length=K).
+    # NOTE: it donates its `states`/`caches` arguments when executed.
     run.segment = segment
+    run.warmup = warmup
     return run
 
 

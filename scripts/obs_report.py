@@ -196,6 +196,26 @@ def render_encode(stats: dict, snap: dict) -> str:
     if compiles:
         lines.append("compiles: " + "  ".join(
             f"{k}={v}" for k, v in sorted(compiles.items())))
+    # incremental-encode hit rate (features/incremental.py): how many
+    # positions rode the delta path, and of the ladder chases that
+    # path COULD have run, how many were answered by a cached verdict
+    delta = counters.get("encode_delta_total", 0)
+    full = counters.get("encode_full_total", 0)
+    if delta:
+        reused = counters.get("encode_incr_verdicts_reused_total", 0)
+        ran = counters.get("encode_incr_chases_run_total", 0)
+        share = 100.0 * delta / max(delta + full, 1)
+        hit = 100.0 * reused / max(reused + ran, 1)
+        lines.append(
+            f"incremental encode: {delta} delta / {full} full "
+            f"({share:.0f}% delta); chase verdicts reused "
+            f"{reused}/{reused + ran} ({hit:.0f}% hit)")
+        resets = {k: v for k, v in counters.items()
+                  if k.startswith("encode_cache_resets_total")}
+        if resets:
+            lines.append("cache resets: " + "  ".join(
+                f"{k.split('reason=', 1)[-1].strip(chr(34) + '{}')}"
+                f"={v}" for k, v in sorted(resets.items())))
     spans = {p: s for p, s in stats.items()
              if p.rsplit("/", 1)[-1] == "encode"}
     if spans:
@@ -263,7 +283,12 @@ FIXTURE = [
                      'serve_rung_total{rung="policy"}': 1,
                      'dispatch_chunks_total{runner="device_mcts"}': 96,
                      'jax_compiles_total{entry="encode.batch"}': 1,
-                     'encode_positions_total{board="19"}': 128},
+                     'encode_positions_total{board="19"}': 128,
+                     "encode_delta_total": 96,
+                     "encode_full_total": 32,
+                     "encode_incr_verdicts_reused_total": 57,
+                     "encode_incr_chases_run_total": 19,
+                     'encode_cache_resets_total{reason="new_game"}': 2},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
                    'device_occupancy{runner="device_mcts"}': 0.983},
         "histograms": {"gtp_genmove_seconds": {
@@ -287,7 +312,9 @@ def selftest() -> int:
               "serve_rung_total", "gtp_genmove_seconds", "compile=1",
               "p99≲2.5", "dispatch pipeline", "98.3%",
               "encode path", "≲25000",
-              'jax_compiles_total{entry="encode.batch"}=1')
+              'jax_compiles_total{entry="encode.batch"}=1',
+              "incremental encode: 96 delta / 32 full (75% delta)",
+              "reused 57/76 (75% hit)", "new_game=2")
     missing = [n for n in needed if n not in out]
     if missing:
         print(f"obs_report selftest FAILED: missing {missing}",

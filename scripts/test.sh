@@ -40,6 +40,17 @@ cd "$(dirname "$0")/.."
 # two-phase-equivalence sweeps are @slow. See docs/PERFORMANCE.md
 # "Encode path".
 #
+# Incremental encode (tests/test_incremental.py, tier-1): the delta
+# path (features/incremental.py) is trajectory-fuzzed bit-identical
+# to the from-scratch encoder at every ply of randomized games
+# (captures, ko, a curated 9×9 ladder opening, passes, game end,
+# cross-game jumps), the chunked self-play cache carry is pinned
+# move-identical, Preprocess.advance matches state_to_tensor, and
+# warm advances are compile-free via the obs counters. The longer
+# 9×9 fuzz, the monolithic-scan identity and the direct
+# batched-encoder match are @slow. See docs/PERFORMANCE.md
+# "Incremental encode".
+#
 # Pipelined dispatch: tests/test_pipeline.py is tier-1 —
 # bit-identical pipelined-vs-sync sweeps for PUCT/gumbel search,
 # chunked self-play (lagged done-poll) and a zero iteration, the

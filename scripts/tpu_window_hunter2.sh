@@ -117,7 +117,7 @@ make_specs
 STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
 preprocess chase_xla chase_pls encode_base encode_shared4 \
 encode_shared1 encode_shared2 encode_shared8 encode_split4 \
-encode_pallas \
+encode_pallas encode_incr_seq encode_incr_batch encode_incr_selfplay \
 devmcts9 devmcts_gumbel selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
 train_trace preprocess_trace tournament headline_sized headline"
@@ -160,6 +160,15 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             encode_shared8) run encode_shared8 python benchmarks/bench_encode.py --gating shared --phase1 8 --skip-noladder --reps 2 ;;
             encode_split4)  run encode_split4  python benchmarks/bench_encode.py --gating split --phase1 4 --skip-noladder --reps 2 ;;
             encode_pallas)  run encode_pallas  python benchmarks/bench_encode.py --gating shared --phase1 4 --impl pallas --skip-noladder --reps 2 ;;
+            # encode_incr*: the PR-6 incremental-encode A/B on chip —
+            # sequential real-game-tail µs/pos (encode_incr vs
+            # encode_scratch rows), the batched-lockstep pair that
+            # decides selfplay.incremental_default for TPU, and the
+            # fused self-play segment with the cache carry threaded
+            # (ROCALPHAGO_ENCODE_INCR=1 forces the delta path)
+            encode_incr_seq)   run encode_incr_seq   python benchmarks/bench_encode.py --trajectory --traj-plies 100 --traj-skip 60 --reps 2 ;;
+            encode_incr_batch) run encode_incr_batch python benchmarks/bench_encode.py --trajectory --traj-plies 30 --traj-skip 60 --traj-batch 256 --reps 2 ;;
+            encode_incr_selfplay) run encode_incr_selfplay env ROCALPHAGO_ENCODE_INCR=1 python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;
             devmcts9)    run devmcts9    python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
             devmcts_gumbel) run devmcts_gumbel python benchmarks/bench_device_mcts.py --board 9 --sims 32 --gumbel --reps 2 ;;
             bisect)      run bisect      python scripts/tpu_crash_bisect.py --log "$LOG/bisect.jsonl" ;;

@@ -26,11 +26,27 @@ jax.config.update("jax_platforms", "cpu")
 
 # persistent XLA compile cache (same knob bench.py uses): repeat suite
 # runs skip recompiling the expensive trainer/self-play programs, which
-# dominate suite wall-time (VERDICT r2 weak #4)
-try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.expanduser("~/.cache/jax_comp_cache_tests"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-except Exception:  # noqa: BLE001 — older jax without the knobs
-    pass
+# dominate suite wall-time (VERDICT r2 weak #4).
+#
+# The cache directory is VERSIONED by the jax/jaxlib pair and the
+# virtual-device topology: a legacy unversioned directory on this
+# machine served a poisoned executable for the RL iteration program
+# (deterministically zeroed updates — `test_rl_trainer_runs_and_saves`
+# failed with the old directory and passes with a fresh one, same
+# code), and suite runs here are routinely killed by driver timeouts,
+# which can tear in-flight cache writes. Versioned directories never
+# inherit entries written by another toolchain/topology, and
+# `ROCALPHAGO_TEST_COMPILE_CACHE=0` disables the cache entirely when a
+# poisoned entry is suspected (wipe the directory to recover).
+if os.environ.get("ROCALPHAGO_TEST_COMPILE_CACHE", "1") != "0":
+    try:
+        import jaxlib
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser(
+                "~/.cache/jax_comp_cache_tests/"
+                f"jax{jax.__version__}-jaxlib{jaxlib.__version__}-d8"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # noqa: BLE001 — older jax without the knobs
+        pass

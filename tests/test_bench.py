@@ -41,11 +41,13 @@ def test_honest_metric_suffixes(monkeypatch):
     name, vs = bench._honest_metric(m, 10.0, 12.5, truncated=False,
                                     includes_compile=True,
                                     contended=False)
-    assert name == m and vs is None     # honest name, no ratio
+    # compile-polluted runs suffix too (the r5 leak published the
+    # headline name with includes_compile true)
+    assert name == m + "_compiled" and vs is None
     name, vs = bench._honest_metric(m, 10.0, 12.5, truncated=True,
                                     includes_compile=True,
                                     contended=True)
-    assert name == m + "_truncated_contended" and vs is None
+    assert name == m + "_truncated_compiled_contended" and vs is None
 
 
 def test_host_contention_reading(monkeypatch):
@@ -61,6 +63,35 @@ def test_host_contention_reading(monkeypatch):
     assert isinstance(contended, bool)
     assert isinstance(heavy, list)
     assert os.getpid() not in heavy     # never flags itself
+
+
+def test_warmup_compiles_exactly_the_timed_programs():
+    """run.warmup must leave a subsequent full rep with ZERO segment
+    compiles — the exact-program warmup discipline that keeps the
+    headline row at includes_compile: false (the r5 leak was a
+    full-rep warmup starving the timed reps instead)."""
+    import jax
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.models import CNNPolicy
+    from rocalphago_tpu.search.selfplay import make_selfplay_chunked
+
+    cfg = GoConfig(size=5)
+    net = CNNPolicy(("board", "ones"), board=5, layers=1,
+                    filters_per_layer=2)
+    # chunk deliberately not a divisor: the remainder segment is its
+    # own compile and warmup must cover it too
+    run = make_selfplay_chunked(
+        cfg, net.feature_list, net.module.apply, net.module.apply,
+        batch=4, max_moves=10, chunk=4, score_on_device=False)
+    seg_s = run.warmup(net.params, net.params)
+    assert seg_s is not None and seg_s > 0
+    n0 = run.segment._cache_size()
+    assert n0 == 2          # chunk-length + remainder programs
+    res = run(net.params, net.params, jax.random.key(1),
+              stop_when_done=True)
+    jax.device_get(res.actions)
+    assert run.segment._cache_size() == n0   # zero compile growth
 
 
 @pytest.mark.slow

@@ -42,6 +42,13 @@ def test_distributed_init_dispatches_multiprocess(monkeypatch):
     monkeypatch.setattr(
         meshlib.jax.distributed, "initialize",
         lambda *a, **k: calls.append(k))
+    # distributed_init selects gloo CPU collectives before a REAL
+    # multi-process bring-up; with initialize mocked there is no
+    # distributed client, and a leaked flag would break this process's
+    # own (single-process) CPU backend creation — mask the capability
+    # so this mocked dispatch never touches process-global jax config
+    monkeypatch.setattr(
+        meshlib, "cpu_collectives_available", lambda: False)
     meshlib.distributed_init(coordinator="host0:1234",
                              num_processes=2, process_id=1)
     assert calls and calls[0]["num_processes"] == 2
@@ -93,11 +100,20 @@ def test_non_coordinator_split_matches_coordinator(corpus, tmp_path,
     t_worker.ckpt.close()
 
 
+@pytest.mark.skipif(
+    not meshlib.cpu_collectives_available(),
+    reason="installed jaxlib ships no gloo CPU collectives — a "
+           "2-process CPU bring-up fails at the first cross-process "
+           "op with 'Multiprocess computations aren't implemented on "
+           "the CPU backend'")
 def test_two_process_distributed_dp_step(tmp_path):
     """REAL 2-process ``jax.distributed`` bring-up (VERDICT r3 #8):
     localhost coordinator, CPU backend, one local device per process.
     Both processes must complete one data-parallel step, agree on the
-    replicated result, and only the coordinator may write artifacts."""
+    replicated result, and only the coordinator may write artifacts.
+    ``distributed_init`` selects gloo TCP collectives on CPU (the
+    default CPU client has no collectives transport at all), so this
+    runs wherever the jaxlib ships gloo — capability-gated above."""
     import socket
     import subprocess
     import sys as _sys

@@ -130,6 +130,32 @@ def test_pallas_chase_under_vmap_matches_unbatched():
 
 
 @pytest.mark.slow
+def test_pallas_chase_collect_core_matches_xla():
+    """The kernel's read-core accumulation (the incremental encoder's
+    footprint seed) must match the XLA chase's ``collect_core`` cell
+    for cell — captured verdicts too, since the tuple return shares
+    one while loop."""
+    from rocalphago_tpu.features.ladders import _chase
+
+    cfg = GoConfig(size=SIZE)
+    boards, labels, preys = chase_lanes(seed=7, positions=30)
+    xla = jax.jit(jax.vmap(functools.partial(
+        _chase, cfg, depth=40, enabled=True, collect_core=True)))
+    want_cap, want_core = xla(jnp.asarray(boards),
+                              jnp.asarray(labels),
+                              jnp.asarray(preys))
+    prey_oh = (np.arange(N)[None, :] == preys[:, None])
+    got_cap, got_core = pallas_chase(
+        jnp.asarray(boards), jnp.asarray(labels), jnp.asarray(prey_oh),
+        SIZE, depth=40, interpret=True, collect_core=True)
+    np.testing.assert_array_equal(np.asarray(got_cap),
+                                  np.asarray(want_cap))
+    np.testing.assert_array_equal(np.asarray(got_core),
+                                  np.asarray(want_core))
+    assert np.asarray(want_core).any()
+
+
+@pytest.mark.slow
 def test_pallas_chase_disabled_lane_is_false():
     boards, labels, preys = chase_lanes(seed=5, positions=4)
     zeros = np.zeros((len(preys), N), bool)

@@ -190,6 +190,35 @@ def test_chunked_iteration_sharded_matches_unsharded(net):
             rtol=1e-6, err_msg=k)
 
 
+@pytest.fixture()
+def no_persistent_compile_cache():
+    """Disable the suite's persistent XLA compile cache for tests
+    whose program this machine's cache round-trips INCORRECTLY.
+
+    On the pinned toolchain (jaxlib 0.4.36 CPU), the RL iteration
+    executable comes back from the persistent compilation cache
+    producing exactly-zero parameter updates: a COLD cache run of
+    ``test_rl_trainer_runs_and_saves`` passes and writes the entry,
+    and the immediately following warm run fails — same code, same
+    seeds. The trainer's correctness is pinned by the gradient and
+    bit-identity tests either way; this fixture only takes the broken
+    serialization round-trip out of the loop for the loop-behavior
+    tests it corrupts (a fresh compile costs ~3s here)."""
+    from jax._src import compilation_cache as _cc
+
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    # the flag alone is NOT enough: the cache object initializes once
+    # per process at the first compile and later get/put calls use it
+    # without re-checking the flag — reset so the next (in-test)
+    # compile re-initializes under the disabled flag, and again on
+    # teardown so the rest of the suite gets its cache back
+    _cc.reset_cache()
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+    _cc.reset_cache()
+
+
 def make_trainer(tmp_path, net, iterations=2, save_every=1):
     cfg = RLConfig(out_dir=str(tmp_path / "rl"), learning_rate=0.01,
                    game_batch=BATCH, iterations=iterations,
@@ -201,7 +230,8 @@ def make_trainer(tmp_path, net, iterations=2, save_every=1):
     return RLTrainer(cfg, net=fresh)
 
 
-def test_rl_trainer_runs_and_saves(tmp_path, net):
+def test_rl_trainer_runs_and_saves(tmp_path, net,
+                                   no_persistent_compile_cache):
     trainer = make_trainer(tmp_path, net)
     before = jax.device_get(trainer.state.params)
     final = trainer.run()
@@ -221,7 +251,8 @@ def test_rl_trainer_runs_and_saves(tmp_path, net):
     assert os.path.exists(os.path.join(out, "weights.00002.flax.msgpack"))
 
 
-def test_rl_trainer_resumes(tmp_path, net):
+def test_rl_trainer_resumes(tmp_path, net,
+                            no_persistent_compile_cache):
     trainer = make_trainer(tmp_path, net, iterations=2)
     trainer.run()
     trainer.ckpt.close()
