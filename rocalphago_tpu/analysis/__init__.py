@@ -1,0 +1,35 @@
+"""jaxlint — JAX-aware static analysis for this codebase.
+
+The stack's correctness conventions are mostly *invisible to Python*:
+buffer donation (a donated array must never be read again — PR 4's
+`donates_buffers` discipline), jit-boundary purity (no host syncs or
+Python control flow on tracers inside compiled bodies), PRNG key
+hygiene (never consume the same key twice), retrace discipline
+(static arguments must be hashable and low-cardinality), and the
+documented observability/resilience inventories (every metric, span,
+fault barrier and ``ROCALPHAGO_*`` env knob is contract, not
+incidental string). Each of these has cost a debugging cycle when
+violated; none is caught by the type system or the test suite until
+the bad path actually runs.
+
+This package proves them *before* code runs: an AST-based rule
+framework (:mod:`.core`), five rule families (:mod:`.rules`), a
+committed baseline for grandfathered findings (:mod:`.baseline`),
+per-line suppression comments, and text/JSON reporters
+(:mod:`.reporters`). ``scripts/lint.py`` is the CLI; the self-lint
+test in ``tests/test_jaxlint.py`` keeps the shipped tree clean in
+tier-1. See docs/STATIC_ANALYSIS.md for the rule catalog and the
+suppression/baseline workflow.
+
+Stdlib-only by design (``ast`` + ``re`` + ``json``): the linter must
+run anywhere the repo checks out, including hosts without jax.
+"""
+
+from rocalphago_tpu.analysis.core import (  # noqa: F401
+    Finding, LintContext, ModuleInfo, all_rule_ids, lint_source,
+    module_rule, project_rule, run_lint,
+)
+from rocalphago_tpu.analysis.config import LintConfig, load_config  # noqa: F401
+from rocalphago_tpu.analysis.baseline import (  # noqa: F401
+    Baseline, load_baseline, write_baseline,
+)

@@ -1,0 +1,102 @@
+"""jaxlint baseline: grandfathered findings, committed to the repo.
+
+The baseline is how the linter lands on an existing codebase without
+a flag day: every finding triaged as *intentional* is recorded here
+(with a one-line justification) and stops failing the build; any NEW
+finding still fails. Entries match by fingerprint — ``rule + path +
+stripped source line`` — so pure line-number drift (code added above)
+does not invalidate them, while editing the offending line itself
+resurfaces the finding for re-triage. Matching is count-aware: two
+identical offending lines in one file need two entries.
+
+File shape (``.jaxlint-baseline.json``, sorted, one entry per line
+for reviewable diffs):
+
+    {"version": 1,
+     "findings": [{"rule": ..., "path": ..., "snippet": ...,
+                   "note": "why this is intentional"}, ...]}
+
+Workflow: ``scripts/lint.py --update-baseline`` rewrites the file
+from the current findings, preserving notes of entries that still
+match; hand-edit the ``note`` fields after. A baseline entry whose
+finding no longer exists is dropped on update (and reported as stale
+by ``--check`` output so the file cannot silently rot).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+from rocalphago_tpu.analysis.core import Finding
+
+VERSION = 1
+
+
+class Baseline:
+    """Multiset of grandfathered fingerprints + their notes."""
+
+    def __init__(self, entries: list[dict] | None = None):
+        self.entries = entries or []
+        self._counts = collections.Counter(
+            self._fp(e) for e in self.entries)
+
+    @staticmethod
+    def _fp(entry: dict) -> str:
+        return (f"{entry.get('rule', '')}::{entry.get('path', '')}::"
+                f"{entry.get('snippet', '')}")
+
+    def partition(self, findings: list[Finding]):
+        """-> (new, grandfathered, stale_entries). Count-aware: each
+        baseline entry absorbs at most one finding."""
+        budget = collections.Counter(self._counts)
+        new, old = [], []
+        for f in findings:
+            fp = f.fingerprint()
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        stale = []
+        for e in self.entries:
+            fp = self._fp(e)
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                stale.append(e)
+        return new, old, stale
+
+    def note_for(self, f: Finding) -> str:
+        fp = f.fingerprint()
+        for e in self.entries:
+            if self._fp(e) == fp:
+                return e.get("note", "")
+        return ""
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Baseline(list(data.get("findings", [])))
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   previous: Baseline | None = None) -> dict:
+    """Serialize ``findings`` as the new baseline, carrying notes
+    forward from ``previous`` where fingerprints still match."""
+    entries = []
+    for f in sorted(findings):
+        note = previous.note_for(f) if previous is not None else ""
+        entries.append({"rule": f.rule, "path": f.path,
+                        "snippet": f.snippet,
+                        "message": f.message, "note": note})
+    payload = {"version": VERSION, "findings": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return payload

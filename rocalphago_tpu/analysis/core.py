@@ -1,0 +1,267 @@
+"""jaxlint core: findings, module model, rule registry, driver.
+
+A *rule* is a function registered under a stable kebab-case id.
+Module rules run once per parsed file; project rules run once over
+the whole file set (the inventory-drift family needs cross-file
+state — every metric produced anywhere vs one documented table).
+
+Suppression syntax (same-line comment, documented in
+docs/STATIC_ANALYSIS.md):
+
+* ``# jaxlint: disable=rule-id`` — suppress that rule on this line
+  (comma-separate several ids);
+* ``# jaxlint: disable`` — suppress every rule on this line;
+* ``# jaxlint: skip-file`` — anywhere in the file, drops the whole
+  file from analysis (reserved for vendored/generated code).
+
+Suppressions anchor on the line a finding is REPORTED at (the
+statement's first line for multi-line statements).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+# --------------------------------------------------------------- findings
+
+SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|skip-file)\b(?:\s*=\s*([A-Za-z0-9_,\- ]+))?")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint hit. ``snippet`` (the stripped source line) joins the
+    fingerprint so baseline entries survive line-number drift."""
+
+    path: str          # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+    snippet: str = ""
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.path}::{self.snippet}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "snippet": self.snippet}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ----------------------------------------------------------- module model
+
+class ModuleInfo:
+    """One parsed source file: AST + per-line suppression map."""
+
+    def __init__(self, rel: str, source: str, path: str | None = None):
+        self.rel = rel.replace(os.sep, "/")
+        self.path = path or rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)  # SyntaxError handled by driver
+        self.suppressions: dict[int, set[str]] = {}
+        self.skip_file = False
+        for i, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            if m.group(1) == "skip-file":
+                self.skip_file = True
+            elif m.group(2):
+                ids = {t.strip() for t in m.group(2).split(",") if t.strip()}
+                self.suppressions.setdefault(i, set()).update(ids)
+            else:
+                self.suppressions.setdefault(i, set()).add("*")
+
+    def line(self, n: int) -> str:
+        if 1 <= n <= len(self.lines):
+            return self.lines[n - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", None) or int(node)
+        return Finding(path=self.rel, line=line, rule=rule,
+                       message=message, snippet=self.line(line))
+
+    def suppressed(self, f: Finding) -> bool:
+        ids = self.suppressions.get(f.line)
+        return bool(ids) and ("*" in ids or f.rule in ids)
+
+
+# ----------------------------------------------------------- rule registry
+
+#: rule id -> (callable, one-line summary). Module rules take
+#: ``(module, ctx)``; project rules take ``(ctx)`` and read
+#: ``ctx.modules``.
+MODULE_RULES: dict = {}
+PROJECT_RULES: dict = {}
+
+
+def module_rule(rule_id: str, summary: str):
+    def deco(fn):
+        assert rule_id not in MODULE_RULES and rule_id not in PROJECT_RULES
+        MODULE_RULES[rule_id] = (fn, summary)
+        fn.rule_id = rule_id
+        return fn
+    return deco
+
+
+def project_rule(rule_id: str, summary: str):
+    def deco(fn):
+        assert rule_id not in MODULE_RULES and rule_id not in PROJECT_RULES
+        PROJECT_RULES[rule_id] = (fn, summary)
+        fn.rule_id = rule_id
+        return fn
+    return deco
+
+
+def _load_rules() -> None:
+    """Import the rule modules (registration is an import side
+    effect); idempotent."""
+    from rocalphago_tpu.analysis import rules  # noqa: F401
+
+
+def all_rule_ids() -> list[str]:
+    _load_rules()
+    return sorted(list(MODULE_RULES) + list(PROJECT_RULES))
+
+
+def rule_catalog() -> dict[str, str]:
+    """id -> one-line summary, for ``lint.py --list-rules`` and the
+    doc table."""
+    _load_rules()
+    cat = {rid: s for rid, (_, s) in MODULE_RULES.items()}
+    cat.update({rid: s for rid, (_, s) in PROJECT_RULES.items()})
+    return dict(sorted(cat.items()))
+
+
+# ----------------------------------------------------------------- driver
+
+class LintContext:
+    """Shared state for one lint run: config, the parsed modules, and
+    a scratch cache for cross-module indexes (donation registry, jit
+    map) built lazily by the rule modules."""
+
+    def __init__(self, root: str, config, modules: list[ModuleInfo]):
+        self.root = root
+        self.config = config
+        self.modules = modules
+        self.cache: dict = {}
+
+    def read_doc(self, rel: str) -> str | None:
+        """Repo doc contents (None when absent); inventory rules diff
+        against these."""
+        p = os.path.join(self.root, rel)
+        try:
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+def discover_files(root: str, config) -> list[str]:
+    """Repo-relative paths of the python files under
+    ``config.include`` minus ``config.exclude`` prefixes."""
+    out: list[str] = []
+    for entry in config.include:
+        full = os.path.join(root, entry)
+        if os.path.isfile(full):
+            out.append(entry)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
+    excl = tuple(config.exclude)
+    return [p for p in sorted(set(out))
+            if not any(p == e or p.startswith(e.rstrip("/") + "/")
+                       for e in excl)]
+
+
+def parse_modules(root: str, rels: list[str]):
+    """-> (modules, parse_findings). A file that does not parse is a
+    finding, not a crash — the lint must degrade per-file."""
+    modules, findings = [], []
+    for rel in rels:
+        full = os.path.join(root, rel)
+        try:
+            with open(full, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(ModuleInfo(rel, src, path=full))
+        except SyntaxError as e:
+            findings.append(Finding(
+                path=rel, line=int(e.lineno or 1), rule="parse-error",
+                message=f"file does not parse: {e.msg}"))
+        except OSError as e:
+            findings.append(Finding(
+                path=rel, line=1, rule="parse-error",
+                message=f"unreadable: {e}"))
+    return modules, findings
+
+
+def _enabled(rule_id: str, config, only) -> bool:
+    if only is not None and rule_id not in only:
+        return False
+    return rule_id not in set(config.disable)
+
+
+def run_lint(root: str, config, only: set[str] | None = None
+             ) -> list[Finding]:
+    """Full run: discover → parse → rules → suppression filter.
+    Returns ALL findings (baselining is the caller's concern — see
+    :mod:`.baseline`), sorted by path/line/rule."""
+    _load_rules()
+    rels = discover_files(root, config)
+    modules, findings = parse_modules(root, rels)
+    modules = [m for m in modules if not m.skip_file]
+    ctx = LintContext(root, config, modules)
+    for mod in modules:
+        for rule_id, (fn, _) in MODULE_RULES.items():
+            if _enabled(rule_id, config, only):
+                findings.extend(f for f in fn(mod, ctx)
+                                if not mod.suppressed(f))
+    by_rel = {m.rel: m for m in modules}
+    for rule_id, (fn, _) in PROJECT_RULES.items():
+        if _enabled(rule_id, config, only):
+            for f in fn(ctx):
+                mod = by_rel.get(f.path)
+                if mod is None or not mod.suppressed(f):
+                    findings.append(f)
+    return sorted(findings)
+
+
+def lint_source(source: str, rel: str = "<fixture>.py",
+                rules: set[str] | None = None, config=None,
+                root: str = ".", docs: dict[str, str] | None = None
+                ) -> list[Finding]:
+    """Lint one in-memory source string — the fixture-test entry
+    point. ``docs`` maps repo-relative doc paths to contents for the
+    inventory rules; the default (no docs) makes the doc-sync rules
+    no-ops rather than diffing a fixture against the real repo docs."""
+    from rocalphago_tpu.analysis.config import LintConfig
+    _load_rules()
+    config = config or LintConfig()
+    mod = ModuleInfo(rel, source)
+    if mod.skip_file:
+        return []
+    ctx = LintContext(root, config, [mod])
+    ctx.read_doc = lambda rel_, _d=(docs or {}): _d.get(rel_)  # type: ignore
+    findings: list[Finding] = []
+    for rule_id, (fn, _) in MODULE_RULES.items():
+        if _enabled(rule_id, config, rules):
+            findings.extend(f for f in fn(mod, ctx)
+                            if not mod.suppressed(f))
+    for rule_id, (fn, _) in PROJECT_RULES.items():
+        if _enabled(rule_id, config, rules):
+            findings.extend(f for f in fn(ctx)
+                            if f.path != mod.rel or not mod.suppressed(f))
+    return sorted(findings)

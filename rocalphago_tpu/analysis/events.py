@@ -1,0 +1,177 @@
+"""Evaluation-order event streams per function scope.
+
+The donation-reuse and PRNG-discipline rules are *ordering* rules:
+"after this call, the next touch of ``x`` decides". Python's AST
+walk order is not evaluation order (``carry = f(carry)`` evaluates
+the RHS — including the argument read — before the store), so this
+module flattens each scope into a list of ``read`` / ``write`` /
+``call`` events in evaluation order, with loop extents recorded so a
+rule can reason about "the next iteration touches it again".
+
+Approximations (deliberate, baseline-absorbable): ``if``/``else``
+arms are concatenated linearly; ``try`` flows linearly; nested
+function bodies are separate scopes (a closure read is not an event
+in the enclosing scope); only ``Name`` targets produce ``write``
+events (attribute/subscript stores read their base instead).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+@dataclasses.dataclass
+class Event:
+    kind: str                 # "read" | "write" | "call"
+    name: str | None          # read/write target
+    node: ast.AST             # anchor for findings
+    call: ast.Call | None = None   # for kind == "call"
+    src: str | None = None    # write: dotted callee of a direct-call RHS
+
+
+@dataclasses.dataclass
+class ScopeEvents:
+    scope: ast.AST            # FunctionDef or Module
+    events: list
+    loops: list               # (start_idx, end_idx) per loop, any order
+
+    def enclosing_loop(self, i: int):
+        """Innermost loop range containing event index ``i``."""
+        best = None
+        for s, e in self.loops:
+            if s <= i < e and (best is None or (e - s) < (best[1] - best[0])):
+                best = (s, e)
+        return best
+
+
+class _Walker:
+    def __init__(self):
+        self.events: list = []
+        self.loops: list = []
+
+    # -- expressions (reads, calls) ----------------------------------
+    def expr(self, node) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                self.events.append(Event("read", node.id, node))
+            return
+        if isinstance(node, ast.Call):
+            self.expr(node.func)
+            for a in node.args:
+                self.expr(a.value if isinstance(a, ast.Starred) else a)
+            for k in node.keywords:
+                self.expr(k.value)
+            self.events.append(Event("call", None, node, call=node))
+            return
+        if isinstance(node, (ast.Lambda,)):
+            return  # separate scope
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                self.expr(gen.iter)  # iterables evaluate in this scope
+            return  # element exprs run in the comprehension scope
+        for child in ast.iter_child_nodes(node):
+            self.expr(child)
+
+    # -- statements ---------------------------------------------------
+    def write_target(self, tgt, src: str | None) -> None:
+        if isinstance(tgt, ast.Name):
+            self.events.append(Event("write", tgt.id, tgt, src=src))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self.write_target(e, src)
+        elif isinstance(tgt, ast.Starred):
+            self.write_target(tgt.value, src)
+        else:  # attribute/subscript store: base object is read
+            self.expr(getattr(tgt, "value", None))
+            self.expr(getattr(tgt, "slice", None))
+
+    def stmts(self, body) -> None:
+        for st in body:
+            self.stmt(st)
+
+    def stmt(self, st) -> None:
+        if isinstance(st, ast.Assign):
+            self.expr(st.value)
+            src = dotted_callee(st.value)
+            for tgt in st.targets:
+                self.write_target(tgt, src)
+        elif isinstance(st, ast.AugAssign):
+            if isinstance(st.target, ast.Name):
+                self.events.append(Event("read", st.target.id, st.target))
+            self.expr(st.value)
+            self.write_target(st.target, None)
+        elif isinstance(st, ast.AnnAssign):
+            self.expr(st.value)
+            if st.value is not None:
+                self.write_target(st.target, dotted_callee(st.value))
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self.expr(st.iter)
+            start = len(self.events)
+            self.write_target(st.target, None)
+            self.stmts(st.body)
+            self.loops.append((start, len(self.events)))
+            self.stmts(st.orelse)
+        elif isinstance(st, ast.While):
+            start = len(self.events)
+            self.expr(st.test)
+            self.stmts(st.body)
+            self.loops.append((start, len(self.events)))
+            self.stmts(st.orelse)
+        elif isinstance(st, ast.If):
+            self.expr(st.test)
+            self.stmts(st.body)
+            self.stmts(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.write_target(item.optional_vars, None)
+            self.stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self.stmts(st.body)
+            for h in st.handlers:
+                self.stmts(h.body)
+            self.stmts(st.orelse)
+            self.stmts(st.finalbody)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope
+        elif isinstance(st, (ast.Return, ast.Expr, ast.Raise,
+                             ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(st):
+                self.expr(child)
+        elif isinstance(st, (ast.Import, ast.ImportFrom, ast.Pass,
+                             ast.Break, ast.Continue, ast.Global,
+                             ast.Nonlocal)):
+            return
+        else:
+            for child in ast.iter_child_nodes(st):
+                self.expr(child)
+
+
+def dotted_callee(value) -> str | None:
+    from rocalphago_tpu.analysis.jaxmodel import dotted
+    if isinstance(value, ast.Call):
+        return dotted(value.func)
+    return None
+
+
+def scope_events(scope) -> ScopeEvents:
+    """Flatten one scope (FunctionDef body or Module body) into
+    evaluation-order events."""
+    w = _Walker()
+    w.stmts(scope.body)
+    return ScopeEvents(scope=scope, events=w.events, loops=w.loops)
+
+
+def iter_scopes(tree):
+    """Module scope plus every function def (nested included — each
+    analyzed as its own scope)."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

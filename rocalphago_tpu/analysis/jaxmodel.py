@@ -1,0 +1,312 @@
+"""Shared AST model: how THIS codebase expresses jit and donation.
+
+The rule families all need the same three facts about a module:
+
+* which function defs are jit-compiled (decorator forms
+  ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``, call forms
+  ``jax.jit(fn, ...)`` / ``functools.partial(jax.jit, ...)(fn)``,
+  and ``jaxobs.track("entry", fn)`` wrappers);
+* each jitted def's *static* parameters (``static_argnames`` /
+  ``static_argnums`` resolved against the def's signature);
+* which callables DONATE input buffers, and at which positions —
+  ``donate_argnums`` on any jit form, plus the repo convention
+  ``fn.donates_buffers = True`` (see runtime/retries.py).
+
+Everything here is a heuristic over names ("a call whose dotted path
+ends in ``jit``"), which is the right trade for a project lint: the
+codebase controls its own idiom, and the baseline absorbs the rare
+mismatch.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+PARTIAL_NAMES = ("functools.partial", "partial")
+TRACK_SUFFIX = ("track",)
+
+
+def dotted(node) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(name: str | None) -> str | None:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _const_str_tuple(node) -> tuple:
+    """Literal ``("a", "b")`` / ``"a"`` -> names; () otherwise."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _const_int_tuple(node) -> tuple:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class JitSpec:
+    """Options attached to one jit wrapper expression."""
+    static_names: tuple = ()
+    static_nums: tuple = ()
+    donate_nums: tuple = ()
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_nums)
+
+
+def jit_wrapper_spec(call: ast.Call) -> JitSpec | None:
+    """``call`` IS a jit wrapper constructor?  Handles ``jax.jit(...)``
+    and ``functools.partial(jax.jit, ...)``; returns its spec."""
+    name = dotted(call.func)
+    if name in JIT_NAMES:
+        pass
+    elif name in PARTIAL_NAMES and call.args \
+            and dotted(call.args[0]) in JIT_NAMES:
+        pass
+    else:
+        return None
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    return JitSpec(
+        static_names=_const_str_tuple(kw.get("static_argnames")),
+        static_nums=_const_int_tuple(kw.get("static_argnums")),
+        donate_nums=_const_int_tuple(kw.get("donate_argnums")))
+
+
+def positional_params(fndef) -> tuple:
+    a = fndef.args
+    return tuple(p.arg for p in (*a.posonlyargs, *a.args))
+
+
+def all_params(fndef) -> tuple:
+    a = fndef.args
+    return tuple(p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs))
+
+
+def static_param_names(fndef, spec: JitSpec) -> frozenset:
+    pos = positional_params(fndef)
+    nums = {pos[i] for i in spec.static_nums if 0 <= i < len(pos)}
+    return frozenset(set(spec.static_names) | nums)
+
+
+@dataclasses.dataclass
+class DonatingCallable:
+    """A callable known (or declared by convention) to donate."""
+    name: str                 # simple name (last attribute segment)
+    donate_nums: tuple | None  # None = convention-only, positions unknown
+    params: tuple = ()        # underlying def's positional params, if known
+    line: int = 0
+    module: str = ""
+    #: declared via `X.donates_buffers = True` — the repo's explicit
+    #: cross-module contract (runtime/retries.py). Only these entries
+    #: propagate beyond their own module; jit-inferred donation stays
+    #: module-local (bare names like `iteration` collide otherwise).
+    convention: bool = False
+
+
+class ModuleJaxIndex:
+    """Per-module index of jitted defs and donating callables.
+    Build once via :func:`index_module` (cached on the module)."""
+
+    def __init__(self):
+        # id(fndef) -> (fndef, JitSpec)
+        self.jitted: dict[int, tuple] = {}
+        # simple callable name -> DonatingCallable
+        self.donating: dict[str, DonatingCallable] = {}
+        # def name -> fndef (module/class/nested, last def wins)
+        self.defs: dict[str, ast.AST] = {}
+
+    def jit_spec_for_def(self, fndef) -> JitSpec | None:
+        hit = self.jitted.get(id(fndef))
+        return hit[1] if hit else None
+
+    def _mark_jitted(self, fndef, spec: JitSpec) -> None:
+        prev = self.jitted.get(id(fndef))
+        if prev:  # merge: decorator + call-site info
+            p = prev[1]
+            spec = JitSpec(
+                static_names=tuple(set(p.static_names)
+                                   | set(spec.static_names)),
+                static_nums=tuple(set(p.static_nums)
+                                  | set(spec.static_nums)),
+                donate_nums=tuple(set(p.donate_nums)
+                                  | set(spec.donate_nums)))
+        self.jitted[id(fndef)] = (fndef, spec)
+        if spec.donates:
+            self._mark_donating(fndef.name, spec.donate_nums,
+                                positional_params(fndef), fndef.lineno)
+
+    def _mark_donating(self, name, nums, params, line,
+                       convention: bool = False) -> None:
+        prev = self.donating.get(name)
+        if prev and prev.donate_nums and not nums:
+            prev.convention = prev.convention or convention
+            return  # keep the position-bearing entry
+        self.donating[name] = DonatingCallable(
+            name=name, donate_nums=tuple(nums) if nums else
+            (prev.donate_nums if prev else None),
+            params=params or (prev.params if prev else ()),
+            line=line,
+            convention=convention or (prev.convention if prev
+                                      else False))
+
+
+def index_module(mod) -> ModuleJaxIndex:
+    """Build (or return the cached) :class:`ModuleJaxIndex` for a
+    ``core.ModuleInfo``."""
+    cached = getattr(mod, "_jax_index", None)
+    if cached is not None:
+        return cached
+    idx = ModuleJaxIndex()
+    tree = mod.tree
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            idx.defs[node.name] = node
+
+    for node in ast.walk(tree):
+        # decorator forms
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                spec = (jit_wrapper_spec(dec)
+                        if isinstance(dec, ast.Call) else None)
+                if spec is None and dotted(dec) in JIT_NAMES:
+                    spec = JitSpec()
+                if spec is not None:
+                    idx._mark_jitted(node, spec)
+        # call forms
+        if isinstance(node, ast.Call):
+            spec = jit_wrapper_spec(node)
+            if spec is not None and node.args:
+                # jax.jit(fn, ...) — fn may be a def in this module
+                target = dotted(node.args[0])
+                fndef = idx.defs.get(last_segment(target) or "")
+                if fndef is not None and target not in JIT_NAMES:
+                    idx._mark_jitted(fndef, spec)
+            # functools.partial(jax.jit, ...)(fn)
+            if isinstance(node.func, ast.Call):
+                inner = jit_wrapper_spec(node.func)
+                if inner is not None and node.args:
+                    fndef = idx.defs.get(
+                        last_segment(dotted(node.args[0])) or "")
+                    if fndef is not None:
+                        idx._mark_jitted(fndef, inner)
+            # jaxobs.track("entry", fn)
+            if last_segment(dotted(node.func)) in TRACK_SUFFIX \
+                    and len(node.args) >= 2:
+                fndef = idx.defs.get(
+                    last_segment(dotted(node.args[1])) or "")
+                if fndef is not None:
+                    idx._mark_jitted(fndef, JitSpec())
+
+    # assignment forms: name = <jit wrapper>(fn) / X.donates_buffers = True
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        # X.donates_buffers = True  (the runtime/retries.py convention)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) \
+                    and tgt.attr == "donates_buffers" \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is True:
+                owner = last_segment(dotted(tgt.value))
+                if owner:
+                    fndef = idx.defs.get(owner)
+                    spec = idx.jit_spec_for_def(fndef) \
+                        if fndef is not None else None
+                    idx._mark_donating(
+                        owner,
+                        spec.donate_nums if spec else None,
+                        positional_params(fndef) if fndef is not None
+                        else (), node.lineno, convention=True)
+        # name = jax.jit(fn, donate_argnums=...) and partial forms;
+        # jaxobs.track("entry", jax.jit(...)) wrappers delegate
+        # attributes, so unwrap them to the inner jit expression
+        value = node.value
+        if isinstance(value, ast.Call) \
+                and last_segment(dotted(value.func)) in TRACK_SUFFIX \
+                and len(value.args) >= 2 \
+                and isinstance(value.args[1], ast.Call):
+            value = value.args[1]
+        if isinstance(value, ast.Call):
+            spec = jit_wrapper_spec(value)
+            applied = None
+            if spec is not None and value.args:
+                applied = spec  # jax.jit(fn, ...) called with the fn
+            elif isinstance(value.func, ast.Call):
+                applied = jit_wrapper_spec(value.func)
+            if applied is not None and applied.donates:
+                for tgt in node.targets:
+                    name = last_segment(dotted(tgt))
+                    if name:
+                        fndef = idx.defs.get(
+                            last_segment(dotted(value.args[0]))
+                            or "") if value.args else None
+                        idx._mark_donating(
+                            name, applied.donate_nums,
+                            positional_params(fndef)
+                            if fndef is not None else (), node.lineno)
+
+    mod._jax_index = idx
+    return idx
+
+
+def project_donating_index(ctx) -> dict:
+    """Union of the CONVENTION-marked donating callables across
+    modules, keyed by simple name — chunk programs are donated where
+    they are BUILT but called where they are USED (tests, other
+    packages). Only ``donates_buffers = True`` declarations cross
+    module boundaries: that flag is the repo's explicit contract,
+    while jit-inferred donation stays module-local (bare names like
+    ``iteration`` exist in several trainers with different specs)."""
+    cached = ctx.cache.get("donating")
+    if cached is not None:
+        return cached
+    merged: dict[str, DonatingCallable] = {}
+    for mod in ctx.modules:
+        idx = index_module(mod)
+        for name, d in idx.donating.items():
+            if not d.convention:
+                continue
+            prev = merged.get(name)
+            if prev is None or (prev.donate_nums is None
+                                and d.donate_nums is not None):
+                d.module = mod.rel
+                merged[name] = d
+    ctx.cache["donating"] = merged
+    return merged
+
+
+def donating_for_module(mod, ctx) -> dict:
+    """The donation registry a module's call sites resolve against:
+    cross-module convention entries, overridden by the module's own
+    index, with non-donating LOCAL defs shadowing colliding names."""
+    idx = index_module(mod)
+    donating = dict(project_donating_index(ctx))
+    donating.update(idx.donating)
+    for name in list(donating):
+        if name in idx.defs and name not in idx.donating:
+            del donating[name]
+    return donating

@@ -1,0 +1,44 @@
+"""jaxlint output: text for humans, JSON for tooling.
+
+The text reporter groups by file and marks grandfathered findings
+with ``(baselined)`` so a full run still shows the debt without
+failing on it; the JSON reporter is one stable object (findings +
+partition counts) for CI artifacts and the tests.
+"""
+
+from __future__ import annotations
+
+import json
+
+from rocalphago_tpu.analysis.core import Finding
+
+
+def render_text(new: list[Finding], baselined: list[Finding],
+                stale_entries: list[dict], verbose: bool = False) -> str:
+    out = []
+    flagged = {id(f) for f in new}
+    for f in sorted(new + baselined):
+        tag = "" if id(f) in flagged else "  (baselined)"
+        if id(f) in flagged or verbose:
+            out.append(f.render() + tag)
+    for e in stale_entries:
+        out.append(f"{e.get('path', '?')}: [baseline-stale] baselined "
+                   f"finding no longer occurs: [{e.get('rule')}] "
+                   f"{e.get('snippet', '')!r} — remove it (or run "
+                   "--update-baseline)")
+    n_stale = len(stale_entries)
+    out.append(f"jaxlint: {len(new)} new finding(s), "
+               f"{len(baselined)} baselined, {n_stale} stale baseline "
+               "entr" + ("y" if n_stale == 1 else "ies"))
+    return "\n".join(out)
+
+
+def render_json(new: list[Finding], baselined: list[Finding],
+                stale_entries: list[dict]) -> str:
+    return json.dumps({
+        "new": [f.to_dict() for f in sorted(new)],
+        "baselined": [f.to_dict() for f in sorted(baselined)],
+        "stale_baseline_entries": stale_entries,
+        "counts": {"new": len(new), "baselined": len(baselined),
+                   "stale": len(stale_entries)},
+    }, indent=1, sort_keys=False)

@@ -1,0 +1,7 @@
+"""jaxlint rule families. Importing this package registers every
+rule with :mod:`rocalphago_tpu.analysis.core`; the catalog lives in
+docs/STATIC_ANALYSIS.md."""
+
+from rocalphago_tpu.analysis.rules import (  # noqa: F401
+    donation, inventory, prng, retrace, tracer,
+)

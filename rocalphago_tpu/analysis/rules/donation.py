@@ -1,0 +1,177 @@
+"""Donation safety (PR 4's discipline, statically enforced).
+
+``donation-reuse`` — a buffer passed at a donated position is dead
+the moment the call is dispatched: XLA may already have reused its
+memory, so ANY later read computes on garbage. The legal idiom is
+the carry pattern (``carry = f(..., carry, ...)``): the store on the
+same statement re-binds the name to the *result*, which is a live
+buffer. The rule flattens each scope to evaluation-order events and
+checks, for every donated ``Name`` argument, that the next touch of
+that name is a write — including around the back edge of an
+enclosing loop (``f(x)`` alone in a loop donates the same buffer
+twice on iteration 2, which XLA rejects at best and corrupts at
+worst).
+
+``retry-wraps-donating`` — ``runtime.retries`` refuses donating
+callables at runtime (wrap time); this rule proves it at lint time,
+including the decorator form and one-shot ``retry_call``. A failed
+dispatch may already have invalidated the donated inputs, so the
+retry would re-dispatch garbage; wrap the enclosing iteration
+instead (see runtime/retries.py module docstring).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rocalphago_tpu.analysis.core import module_rule
+from rocalphago_tpu.analysis.events import iter_scopes, scope_events
+from rocalphago_tpu.analysis.jaxmodel import (
+    donating_for_module, dotted, index_module, jit_wrapper_spec,
+    last_segment,
+)
+
+RETRY_NAMES = ("retry", "retry_call")
+
+
+def _donated_arg_names(call: ast.Call, don) -> list:
+    """Names passed at donated positions of ``call``; positions may
+    map through keywords when the underlying def's params are known."""
+    out = []
+    if don.donate_nums is None:
+        return out
+    for i in don.donate_nums:
+        if i < len(call.args):
+            a = call.args[i]
+            if isinstance(a, ast.Name):
+                out.append(a.id)
+        elif don.params and i < len(don.params):
+            pname = don.params[i]
+            for k in call.keywords:
+                if k.arg == pname and isinstance(k.value, ast.Name):
+                    out.append(k.value.id)
+    return out
+
+
+def _resolve_donating(call: ast.Call, donating: dict):
+    """The donation info for this call's callee, if any. Matches by
+    the callee's last dotted segment (chunk programs are attributes:
+    ``search.run_sims_donated``), or an inline jit wrapper with
+    ``donate_argnums`` (``jax.jit(f, donate_argnums=(0,))(x)``)."""
+    name = last_segment(dotted(call.func))
+    if name in donating:
+        return donating[name]
+    if isinstance(call.func, ast.Call):
+        spec = jit_wrapper_spec(call.func)
+        if spec is not None and spec.donates:
+            from rocalphago_tpu.analysis.jaxmodel import DonatingCallable
+            return DonatingCallable(name="<inline jit>",
+                                    donate_nums=spec.donate_nums)
+    return None
+
+
+@module_rule(
+    "donation-reuse",
+    "a buffer passed at a donated position must not be read again")
+def donation_reuse(mod, ctx):
+    findings = []
+    donating = donating_for_module(mod, ctx)
+    for scope in iter_scopes(mod.tree):
+        ev = scope_events(scope)
+        for i, e in enumerate(ev.events):
+            if e.kind != "call":
+                continue
+            don = _resolve_donating(e.call, donating)
+            if don is None:
+                continue
+            for name in _donated_arg_names(e.call, don):
+                f = _next_touch_violation(ev, i, name, don)
+                if f is not None:
+                    node, msg = f
+                    findings.append(mod.finding("donation-reuse",
+                                                node, msg))
+    return findings
+
+
+def _next_touch_violation(ev, i: int, name: str, don):
+    """After the donate at event ``i``, is the next touch of ``name``
+    a read?  Checks forward to the enclosing loop end (or scope end),
+    then around the loop back edge."""
+    loop = ev.enclosing_loop(i)
+    end = loop[1] if loop else len(ev.events)
+    for j in range(i + 1, end):
+        t = ev.events[j]
+        if t.name == name:
+            if t.kind == "read":
+                return (t.node,
+                        f"'{name}' read after being DONATED to "
+                        f"{don.name} (donate position) — the buffer "
+                        "may already be invalid; re-bind it from the "
+                        "call result first")
+            return None  # write re-binds: cleared
+    if loop:
+        for j in range(loop[0], i + 1):
+            t = ev.events[j]
+            if t.name == name:
+                if t.kind == "read":
+                    return (t.node,
+                            f"'{name}' donated to {don.name} inside a "
+                            "loop without re-binding — the next "
+                            "iteration reads/donates a dead buffer")
+                return None
+        # donate past loop end with no touch inside: fall through
+        for j in range(end, len(ev.events)):
+            t = ev.events[j]
+            if t.name == name:
+                if t.kind == "read":
+                    return (t.node,
+                            f"'{name}' read after being DONATED to "
+                            f"{don.name} — the buffer may already be "
+                            "invalid")
+                return None
+    return None
+
+
+@module_rule(
+    "retry-wraps-donating",
+    "retry/retry_call must never wrap a donating callable")
+def retry_wraps_donating(mod, ctx):
+    findings = []
+    known = set(donating_for_module(mod, ctx))
+
+    def is_donating_ref(node) -> bool:
+        return last_segment(dotted(node)) in known
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            callee = last_segment(dotted(node.func))
+            # retry_call(fn, ...) — one-shot form
+            if callee == "retry_call" and node.args \
+                    and is_donating_ref(node.args[0]):
+                findings.append(mod.finding(
+                    "retry-wraps-donating", node,
+                    "retry_call on a donating callable "
+                    f"({dotted(node.args[0])}) — a failed dispatch "
+                    "may already have invalidated the donated "
+                    "inputs; retry the enclosing iteration instead"))
+            # retry(...)(fn) — decorator-call form
+            if isinstance(node.func, ast.Call) \
+                    and last_segment(dotted(node.func.func)) == "retry" \
+                    and node.args and is_donating_ref(node.args[0]):
+                findings.append(mod.finding(
+                    "retry-wraps-donating", node,
+                    "retry(...) wraps a donating callable "
+                    f"({dotted(node.args[0])}) — retry the enclosing "
+                    "iteration instead"))
+        # @retry(...) decorator on a def that donates
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in known:
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                if last_segment(dotted(base)) == "retry":
+                    findings.append(mod.finding(
+                        "retry-wraps-donating", dec,
+                        f"@retry on donating def '{node.name}' — a "
+                        "failed dispatch may already have invalidated "
+                        "the donated inputs"))
+    return findings

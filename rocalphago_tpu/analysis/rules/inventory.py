@@ -1,0 +1,517 @@
+"""Inventory drift: code vs documented observability/resilience
+contracts.
+
+The metric names, span names, fault-barrier names and
+``ROCALPHAGO_*`` env knobs are *interfaces*: operators scrape them,
+fault plans target them, docs promise them. They are also just
+strings, so nothing stops a rename in code from silently orphaning
+the documented name (or a new metric from shipping undocumented).
+This family extracts every such name statically and diffs it against
+the documented inventories:
+
+* ``undocumented-metric`` / ``stale-metric-doc`` — registry
+  counters/gauges/histograms vs the metric table in
+  docs/OBSERVABILITY.md;
+* ``undocumented-span`` — ``trace.span("…")`` names vs
+  docs/OBSERVABILITY.md (prose/backtick mention suffices; spans have
+  no stale check because the doc groups them as prose);
+* ``undocumented-barrier`` / ``stale-barrier-doc`` — fault-barrier
+  names vs the two barrier tables in docs/RESILIENCE.md;
+* ``knob-doc-drift`` — env knobs vs the generated docs/KNOBS.md
+  (regenerate with ``python scripts/lint.py --write-knobs``);
+* ``report-unknown-metric`` — metric names *consumed* by
+  scripts/obs_report.py that no code path produces (the renderer
+  silently showing empty sections is exactly the rot this catches).
+
+F-string names become ``*`` glob patterns (``encode_incr_{f}_total``
+→ ``encode_incr_*_total``) and match the documented glob; documented
+placeholders (``serve.<rung>``) glob the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import re
+
+from rocalphago_tpu.analysis.core import Finding, project_rule
+from rocalphago_tpu.analysis.jaxmodel import dotted, last_segment
+
+#: modules whose registry/trace calls DEFINE the api, not metrics
+#: (obs/jaxobs.py is a genuine producer — jax_compiles_total — so
+#: only the registry/trace definition modules are excluded)
+PRODUCER_EXCLUDE = ("rocalphago_tpu/obs/registry.py",
+                    "rocalphago_tpu/obs/trace.py",
+                    "rocalphago_tpu/analysis/",
+                    "tests/", "scripts/obs_report.py")
+BARRIER_EXCLUDE = ("rocalphago_tpu/runtime/faults.py",
+                   "rocalphago_tpu/analysis/", "tests/")
+KNOB_RE = re.compile(r"^ROCALPHAGO_[A-Z0-9_]+$")
+METRIC_SUFFIX = re.compile(
+    r"_(total|seconds|us|per_s|per_min|occupancy|gap_s|margin_s|"
+    r"plies)$")
+
+
+@dataclasses.dataclass
+class Entry:
+    name: str          # may contain '*' (from f-strings)
+    module: str
+    line: int
+    kind: str = ""
+    labels: tuple = ()
+
+
+@dataclasses.dataclass
+class Knob:
+    name: str
+    module: str = ""      # owning (first defining/reading) module
+    default: str = ""     # literal default at the environ.get site
+    readers: tuple = ()
+
+
+def _joined_pattern(node: ast.JoinedStr) -> str:
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        else:
+            parts.append("*")
+    return "".join(parts)
+
+
+def _str_or_pattern(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return _joined_pattern(node)
+    return None
+
+
+def _excluded(rel: str, prefixes) -> bool:
+    return any(rel == p or rel.startswith(p) for p in prefixes)
+
+
+# ------------------------------------------------------------ extraction
+
+def _extract(ctx) -> dict:
+    cached = ctx.cache.get("inventory")
+    if cached is not None:
+        return cached
+    metrics: list = []
+    spans: list = []
+    barriers: list = []
+    knob_map: dict = {}
+
+    owner_rank: dict = {}
+
+    def _rank(module, defining):
+        # package modules own their knobs; benches/scripts/tests are
+        # readers. Within a tier a defining `X_ENV = "…"` assign
+        # beats a bare read.
+        tier = (0 if module.startswith("rocalphago_tpu/")
+                else 2 if module.startswith("tests/") else 1)
+        return (tier, 0 if defining else 1)
+
+    def note_knob(name, module, line, default=None, defining=False):
+        k = knob_map.setdefault(name, Knob(name=name))
+        readers = set(k.readers)
+        readers.add(module)
+        k.readers = tuple(sorted(readers))
+        rank = _rank(module, defining)
+        if not k.module or rank < owner_rank[name]:
+            k.module = module
+            owner_rank[name] = rank
+        if default is not None and not k.default:
+            k.default = default
+
+    for mod in ctx.modules:
+        rel = mod.rel
+        # module-level "NAME_ENV = 'ROCALPHAGO_X'" aliases (defining)
+        aliases: dict = {}
+        for st in mod.tree.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name) \
+                    and isinstance(st.value, ast.Constant) \
+                    and isinstance(st.value.value, str) \
+                    and KNOB_RE.match(st.value.value):
+                aliases[st.targets[0].id] = st.value.value
+                note_knob(st.value.value, rel, st.lineno,
+                          defining=True)
+
+        def knob_of(node):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and KNOB_RE.match(node.value):
+                return node.value
+            if isinstance(node, ast.Name):
+                return aliases.get(node.id)
+            return None
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                seg = last_segment(name)
+                # ---- metrics / spans / barriers (producers)
+                if seg in ("counter", "gauge", "histogram") \
+                        and name and "." in name \
+                        and not _excluded(rel, PRODUCER_EXCLUDE) \
+                        and node.args:
+                    m = _str_or_pattern(node.args[0])
+                    if m:
+                        metrics.append(Entry(
+                            name=m, module=rel, line=node.lineno,
+                            kind=seg,
+                            labels=tuple(sorted(
+                                k.arg for k in node.keywords
+                                if k.arg))))
+                elif seg == "span" and not _excluded(
+                        rel, PRODUCER_EXCLUDE) and node.args:
+                    s = _str_or_pattern(node.args[0])
+                    if s:
+                        spans.append(Entry(name=s, module=rel,
+                                           line=node.lineno))
+                elif seg and seg.endswith("barrier") \
+                        and not _excluded(rel, BARRIER_EXCLUDE) \
+                        and node.args:
+                    b = _str_or_pattern(node.args[0])
+                    if b:
+                        barriers.append(Entry(name=b, module=rel,
+                                              line=node.lineno))
+                # ---- env knobs (environ access forms)
+                if name and (name.endswith("environ.get")
+                             or name.endswith(".getenv")
+                             or name == "getenv"
+                             or name.endswith("environ.setdefault")
+                             or name.endswith("environ.pop")):
+                    if node.args:
+                        kn = knob_of(node.args[0])
+                        if kn:
+                            default = None
+                            if len(node.args) > 1 and isinstance(
+                                    node.args[1], ast.Constant):
+                                default = repr(node.args[1].value)
+                            note_knob(kn, rel, node.lineno,
+                                      default=default)
+            elif isinstance(node, ast.Subscript):
+                base = dotted(node.value)
+                if base and base.endswith("environ"):
+                    kn = knob_of(node.slice)
+                    if kn:
+                        note_knob(kn, rel, node.lineno)
+            elif isinstance(node, ast.Compare) \
+                    and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                right = dotted(node.comparators[0])
+                if right and right.endswith("environ"):
+                    kn = knob_of(node.left)
+                    if kn:
+                        note_knob(kn, rel, node.lineno)
+
+    out = {"metrics": metrics, "spans": spans, "barriers": barriers,
+           "knobs": dict(sorted(knob_map.items()))}
+    ctx.cache["inventory"] = out
+    return out
+
+
+# ------------------------------------------------------------ doc parsing
+
+def _backtick_tokens(text: str) -> list:
+    return re.findall(r"`([^`\n]+)`", text)
+
+
+def _table_first_cells(text: str, header_word: str) -> list:
+    """(lineno, [backtick tokens]) for the first column of every
+    markdown table whose header's first cell contains
+    ``header_word``."""
+    rows = []
+    lines = text.splitlines()
+    in_table = False
+    for i, line in enumerate(lines, start=1):
+        s = line.strip()
+        if not s.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in s.strip("|").split("|")]
+        if not cells:
+            continue
+        if header_word in cells[0].lower() and "`" not in cells[0]:
+            in_table = True
+            continue
+        if set(cells[0]) <= {"-", ":", " "}:
+            continue
+        if in_table:
+            toks = re.findall(r"`([^`]+)`", cells[0])
+            if toks:
+                rows.append((i, toks))
+    return rows
+
+
+def _norm(name: str) -> str:
+    """Strip the ``{label=}`` suffix and turn ``<placeholder>`` into a
+    glob, so documented and extracted names compare."""
+    name = re.sub(r"\{[^}]*\}", "", name).strip()
+    name = re.sub(r"<[^>]*>", "*", name)
+    return name
+
+
+def _match(a: str, b: str) -> bool:
+    a, b = _norm(a), _norm(b)
+    return a == b or fnmatch.fnmatchcase(a, b) \
+        or fnmatch.fnmatchcase(b, a)
+
+
+def _doc_line_of(text: str, token: str) -> int:
+    for i, line in enumerate(text.splitlines(), start=1):
+        if token in line:
+            return i
+    return 1
+
+
+# ------------------------------------------------------------ the rules
+
+@project_rule(
+    "undocumented-metric",
+    "a registry metric produced in code but absent from the "
+    "OBSERVABILITY.md inventory")
+def undocumented_metric(ctx):
+    inv = _extract(ctx)
+    doc = ctx.read_doc(ctx.config.docs_observability)
+    if doc is None:
+        return []
+    tokens = [_norm(t) for t in _backtick_tokens(doc)]
+    findings = []
+    seen = set()
+    for m in inv["metrics"]:
+        base = _norm(m.name)
+        if base in seen:
+            continue
+        seen.add(base)
+        if not any(_match(base, t) for t in tokens):
+            findings.append(Finding(
+                path=m.module, line=m.line, rule="undocumented-metric",
+                message=f"metric '{m.name}' ({m.kind}) is not in "
+                        f"{ctx.config.docs_observability} — add it to "
+                        "the metric inventory table",
+                snippet=f"metric:{base}"))
+    return findings
+
+
+@project_rule(
+    "stale-metric-doc",
+    "a metric documented in OBSERVABILITY.md's table that no code "
+    "produces")
+def stale_metric_doc(ctx):
+    inv = _extract(ctx)
+    doc = ctx.read_doc(ctx.config.docs_observability)
+    if doc is None:
+        return []
+    produced = [_norm(m.name) for m in inv["metrics"]]
+    findings = []
+    for lineno, toks in _table_first_cells(doc, "metric"):
+        for t in toks:
+            base = _norm(t)
+            # non-name tokens in the cell (e.g. annotations) — skip
+            if not re.match(r"^[a-z][a-z0-9_*]+$", base):
+                continue
+            if not any(_match(base, p) for p in produced):
+                findings.append(Finding(
+                    path=ctx.config.docs_observability, line=lineno,
+                    rule="stale-metric-doc",
+                    message=f"documented metric '{t}' is produced by "
+                            "no code path — remove the row or restore "
+                            "the metric",
+                    snippet=f"doc-metric:{base}"))
+    return findings
+
+
+@project_rule(
+    "undocumented-span",
+    "a trace.span name not mentioned in OBSERVABILITY.md")
+def undocumented_span(ctx):
+    inv = _extract(ctx)
+    doc = ctx.read_doc(ctx.config.docs_observability)
+    if doc is None:
+        return []
+    tokens = []
+    for t in _backtick_tokens(doc):
+        for part in t.split("/"):
+            tokens.append(_norm(part))
+    findings = []
+    seen = set()
+    for s in inv["spans"]:
+        base = _norm(s.name)
+        if base in seen:
+            continue
+        seen.add(base)
+        if not any(_match(base, t) for t in tokens):
+            findings.append(Finding(
+                path=s.module, line=s.line, rule="undocumented-span",
+                message=f"span '{s.name}' is not mentioned in "
+                        f"{ctx.config.docs_observability} — document "
+                        "it in the span-coverage paragraph",
+                snippet=f"span:{base}"))
+    return findings
+
+
+@project_rule(
+    "undocumented-barrier",
+    "a fault-barrier name absent from RESILIENCE.md's barrier tables")
+def undocumented_barrier(ctx):
+    inv = _extract(ctx)
+    doc = ctx.read_doc(ctx.config.docs_resilience)
+    if doc is None:
+        return []
+    documented = []
+    for _ln, toks in _table_first_cells(doc, "barrier"):
+        documented.extend(_norm(t) for t in toks)
+    findings = []
+    seen = set()
+    for b in inv["barriers"]:
+        base = _norm(b.name)
+        if base in seen:
+            continue
+        seen.add(base)
+        if not any(_match(base, t) for t in documented):
+            findings.append(Finding(
+                path=b.module, line=b.line,
+                rule="undocumented-barrier",
+                message=f"fault barrier '{b.name}' is not in the "
+                        f"{ctx.config.docs_resilience} barrier tables"
+                        " — fault plans can't target what operators "
+                        "can't see",
+                snippet=f"barrier:{base}"))
+    return findings
+
+
+@project_rule(
+    "stale-barrier-doc",
+    "a barrier documented in RESILIENCE.md that no code declares")
+def stale_barrier_doc(ctx):
+    inv = _extract(ctx)
+    doc = ctx.read_doc(ctx.config.docs_resilience)
+    if doc is None:
+        return []
+    declared = [_norm(b.name) for b in inv["barriers"]]
+    findings = []
+    for lineno, toks in _table_first_cells(doc, "barrier"):
+        for t in toks:
+            base = _norm(t)
+            if not re.match(r"^[a-z][a-z0-9_.]+$", base):
+                continue
+            if not any(_match(base, d) for d in declared):
+                findings.append(Finding(
+                    path=ctx.config.docs_resilience, line=lineno,
+                    rule="stale-barrier-doc",
+                    message=f"documented barrier '{t}' is declared "
+                            "nowhere in code — remove the row or "
+                            "restore the barrier",
+                    snippet=f"doc-barrier:{base}"))
+    return findings
+
+
+@project_rule(
+    "knob-doc-drift",
+    "ROCALPHAGO_* env knobs out of sync with the generated "
+    "docs/KNOBS.md")
+def knob_doc_drift(ctx):
+    inv = _extract(ctx)
+    doc = ctx.read_doc(ctx.config.docs_knobs)
+    findings = []
+    documented = set()
+    if doc is not None:
+        for _ln, toks in _table_first_cells(doc, "knob"):
+            documented.update(_norm(t) for t in toks)
+    for name, k in inv["knobs"].items():
+        if name not in documented:
+            findings.append(Finding(
+                path=k.module or "pyproject.toml", line=1,
+                rule="knob-doc-drift",
+                message=f"env knob '{name}' is not documented in "
+                        f"{ctx.config.docs_knobs} — run `python "
+                        "scripts/lint.py --write-knobs`",
+                snippet=f"knob:{name}"))
+    for name in sorted(documented):
+        if name not in inv["knobs"]:
+            findings.append(Finding(
+                path=ctx.config.docs_knobs,
+                line=_doc_line_of(doc or "", name),
+                rule="knob-doc-drift",
+                message=f"documented knob '{name}' is read nowhere "
+                        "in code — stale name? run `python "
+                        "scripts/lint.py --write-knobs`",
+                snippet=f"doc-knob:{name}"))
+    return findings
+
+
+@project_rule(
+    "report-unknown-metric",
+    "obs_report consumes a metric name no code produces")
+def report_unknown_metric(ctx):
+    inv = _extract(ctx)
+    produced = [_norm(m.name) for m in inv["metrics"]]
+    findings = []
+    report_mods = [m for m in ctx.modules
+                   if m.rel in ctx.config.report_modules]
+    for mod in report_mods:
+        seen = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(dotted(node.func))
+            if seg not in ("startswith", "get"):
+                continue
+            for a in node.args[:1]:
+                if not (isinstance(a, ast.Constant)
+                        and isinstance(a.value, str)):
+                    continue
+                base = _norm(a.value)
+                if base in seen or not METRIC_SUFFIX.search(base):
+                    continue
+                seen.add(base)
+                # prefix consumption (startswith) matches any
+                # produced metric that begins with the base
+                ok = any(_match(base, p) or p.startswith(base)
+                         for p in produced)
+                if not ok:
+                    findings.append(mod.finding(
+                        "report-unknown-metric", node,
+                        f"obs_report reads metric '{base}' which no "
+                        "code path produces — its section will "
+                        "render empty forever"))
+        findings = [f for f in findings]
+    return findings
+
+
+# --------------------------------------------------- KNOBS.md generator
+
+KNOBS_HEADER = """\
+# KNOBS — every `ROCALPHAGO_*` environment variable
+
+<!-- GENERATED by `python scripts/lint.py --write-knobs` from the
+     jaxlint env-knob extractor; hand edits to the table are
+     overwritten. The `knob-doc-drift` lint rule fails when this
+     file and the source disagree. -->
+
+One row per knob the source actually reads: the owning module (the
+definition/primary read site), the literal default at the
+`environ.get` site (`—` when the knob is presence/flag-style or the
+default is computed), and every other module that reads it. Semantics
+live with the owning module's docstrings and the subsystem docs
+(docs/PERFORMANCE.md, docs/RESILIENCE.md, docs/OBSERVABILITY.md).
+
+| knob | owning module | default | also read in |
+|---|---|---|---|
+"""
+
+
+def render_knobs_doc(ctx) -> str:
+    inv = _extract(ctx)
+    rows = []
+    for name, k in sorted(inv["knobs"].items()):
+        others = [r for r in k.readers if r != k.module]
+        rows.append(
+            f"| `{name}` | `{k.module}` | "
+            f"{('`' + k.default + '`') if k.default else '—'} | "
+            f"{', '.join('`' + o + '`' for o in others) or '—'} |")
+    return KNOBS_HEADER + "\n".join(rows) + "\n"

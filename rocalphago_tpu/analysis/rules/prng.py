@@ -1,0 +1,155 @@
+"""PRNG key discipline.
+
+A JAX PRNG key is single-use: every ``jax.random.*`` sampler (and
+``split`` itself) consumes the key value it is given, and two calls
+with the same key return *correlated* (identical-stream) results —
+the classic silent bug that degrades self-play diversity without
+failing a single test. The discipline is mechanical: every consume
+is preceded by a fresh ``split`` (or derives a per-item key with
+``fold_in``), i.e. a key name is consumed at most once between
+re-bindings.
+
+``prng-key-reuse`` — the same key name is consumed by two
+``jax.random.*`` calls with no intervening re-binding of that name.
+
+``prng-key-reuse-in-loop`` — a key defined outside a loop is
+consumed inside the loop body and never re-bound within it: every
+iteration draws the same stream. (``fold_in(key, i)`` is the
+sanctioned pattern and is exempt.)
+
+Key names are tracked three ways: values returned by
+``PRNGKey``/``key``/``split``/``fold_in``, names matching the key
+convention (``key``, ``rng``, ``*_key``, ``*_rng``, …) whether bound
+as parameters or assigned, and tuple-unpacks of ``split``. A
+consume only counts when such a name is passed to a ``*.random.*``
+call, so dict-iteration ``key`` variables never false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from rocalphago_tpu.analysis.core import module_rule
+from rocalphago_tpu.analysis.events import iter_scopes, scope_events
+from rocalphago_tpu.analysis.jaxmodel import dotted
+
+#: jax.random.* entry points that do NOT consume in the reuse sense
+NON_CONSUMING = ("PRNGKey", "key", "wrap_key_data", "key_data",
+                 "fold_in", "clone", "key_impl")
+#: producers whose result is a fresh key (re-binding from these
+#: makes the target key-like)
+PRODUCERS = ("PRNGKey", "key", "split", "fold_in", "clone")
+
+KEYLIKE_NAME = re.compile(
+    r"(^|_)(key|keys|rng|rngs|prng)(_|$)|_key$|_rng$")
+
+_RANDOM_CALL = re.compile(r"(^|\.)random\.([A-Za-z_][A-Za-z0-9_]*)$")
+
+
+def _random_fn(call: ast.Call) -> str | None:
+    """``jax.random.normal`` -> ``normal``; None for non-random
+    calls. Accepts any ``*.random.<fn>`` dotted path plus bare
+    ``split``/``fold_in``/``PRNGKey`` (from-imports)."""
+    name = dotted(call.func)
+    if not name:
+        return None
+    m = _RANDOM_CALL.search(name)
+    if m:
+        return m.group(2)
+    if name in ("split", "fold_in", "PRNGKey"):
+        return name
+    return None
+
+
+def _scope_param_keys(scope) -> set:
+    if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    a = scope.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    return {n for n in names if KEYLIKE_NAME.search(n)}
+
+
+def _walk_module(mod) -> list:
+    findings = []
+    for scope in iter_scopes(mod.tree):
+        ev = scope_events(scope)
+        keylike = _scope_param_keys(scope)
+        consumed: dict = {}   # name -> event index of first consume
+        loop_consumes: list = []   # (idx, name, call node)
+        for i, e in enumerate(ev.events):
+            if e.kind == "write":
+                producer = bool(
+                    e.src and e.src.rsplit(".", 1)[-1] in PRODUCERS)
+                if producer or KEYLIKE_NAME.search(e.name or ""):
+                    keylike.add(e.name)
+                else:
+                    keylike.discard(e.name)
+                consumed.pop(e.name, None)
+            elif e.kind == "call":
+                fn = _random_fn(e.call)
+                if fn is None or fn in NON_CONSUMING:
+                    continue
+                key_arg = None
+                if e.call.args and isinstance(e.call.args[0], ast.Name) \
+                        and e.call.args[0].id in keylike:
+                    key_arg = e.call.args[0].id
+                for k in e.call.keywords:
+                    if k.arg in ("key", "rng", "seed") \
+                            and isinstance(k.value, ast.Name) \
+                            and k.value.id in keylike:
+                        key_arg = k.value.id
+                if key_arg is None:
+                    continue
+                if key_arg in consumed:
+                    findings.append(mod.finding(
+                        "prng-key-reuse", e.call,
+                        f"key '{key_arg}' already consumed by a "
+                        "jax.random call (line "
+                        f"{ev.events[consumed[key_arg]].node.lineno})"
+                        " — split it (or fold_in a counter) before "
+                        "reusing; reuse silently draws the SAME "
+                        "stream"))
+                else:
+                    consumed[key_arg] = i
+                loop_consumes.append((i, key_arg, e.call))
+        # loop reuse: consumed inside a loop, never re-bound in it
+        flagged = set()
+        for i, name, call in loop_consumes:
+            loop = ev.enclosing_loop(i)
+            if loop is None or (name, loop) in flagged:
+                continue
+            writes_in_loop = any(
+                t.kind == "write" and t.name == name
+                for t in ev.events[loop[0]:loop[1]])
+            if not writes_in_loop:
+                flagged.add((name, loop))
+                findings.append(mod.finding(
+                    "prng-key-reuse-in-loop", call,
+                    f"key '{name}' consumed inside a loop without "
+                    "re-binding — every iteration draws the same "
+                    "stream; split per iteration or fold_in the "
+                    "loop index"))
+    return findings
+
+
+def _cached_walk(mod) -> list:
+    cached = getattr(mod, "_prng_findings", None)
+    if cached is None:
+        cached = mod._prng_findings = _walk_module(mod)
+    return cached
+
+
+@module_rule(
+    "prng-key-reuse",
+    "the same PRNG key consumed twice without a split/re-bind")
+def prng_key_reuse(mod, ctx):
+    return [f for f in _cached_walk(mod) if f.rule == "prng-key-reuse"]
+
+
+@module_rule(
+    "prng-key-reuse-in-loop",
+    "a key consumed in a loop body without per-iteration splitting")
+def prng_key_reuse_in_loop(mod, ctx):
+    return [f for f in _cached_walk(mod)
+            if f.rule == "prng-key-reuse-in-loop"]

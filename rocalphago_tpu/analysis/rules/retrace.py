@@ -1,0 +1,220 @@
+"""Retrace hazards: arguments that make XLA recompile (or refuse).
+
+``jax.jit`` keys its executable cache on the *hash* of every static
+argument. Two consequences this rule family proves statically:
+
+``float-static-arg`` — a float literal flowing into a
+``static_argnames``/``static_argnums`` position. Floats hash fine
+but have effectively unbounded cardinality (komi sweeps, time
+budgets, learning-rate schedules…), so every distinct value is a
+full recompile — the "recompile storm" the compile tracker
+(docs/OBSERVABILITY.md) exists to catch at runtime. Pass floats as
+traced arguments; keep static for genuinely low-cardinality ints/
+strings/bools.
+
+``unhashable-static-arg`` — a list/dict/set literal (or
+``list()``/``dict()``/``set()`` call) at a static position:
+``TypeError: unhashable type`` at call time, on the branch that
+traces. Use a tuple.
+
+``mutable-global-in-jit`` — a jitted body reads a module-level
+list/dict/set that is mutated somewhere in the module. jit captures
+the value AT TRACE TIME; later mutations are silently ignored (no
+retrace), which is a correctness bug wearing a performance-bug
+costume. Hoist to an argument or freeze to a tuple.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rocalphago_tpu.analysis.core import module_rule
+from rocalphago_tpu.analysis.jaxmodel import (
+    dotted, index_module, jit_wrapper_spec, last_segment,
+    positional_params, static_param_names,
+)
+
+MUTATORS = ("append", "extend", "insert", "add", "update", "pop",
+            "popitem", "remove", "discard", "clear", "setdefault",
+            "sort", "reverse")
+
+
+def _is_float_literal(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand,
+                                                    ast.Constant):
+        return isinstance(node.operand.value, float)
+    if isinstance(node, ast.Call) and dotted(node.func) == "float":
+        return True
+    return False
+
+
+def _is_unhashable_literal(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.SetComp, ast.DictComp)):
+        return True
+    if isinstance(node, ast.Call) \
+            and dotted(node.func) in ("list", "dict", "set"):
+        return True
+    return False
+
+
+def _static_args_of_call(call: ast.Call, fndef, spec):
+    """(param_or_index, arg_node) pairs at static positions."""
+    out = []
+    params = positional_params(fndef) if fndef is not None else ()
+    static_names = set(spec.static_names)
+    if fndef is not None:
+        static_names = set(static_param_names(fndef, spec))
+    nums = set(spec.static_nums)
+    for i, a in enumerate(call.args):
+        pname = params[i] if i < len(params) else None
+        if i in nums or (pname and pname in static_names):
+            out.append((pname or i, a))
+    for k in call.keywords:
+        if k.arg and k.arg in static_names:
+            out.append((k.arg, k.value))
+    return out
+
+
+def _check_static_args(mod, call, fndef, spec, findings) -> None:
+    for where, arg in _static_args_of_call(call, fndef, spec):
+        if _is_float_literal(arg):
+            findings.append(mod.finding(
+                "float-static-arg", arg,
+                f"float value at static position {where!r} — every "
+                "distinct value recompiles; pass it traced, or make "
+                "it a low-cardinality int/str"))
+        elif _is_unhashable_literal(arg):
+            findings.append(mod.finding(
+                "unhashable-static-arg", arg,
+                f"unhashable list/dict/set at static position "
+                f"{where!r} — TypeError at trace time; use a tuple"))
+
+
+def _walk_module(mod) -> list:
+    findings: list = []
+    idx = index_module(mod)
+    # name -> (fndef, spec) for jitted defs with static positions
+    by_name = {}
+    for fndef, spec in idx.jitted.values():
+        if spec.static_names or spec.static_nums:
+            by_name[fndef.name] = (fndef, spec)
+    # alias form: `g = jax.jit(f, static_argnums=...)` — calls go
+    # through `g`, so map the assigned name to the same spec
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        spec = jit_wrapper_spec(node.value)
+        fndef = None
+        if spec is not None and node.value.args:
+            fndef = idx.defs.get(
+                last_segment(dotted(node.value.args[0])) or "")
+        elif isinstance(node.value.func, ast.Call):
+            spec = jit_wrapper_spec(node.value.func)
+            if spec is not None and node.value.args:
+                fndef = idx.defs.get(
+                    last_segment(dotted(node.value.args[0])) or "")
+        if spec is None or not (spec.static_names or spec.static_nums):
+            continue
+        for tgt in node.targets:
+            name = last_segment(dotted(tgt))
+            if name:
+                by_name.setdefault(name, (fndef, spec))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # calls to module-known jitted defs
+        hit = by_name.get(last_segment(dotted(node.func)) or "")
+        if hit is not None:
+            _check_static_args(mod, node, hit[0], hit[1], findings)
+        # inline `jax.jit(f, static_argnums=...)(args)` /
+        # `partial(jax.jit, ...)(f)(args)`
+        if isinstance(node.func, ast.Call):
+            spec = jit_wrapper_spec(node.func)
+            if spec is not None and (spec.static_names
+                                     or spec.static_nums):
+                inner = node.func.args[0] if node.func.args else None
+                fndef = idx.defs.get(
+                    last_segment(dotted(inner)) or "") \
+                    if inner is not None else None
+                _check_static_args(mod, node, fndef, spec, findings)
+
+    # mutable globals read by jitted bodies
+    mutable_globals = {}
+    for st in mod.tree.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and _is_unhashable_literal(st.value):
+            mutable_globals[st.targets[0].id] = st
+    if mutable_globals:
+        mutated = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATORS \
+                    and isinstance(node.func.value, ast.Name):
+                mutated.add(node.func.value.id)
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name):
+                        mutated.add(t.value.id)
+        hot = set(mutable_globals) & mutated
+        for fndef, _spec in idx.jitted.values():
+            local = set()
+            for sub in ast.walk(fndef):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    local.update(p.arg for p in (
+                        *sub.args.posonlyargs, *sub.args.args,
+                        *sub.args.kwonlyargs))
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Store):
+                    local.add(sub.id)
+            for sub in ast.walk(fndef):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load) \
+                        and sub.id in hot and sub.id not in local:
+                    findings.append(mod.finding(
+                        "mutable-global-in-jit", sub,
+                        f"jitted '{fndef.name}' captures mutable "
+                        f"global '{sub.id}' which is mutated in this "
+                        "module — jit freezes the trace-time value; "
+                        "later mutations are silently ignored"))
+    return findings
+
+
+def _cached(mod) -> list:
+    cached = getattr(mod, "_retrace_findings", None)
+    if cached is None:
+        cached = mod._retrace_findings = _walk_module(mod)
+    return cached
+
+
+@module_rule(
+    "float-static-arg",
+    "float literal at a static_argnames/argnums position (recompile "
+    "per value)")
+def float_static_arg(mod, ctx):
+    return [f for f in _cached(mod) if f.rule == "float-static-arg"]
+
+
+@module_rule(
+    "unhashable-static-arg",
+    "list/dict/set at a static position (TypeError at trace time)")
+def unhashable_static_arg(mod, ctx):
+    return [f for f in _cached(mod)
+            if f.rule == "unhashable-static-arg"]
+
+
+@module_rule(
+    "mutable-global-in-jit",
+    "jitted body captures a mutated module-level list/dict/set")
+def mutable_global_in_jit(mod, ctx):
+    return [f for f in _cached(mod)
+            if f.rule == "mutable-global-in-jit"]

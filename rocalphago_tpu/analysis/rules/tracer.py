@@ -1,0 +1,230 @@
+"""Tracer / host-sync hazards inside jit-compiled bodies.
+
+Inside a jit-compiled function every non-static argument is a
+tracer: ``float(x)`` / ``int(x)`` / ``bool(x)`` / ``x.item()`` raise
+``TracerConversionError`` (or, on concrete paths like the fused
+encode/self-play loops, silently force a device sync that serializes
+the pipeline), ``np.*`` calls drop the value out of the traced
+graph, and Python ``if``/``while`` on a tracer-derived value raises
+``TracerBoolConversionError``. All of these are *runtime* failures
+today — and only on the branch that actually traces. This rule finds
+them at lint time.
+
+Taint model (forward, evaluation order, per jitted body):
+
+* non-static parameters start tainted; ``static_argnames`` /
+  ``static_argnums`` parameters start clean (branching on a static
+  arg is exactly what static args are for);
+* assignment propagates taint through expressions; re-binding a name
+  to a clean value clears it;
+* trace-time-static projections sanitize: ``.shape`` / ``.ndim`` /
+  ``.dtype`` / ``.size``, ``len(...)``, and ``x is None`` tests are
+  concrete during tracing, so ``if x.ndim == 2:`` is clean;
+* nested defs inside a jitted body (scan/while/cond bodies) are
+  analyzed with ALL parameters tainted — that is what ``lax``
+  passes them.
+
+Rules: ``host-sync-in-jit`` (conversions, ``.item()``/``.tolist()``,
+``np.*`` on tainted values) and ``python-branch-on-tracer``
+(``if``/``while``/``assert``/ternary on a tainted test).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rocalphago_tpu.analysis.core import module_rule
+from rocalphago_tpu.analysis.jaxmodel import (
+    all_params, dotted, index_module, static_param_names,
+)
+
+#: attribute projections that are concrete at trace time
+SANITIZERS = ("shape", "ndim", "dtype", "size", "aval", "sharding")
+#: host conversions that force a sync / fail on tracers
+CONVERSIONS = ("float", "int", "bool", "complex")
+SYNC_METHODS = ("item", "tolist", "block_until_ready", "__array__")
+NUMPY_PREFIXES = ("np.", "numpy.", "onp.")
+
+
+class _Taint:
+    """Forward taint walk over one jitted body."""
+
+    def __init__(self, mod, fndef, tainted: set, findings: list):
+        self.mod = mod
+        self.findings = findings
+        self.tainted = set(tainted)
+        self.body = fndef.body
+
+    # -- expression taint --------------------------------------------
+    def is_tainted(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in SANITIZERS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name == "len":
+                return False  # len() of a traced array is static
+            if name and (name in CONVERSIONS
+                         or name.startswith(NUMPY_PREFIXES)):
+                return False  # result is a host value (flagged below)
+            parts = [node.func] + list(node.args) \
+                + [k.value for k in node.keywords]
+            return any(self.is_tainted(p) for p in parts)
+        if isinstance(node, ast.Compare):
+            ops = node.ops
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in ops):
+                return False  # `x is None` is a trace-time fact
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Lambda,)):
+            return False
+        return any(self.is_tainted(c)
+                   for c in ast.iter_child_nodes(node))
+
+    # -- statement walk ----------------------------------------------
+    def assign(self, target, value_tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.tainted.add if value_tainted
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, value_tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, value_tainted)
+
+    def check_expr(self, node) -> None:
+        """Flag host syncs anywhere inside ``node``."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = dotted(sub.func)
+            args = list(sub.args) + [k.value for k in sub.keywords]
+            if name in CONVERSIONS and any(
+                    self.is_tainted(a) for a in args):
+                self.findings.append(self.mod.finding(
+                    "host-sync-in-jit", sub,
+                    f"{name}() on a traced value inside a jit body — "
+                    "fails under jit (TracerConversionError) or "
+                    "forces a host sync; keep it in jnp, or make the "
+                    "argument static"))
+            elif isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in SYNC_METHODS \
+                    and self.is_tainted(sub.func.value):
+                self.findings.append(self.mod.finding(
+                    "host-sync-in-jit", sub,
+                    f".{sub.func.attr}() on a traced value inside a "
+                    "jit body — host sync / trace failure"))
+            elif name and name.startswith(NUMPY_PREFIXES) and any(
+                    self.is_tainted(a) for a in args):
+                self.findings.append(self.mod.finding(
+                    "host-sync-in-jit", sub,
+                    f"{name}(...) on a traced value inside a jit "
+                    "body — numpy drops the value out of the traced "
+                    "graph (use jnp)"))
+
+    def check_test(self, node, kw: str) -> None:
+        if self.is_tainted(node):
+            self.findings.append(self.mod.finding(
+                "python-branch-on-tracer", node,
+                f"Python `{kw}` on a tracer-derived value inside a "
+                "jit body — raises TracerBoolConversionError at "
+                "trace time; use lax.cond/select/jnp.where"))
+
+    def walk(self, body) -> None:
+        for st in body:
+            self.stmt(st)
+
+    def stmt(self, st) -> None:
+        if isinstance(st, ast.Assign):
+            self.check_expr(st.value)
+            t = self.is_tainted(st.value)
+            for tgt in st.targets:
+                self.assign(tgt, t)
+        elif isinstance(st, ast.AugAssign):
+            self.check_expr(st.value)
+            if isinstance(st.target, ast.Name) \
+                    and self.is_tainted(st.value):
+                self.tainted.add(st.target.id)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            self.check_expr(st.value)
+            self.assign(st.target, self.is_tainted(st.value))
+        elif isinstance(st, ast.If):
+            self.check_expr(st.test)
+            self.check_test(st.test, "if")
+            self.walk(st.body)
+            self.walk(st.orelse)
+        elif isinstance(st, ast.While):
+            self.check_expr(st.test)
+            self.check_test(st.test, "while")
+            self.walk(st.body)
+            self.walk(st.orelse)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self.check_expr(st.iter)
+            if self.is_tainted(st.iter):
+                self.findings.append(self.mod.finding(
+                    "python-branch-on-tracer", st,
+                    "Python `for` over a traced value inside a jit "
+                    "body — iteration count must be trace-time "
+                    "static; use lax.scan/fori_loop"))
+            self.assign(st.target, self.is_tainted(st.iter))
+            self.walk(st.body)
+            self.walk(st.orelse)
+        elif isinstance(st, ast.Assert):
+            self.check_test(st.test, "assert")
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # scan/while/cond body: every parameter is a tracer
+            inner = _Taint(self.mod, st,
+                           set(self.tainted) | set(all_params(st)),
+                           self.findings)
+            inner.walk(st.body)
+        elif isinstance(st, (ast.Return, ast.Expr, ast.Raise)):
+            for child in ast.iter_child_nodes(st):
+                self.check_expr(child)
+                if isinstance(child, ast.IfExp):
+                    self.check_test(child.test, "ternary")
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.check_expr(item.context_expr)
+            self.walk(st.body)
+        elif isinstance(st, ast.Try):
+            self.walk(st.body)
+            for h in st.handlers:
+                self.walk(h.body)
+            self.walk(st.orelse)
+            self.walk(st.finalbody)
+
+
+@module_rule(
+    "host-sync-in-jit",
+    "float()/int()/.item()/np.* on traced values inside jit bodies")
+def host_sync_in_jit(mod, ctx):
+    findings: list = []
+    idx = index_module(mod)
+    for fndef, spec in idx.jitted.values():
+        static = static_param_names(fndef, spec)
+        params = [p for p in all_params(fndef)
+                  if p not in ("self", "cls")]
+        tainted = {p for p in params if p not in static}
+        _Taint(mod, fndef, tainted, findings).walk(fndef.body)
+    # one walk produces both rule ids; split here
+    return [f for f in findings if f.rule == "host-sync-in-jit"]
+
+
+@module_rule(
+    "python-branch-on-tracer",
+    "Python if/while/assert on tracer-derived values in jit bodies")
+def python_branch_on_tracer(mod, ctx):
+    findings: list = []
+    idx = index_module(mod)
+    for fndef, spec in idx.jitted.values():
+        static = static_param_names(fndef, spec)
+        params = [p for p in all_params(fndef)
+                  if p not in ("self", "cls")]
+        tainted = {p for p in params if p not in static}
+        _Taint(mod, fndef, tainted, findings).walk(fndef.body)
+    return [f for f in findings if f.rule == "python-branch-on-tracer"]

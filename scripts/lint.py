@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""jaxlint CLI — run the project's JAX-aware static analysis.
+
+Usage:
+    python scripts/lint.py                  # full report, exit 1 on
+                                            # NEW (unbaselined) findings
+    python scripts/lint.py --check          # CI form: terse, same exit
+    python scripts/lint.py --json           # machine-readable report
+    python scripts/lint.py --rules a,b      # run a subset of rules
+    python scripts/lint.py --list-rules     # rule catalog
+    python scripts/lint.py --update-baseline  # rewrite the baseline
+                                              # from current findings
+    python scripts/lint.py --write-knobs    # (re)generate docs/KNOBS.md
+
+Exit codes: 0 clean (new findings == 0 AND no stale baseline
+entries), 1 findings/stale entries, 2 usage error. Config lives in
+pyproject.toml ``[tool.jaxlint]``; the baseline file and suppression
+syntax are documented in docs/STATIC_ANALYSIS.md.
+
+Stdlib-only (no jax import): runs in <30 s over the whole repo, so
+it rides tier-1 (scripts/test.sh) ahead of the test suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from rocalphago_tpu.analysis import (  # noqa: E402
+    load_baseline, load_config, run_lint, write_baseline,
+)
+from rocalphago_tpu.analysis.core import LintContext, rule_catalog  # noqa: E402
+from rocalphago_tpu.analysis import core as _core  # noqa: E402
+from rocalphago_tpu.analysis.reporters import (  # noqa: E402
+    render_json, render_text,
+)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_knobs(root: str, config) -> str:
+    """(Re)generate docs/KNOBS.md from the env-knob extractor."""
+    from rocalphago_tpu.analysis.rules.inventory import render_knobs_doc
+    rels = _core.discover_files(root, config)
+    modules, _ = _core.parse_modules(root, rels)
+    ctx = LintContext(root, config, modules)
+    text = render_knobs_doc(ctx)
+    path = os.path.join(root, config.docs_knobs)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="JAX-aware static analysis (jaxlint)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: only new findings + summary")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(notes preserved where fingerprints match)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="treat every finding as new")
+    ap.add_argument("--write-knobs", action="store_true",
+                    help="regenerate docs/KNOBS.md and exit")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print baselined findings")
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    a = ap.parse_args(argv)
+
+    root = a.root or repo_root()
+    config = load_config(root)
+
+    if a.list_rules:
+        for rid, summary in rule_catalog().items():
+            print(f"{rid:26s} {summary}")
+        return 0
+    if a.write_knobs:
+        path = write_knobs(root, config)
+        print(f"jaxlint: wrote {os.path.relpath(path, root)}")
+        return 0
+
+    only = None
+    if a.rules:
+        only = {r.strip() for r in a.rules.split(",") if r.strip()}
+        unknown = only - set(rule_catalog())
+        if unknown:
+            print(f"jaxlint: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    t0 = time.monotonic()
+    findings = run_lint(root, config, only=only)
+    baseline_path = os.path.join(root, config.baseline)
+    if a.no_baseline:
+        new, old, stale = findings, [], []
+        baseline = None
+    else:
+        baseline = load_baseline(baseline_path)
+        new, old, stale = baseline.partition(findings)
+        if only is not None:
+            # a rule-subset run must not read the skipped rules'
+            # baseline entries as stale
+            stale = [e for e in stale if e.get("rule") in only]
+
+    if a.update_baseline:
+        write_baseline(baseline_path, findings, previous=baseline)
+        print(f"jaxlint: baseline updated with {len(findings)} "
+              f"finding(s) -> {config.baseline}")
+        return 0
+
+    dt = time.monotonic() - t0
+    if a.json:
+        print(render_json(new, old, stale))
+    else:
+        print(render_text(new, old, stale, verbose=a.verbose))
+        if not a.check:
+            print(f"jaxlint: {len(rule_catalog())} rules over "
+                  f"{len(_core.discover_files(root, config))} files "
+                  f"in {dt:.1f}s")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

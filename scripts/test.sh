@@ -57,6 +57,18 @@ cd "$(dirname "$0")/.."
 # sync-gap-strictly-higher A/B, the donation/retry refusal, and the
 # step-on-done no-op lemma; the deadline-overshoot-at-depth tests
 # live in tests/test_serving_chaos.py. See docs/PERFORMANCE.md.
+# Static analysis (jaxlint, docs/STATIC_ANALYSIS.md): the JAX-aware
+# lint — donation reuse, retry-wrapping-donators, host syncs and
+# Python branches on tracers in jit bodies, PRNG key reuse,
+# float/unhashable static args, mutable-global capture, and the
+# metric/span/barrier/ROCALPHAGO_* knob inventories diffed against
+# docs/{OBSERVABILITY,RESILIENCE,KNOBS}.md — runs first (stdlib-only,
+# ~2 s, budgeted <30 s) and fails the tier on any unbaselined
+# finding. tests/test_jaxlint.py re-runs it in-process (self-lint)
+# plus per-rule fixture coverage, so `pytest tests/` alone still
+# enforces it.
+python scripts/lint.py --check
+
 ARGS=()
 TIER=(-m "not slow")
 for a in "$@"; do

@@ -1,0 +1,722 @@
+"""jaxlint (rocalphago_tpu/analysis) — rule-family fixtures, the
+suppression/baseline workflow, and the repo self-lint.
+
+Layout mirrors the acceptance contract (docs/STATIC_ANALYSIS.md):
+each rule family has at least one seeded-violation fixture that MUST
+fire and a minimal clean counterpart that MUST NOT (false-positive
+guard); the suppression comment and the committed baseline each
+round-trip; and the shipped tree itself lints clean against the
+committed baseline in tier-1 (the self-lint), inside the <30 s
+budget, so a convention violation fails CI before it ever runs.
+
+Everything here is stdlib-only (the linter never imports jax), so
+this file is cheap even on cold workers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from rocalphago_tpu.analysis import (
+    Finding, lint_source, load_baseline, load_config, run_lint,
+    write_baseline,
+)
+from rocalphago_tpu.analysis.baseline import Baseline
+from rocalphago_tpu.analysis.config import LintConfig, _mini_toml_table
+from rocalphago_tpu.analysis.core import all_rule_ids, rule_catalog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src: str, **kw) -> set:
+    return {f.rule for f in lint_source(src, **kw)}
+
+
+# ------------------------------------------------------- rule family 1
+# donation safety
+
+
+class TestDonationRules:
+    def test_read_after_donation_fires(self):
+        src = """
+import jax, functools
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, x):
+    return state
+def run(state, x):
+    out = step(state, x)
+    return state.board
+"""
+        fs = [f for f in lint_source(src) if f.rule == "donation-reuse"]
+        assert len(fs) == 1
+        assert "'state'" in fs[0].message
+
+    def test_carry_rebind_is_clean(self):
+        src = """
+import jax, functools
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, x):
+    return state
+def run(state, x):
+    for _ in range(3):
+        state = step(state, x)
+    return state
+"""
+        assert "donation-reuse" not in rules_of(src)
+
+    def test_loop_donation_without_rebind_fires(self):
+        src = """
+import jax, functools
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, x):
+    return state
+def run(state, x):
+    for _ in range(3):
+        out = step(state, x)
+    return out
+"""
+        assert "donation-reuse" in rules_of(src)
+
+    def test_donation_into_convention_marked_attr(self):
+        # the repo convention: positions via the jit assignment, the
+        # cross-module contract via donates_buffers = True
+        src = """
+import jax, functools
+class NS: pass
+search = NS()
+search.run_donated = functools.partial(
+    jax.jit, donate_argnums=(0,))(lambda t: t)
+search.run_donated.donates_buffers = True
+def loop(tree):
+    tree2 = search.run_donated(tree)
+    return tree.root
+"""
+        assert "donation-reuse" in rules_of(src)
+
+    def test_retry_wrapping_donator_fires_all_forms(self):
+        src = """
+import jax, functools
+from rocalphago_tpu.runtime.retries import retry, retry_call
+@functools.partial(jax.jit, donate_argnums=(0,))
+def chunk(c):
+    return c
+chunk.donates_buffers = True
+a = retry(max_attempts=2)(chunk)
+b = retry_call(chunk, 1)
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "retry-wraps-donating"]
+        assert len(fs) == 2
+
+    def test_retry_on_plain_callable_is_clean(self):
+        src = """
+from rocalphago_tpu.runtime.retries import retry
+def iteration(state):
+    return state
+safe = retry(max_attempts=2)(iteration)
+"""
+        assert "retry-wraps-donating" not in rules_of(src)
+
+    def test_local_def_shadows_cross_module_name(self):
+        # `segment` donates in search/selfplay.py; a module defining
+        # its OWN non-donating `segment` must not inherit that
+        src = """
+import jax, functools
+@functools.partial(jax.jit, static_argnames=("length",))
+def segment(params, xs, length):
+    return xs
+def run(params, xs):
+    for _ in range(2):
+        out = segment(params, xs, length=4)
+    return out, xs
+"""
+        assert "donation-reuse" not in rules_of(src)
+
+
+# ------------------------------------------------------- rule family 2
+# tracer / host-sync hazards
+
+
+class TestTracerRules:
+    def test_float_cast_in_jit_fires(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    return float(x.sum())
+"""
+        assert "host-sync-in-jit" in rules_of(src)
+
+    def test_item_and_numpy_fire(self):
+        src = """
+import jax
+import numpy as np
+@jax.jit
+def f(x):
+    a = x.sum().item()
+    b = np.asarray(x)
+    return a, b
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "host-sync-in-jit"]
+        assert len(fs) == 2
+
+    def test_static_arg_cast_is_clean(self):
+        src = """
+import jax, functools
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x * int(n)
+"""
+        assert rules_of(src) == set()
+
+    def test_branch_on_tracer_fires(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+"""
+        assert "python-branch-on-tracer" in rules_of(src)
+
+    def test_shape_none_and_isinstance_guards_are_clean(self):
+        src = """
+import jax
+@jax.jit
+def f(x, key=None):
+    if key is None:
+        return x
+    if x.ndim == 2:
+        return x.sum()
+    if len(x) > 3:
+        return x[0]
+    return x
+"""
+        assert rules_of(src) == set()
+
+    def test_scan_body_params_are_tracers(self):
+        src = """
+import jax
+from jax import lax
+@jax.jit
+def f(xs):
+    def body(carry, x):
+        if x > 0:
+            carry = carry + x
+        return carry, x
+    return lax.scan(body, 0.0, xs)
+"""
+        assert "python-branch-on-tracer" in rules_of(src)
+
+    def test_while_on_tracer_fires(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    while x < 10:
+        x = x * 2
+    return x
+"""
+        assert "python-branch-on-tracer" in rules_of(src)
+
+
+# ------------------------------------------------------- rule family 3
+# PRNG discipline
+
+
+class TestPrngRules:
+    def test_double_consume_fires(self):
+        src = """
+import jax
+def sample(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))
+    return a + b
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "prng-key-reuse"]
+        assert len(fs) == 1
+        assert "'key'" in fs[0].message
+
+    def test_split_between_consumes_is_clean(self):
+        src = """
+import jax
+def sample(key):
+    key, k1 = jax.random.split(key)
+    a = jax.random.normal(k1, (3,))
+    key, k2 = jax.random.split(key)
+    b = jax.random.uniform(k2, (3,))
+    return a + b
+"""
+        assert rules_of(src) == set()
+
+    def test_loop_reuse_fires(self):
+        src = """
+import jax
+def sample(key, n):
+    out = []
+    for i in range(n):
+        out.append(jax.random.normal(key, (3,)))
+    return out
+"""
+        assert "prng-key-reuse-in-loop" in rules_of(src)
+
+    def test_fold_in_loop_is_clean(self):
+        src = """
+import jax
+def sample(key, n):
+    out = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)
+        out.append(jax.random.normal(k, (3,)))
+    return out
+"""
+        assert rules_of(src) == set()
+
+    def test_assigned_key_is_tracked(self):
+        # name-convention tracking: unpack helpers produce keys too
+        src = """
+import jax
+def sample(state):
+    key = unpack_rng(state.rng)
+    a = jax.random.normal(key, (3,))
+    b = jax.random.normal(key, (3,))
+    return a + b
+"""
+        assert "prng-key-reuse" in rules_of(src)
+
+    def test_dict_iteration_key_never_fires(self):
+        src = """
+def render(d):
+    out = []
+    for key in d:
+        out.append(d[key])
+    return out
+"""
+        assert rules_of(src) == set()
+
+
+# ------------------------------------------------------- rule family 4
+# retrace hazards
+
+
+class TestRetraceRules:
+    def test_float_static_arg_fires(self):
+        src = """
+import jax, functools
+@functools.partial(jax.jit, static_argnames=("komi",))
+def score(board, komi):
+    return board.sum() + komi
+def run(board):
+    return score(board, komi=7.5)
+"""
+        assert "float-static-arg" in rules_of(src)
+
+    def test_int_static_arg_is_clean(self):
+        src = """
+import jax, functools
+@functools.partial(jax.jit, static_argnames=("size",))
+def score(board, size):
+    return board.sum() + size
+def run(board):
+    return score(board, size=19)
+"""
+        assert rules_of(src) == set()
+
+    def test_unhashable_static_arg_fires(self):
+        src = """
+import jax, functools
+@functools.partial(jax.jit, static_argnames=("dims",))
+def f(x, dims):
+    return x
+def run(x):
+    return f(x, dims=[1, 2])
+"""
+        assert "unhashable-static-arg" in rules_of(src)
+
+    def test_positional_static_argnums_float(self):
+        src = """
+import jax
+def f(x, lr):
+    return x * lr
+g = jax.jit(f, static_argnums=(1,))
+def run(x):
+    return g(x, 0.01)
+"""
+        assert "float-static-arg" in rules_of(src)
+
+    def test_mutable_global_capture_fires(self):
+        src = """
+import jax
+TABLES = {}
+@jax.jit
+def f(x):
+    return x if not TABLES else x * 2
+def warm(k, v):
+    TABLES[k] = v
+"""
+        assert "mutable-global-in-jit" in rules_of(src)
+
+    def test_unmutated_global_is_clean(self):
+        src = """
+import jax
+EDGES = {}
+@jax.jit
+def f(x):
+    return x if not EDGES else x * 2
+"""
+        assert rules_of(src) == set()
+
+
+# ------------------------------------------------------- rule family 5
+# inventory drift (against fixture docs)
+
+OBS_DOC = """
+| metric | where |
+|---|---|
+| `good_total` | somewhere |
+
+Spans: `zero.step`.
+"""
+RES_DOC = """
+| barrier | where |
+|---|---|
+| `zero.pre_save` | the loop |
+"""
+KNOBS_DOC = """
+| knob | owning module | default | also read in |
+|---|---|---|---|
+| `ROCALPHAGO_GOOD` | `m.py` | — | — |
+"""
+DOCS = {"docs/OBSERVABILITY.md": OBS_DOC,
+        "docs/RESILIENCE.md": RES_DOC,
+        "docs/KNOBS.md": KNOBS_DOC}
+
+
+class TestInventoryRules:
+    def test_documented_inventory_is_clean(self):
+        src = """
+import os
+from rocalphago_tpu.obs import registry as obs_registry, trace
+from rocalphago_tpu.runtime import faults
+def work():
+    obs_registry.counter("good_total").inc()
+    with trace.span("zero.step"):
+        faults.barrier("zero.pre_save")
+    return os.environ.get("ROCALPHAGO_GOOD")
+"""
+        assert rules_of(src, docs=DOCS) == set()
+
+    def test_undocumented_metric_span_barrier_fire(self):
+        src = """
+from rocalphago_tpu.obs import registry as obs_registry, trace
+from rocalphago_tpu.runtime import faults
+def work():
+    obs_registry.counter("rogue_total").inc()
+    with trace.span("rogue.step"):
+        faults.barrier("rogue.pre_save")
+"""
+        got = rules_of(src, docs=DOCS)
+        assert {"undocumented-metric", "undocumented-span",
+                "undocumented-barrier"} <= got
+
+    def test_fstring_metric_matches_doc_glob(self):
+        doc = DOCS | {"docs/OBSERVABILITY.md":
+                      "| metric | where |\n|---|---|\n"
+                      "| `encode_*_total` | counters |\n"}
+        src = """
+from rocalphago_tpu.obs import registry as obs_registry
+def work(field):
+    obs_registry.counter(f"encode_{field}_total").inc()
+"""
+        assert "undocumented-metric" not in rules_of(src, docs=doc)
+
+    def test_stale_doc_entries_fire(self):
+        src = "X = 1\n"
+        got = lint_source(src, docs=DOCS)
+        rules = {f.rule for f in got}
+        # fixture docs document a metric/barrier/knob nothing produces
+        assert {"stale-metric-doc", "stale-barrier-doc",
+                "knob-doc-drift"} <= rules
+        stale_knob = [f for f in got if f.rule == "knob-doc-drift"]
+        assert any("ROCALPHAGO_GOOD" in f.message for f in stale_knob)
+
+    def test_undocumented_knob_fires(self):
+        src = """
+import os
+FLAG = os.environ.get("ROCALPHAGO_ROGUE", "")
+"""
+        fs = [f for f in lint_source(src, docs=DOCS)
+              if f.rule == "knob-doc-drift"]
+        assert any("ROCALPHAGO_ROGUE" in f.message for f in fs)
+
+    def test_report_unknown_metric_fires(self):
+        cfg = LintConfig(report_modules=("report.py",))
+        src = """
+def render(counters, key):
+    ghosts = counters.get("ghost_metric_total", 0)
+    return ghosts, key.startswith("dispatch_gap_s")
+"""
+        fs = [f for f in lint_source(src, rel="report.py",
+                                     config=cfg, docs=DOCS)
+              if f.rule == "report-unknown-metric"]
+        # both consumed names lack a producer in this fixture project
+        assert len(fs) == 2
+        assert any("ghost_metric_total" in f.message for f in fs)
+
+    def test_report_known_metric_is_clean(self):
+        # the real repo: every metric obs_report consumes has a
+        # producer (enforced end-to-end by the self-lint below);
+        # here, prove the rule goes quiet when a producer exists
+        cfg = LintConfig(report_modules=("report.py",))
+        src = """
+from rocalphago_tpu.obs import registry as obs_registry
+def produce():
+    obs_registry.counter("ghost_metric_total").inc()
+def render(counters):
+    return counters.get("ghost_metric_total", 0)
+"""
+        fs = [f for f in lint_source(src, rel="report.py",
+                                     config=cfg, docs=DOCS)
+              if f.rule == "report-unknown-metric"]
+        assert fs == []
+
+    def test_knob_alias_and_subscript_forms_extracted(self):
+        src = """
+import os
+DEPTH_ENV = "ROCALPHAGO_DEPTH"
+def read():
+    a = os.environ.get(DEPTH_ENV, "1")
+    b = os.environ["ROCALPHAGO_RAW"]
+    c = "ROCALPHAGO_PRESENT" in os.environ
+    return a, b, c
+"""
+        fs = [f.message for f in lint_source(src, docs=DOCS)
+              if f.rule == "knob-doc-drift"]
+        for knob in ("ROCALPHAGO_DEPTH", "ROCALPHAGO_RAW",
+                     "ROCALPHAGO_PRESENT"):
+            assert any(knob in m for m in fs)
+
+
+# ----------------------------------------------- suppression + baseline
+
+
+class TestSuppressionAndBaseline:
+    SRC = """
+import jax
+def sample(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))
+    return a + b
+"""
+
+    def test_suppression_comment_specific_rule(self):
+        src = self.SRC.replace(
+            "b = jax.random.uniform(key, (3,))",
+            "b = jax.random.uniform(key, (3,))"
+            "  # jaxlint: disable=prng-key-reuse")
+        assert "prng-key-reuse" not in rules_of(src)
+
+    def test_suppression_requires_matching_rule(self):
+        src = self.SRC.replace(
+            "b = jax.random.uniform(key, (3,))",
+            "b = jax.random.uniform(key, (3,))"
+            "  # jaxlint: disable=donation-reuse")
+        assert "prng-key-reuse" in rules_of(src)
+
+    def test_bare_disable_suppresses_all(self):
+        src = self.SRC.replace(
+            "b = jax.random.uniform(key, (3,))",
+            "b = jax.random.uniform(key, (3,))  # jaxlint: disable")
+        assert "prng-key-reuse" not in rules_of(src)
+
+    def test_skip_file(self):
+        src = "# jaxlint: skip-file\n" + self.SRC
+        assert rules_of(src) == set()
+
+    def test_baseline_round_trip(self, tmp_path):
+        findings = lint_source(self.SRC)
+        assert findings
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, findings)
+        bl = load_baseline(path)
+        new, old, stale = bl.partition(findings)
+        assert new == [] and stale == []
+        assert len(old) == len(findings)
+
+    def test_baseline_survives_line_drift_not_edits(self, tmp_path):
+        findings = lint_source(self.SRC)
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, findings)
+        bl = load_baseline(path)
+        # lines shift (comment block added above): still baselined
+        drifted = lint_source("# pad\n# pad\n# pad\n" + self.SRC)
+        new, old, _ = bl.partition(drifted)
+        assert new == []
+        # the offending line itself changes: resurfaces as NEW
+        edited = lint_source(self.SRC.replace(
+            "b = jax.random.uniform(key, (3,))",
+            "b = jax.random.uniform(key, (4,))"))
+        new, _, stale = bl.partition(edited)
+        assert len(new) == 1 and len(stale) == 1
+
+    def test_baseline_notes_preserved_on_update(self, tmp_path):
+        findings = lint_source(self.SRC)
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, findings)
+        data = json.load(open(path))
+        data["findings"][0]["note"] = "intentional: fixture"
+        with open(path, "w") as f:
+            json.dump(data, f)
+        write_baseline(path, findings, previous=load_baseline(path))
+        data2 = json.load(open(path))
+        assert data2["findings"][0]["note"] == "intentional: fixture"
+
+
+# ------------------------------------------------------ config + CLI
+
+
+class TestConfigAndCli:
+    def test_mini_toml_parses_jaxlint_block(self):
+        text = """
+[tool.other]
+include = ["nope"]
+
+[tool.jaxlint]
+include = ["pkg", "scripts"]
+disable = ["prng-key-reuse"]
+baseline = ".b.json"
+"docs.knobs" = "docs/K.md"
+"""
+        table = _mini_toml_table(text, "tool.jaxlint")
+        assert table["include"] == ["pkg", "scripts"]
+        assert table["disable"] == ["prng-key-reuse"]
+        assert table["baseline"] == ".b.json"
+        assert table["docs.knobs"] == "docs/K.md"
+
+    def test_load_config_from_repo(self):
+        cfg = load_config(REPO)
+        assert "rocalphago_tpu" in cfg.include
+        assert cfg.baseline == ".jaxlint-baseline.json"
+
+    def test_disable_respected(self):
+        cfg = LintConfig(disable=("prng-key-reuse",))
+        src = TestSuppressionAndBaseline.SRC
+        assert "prng-key-reuse" not in rules_of(src, config=cfg)
+
+    def test_rule_catalog_covers_all_families(self):
+        ids = all_rule_ids()
+        for rid in ("donation-reuse", "retry-wraps-donating",
+                    "host-sync-in-jit", "python-branch-on-tracer",
+                    "prng-key-reuse", "prng-key-reuse-in-loop",
+                    "float-static-arg", "unhashable-static-arg",
+                    "mutable-global-in-jit", "undocumented-metric",
+                    "stale-metric-doc", "undocumented-span",
+                    "undocumented-barrier", "stale-barrier-doc",
+                    "knob-doc-drift", "report-unknown-metric"):
+            assert rid in ids
+        assert len(rule_catalog()) == len(ids)
+
+
+# ---------------------------------------------------------- self-lint
+
+
+class TestSelfLint:
+    def test_repo_lints_clean_within_budget(self):
+        """THE acceptance gate: zero unbaselined findings on the
+        shipped tree, no stale baseline entries, < 30 s."""
+        t0 = time.monotonic()
+        cfg = load_config(REPO)
+        findings = run_lint(REPO, cfg)
+        bl = load_baseline(os.path.join(REPO, cfg.baseline))
+        new, old, stale = bl.partition(findings)
+        dt = time.monotonic() - t0
+        assert new == [], "unbaselined findings:\n" + "\n".join(
+            f.render() for f in new)
+        assert stale == [], f"stale baseline entries: {stale}"
+        assert dt < 30.0, f"lint budget blown: {dt:.1f}s"
+
+    def test_baseline_entries_all_have_notes(self):
+        bl = load_baseline(os.path.join(REPO, ".jaxlint-baseline.json"))
+        for e in bl.entries:
+            assert e.get("note"), \
+                f"baseline entry without justification: {e}"
+
+    def test_knobs_doc_is_current(self):
+        """docs/KNOBS.md regenerates byte-identical (the generator
+        and the committed doc cannot drift)."""
+        from rocalphago_tpu.analysis.core import (
+            LintContext, discover_files, parse_modules,
+        )
+        from rocalphago_tpu.analysis.rules.inventory import (
+            render_knobs_doc,
+        )
+        cfg = load_config(REPO)
+        mods, _ = parse_modules(REPO, discover_files(REPO, cfg))
+        ctx = LintContext(REPO, cfg, mods)
+        with open(os.path.join(REPO, cfg.docs_knobs)) as f:
+            assert f.read() == render_knobs_doc(ctx)
+
+    def test_cli_check_exits_zero(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+             "--check"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_cli_flags_seeded_violation(self, tmp_path):
+        """End-to-end: a fresh tree with one violation exits 1 and
+        names the rule."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            "import jax\n"
+            "def sample(key):\n"
+            "    a = jax.random.normal(key, (3,))\n"
+            "    b = jax.random.uniform(key, (3,))\n"
+            "    return a + b\n")
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.jaxlint]\ninclude = [\"pkg\"]\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+             "--root", str(tmp_path)],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 1
+        assert "prng-key-reuse" in out.stdout
+
+
+class TestFindingModel:
+    def test_fingerprint_ignores_line(self):
+        a = Finding(path="p.py", line=3, rule="r", message="m",
+                    snippet="x = 1")
+        b = Finding(path="p.py", line=9, rule="r", message="m2",
+                    snippet="x = 1")
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "broken.py").write_text("def f(:\n")
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.jaxlint]\ninclude = [\"pkg\"]\n")
+        cfg = load_config(str(tmp_path))
+        findings = run_lint(str(tmp_path), cfg)
+        assert any(f.rule == "parse-error" for f in findings)
+
+    def test_count_aware_baseline(self):
+        f = Finding(path="p.py", line=1, rule="r", message="m",
+                    snippet="dup()")
+        g = Finding(path="p.py", line=2, rule="r", message="m",
+                    snippet="dup()")
+        bl = Baseline([{"rule": "r", "path": "p.py",
+                        "snippet": "dup()", "note": "one"}])
+        new, old, stale = bl.partition([f, g])
+        assert len(old) == 1 and len(new) == 1 and stale == []
