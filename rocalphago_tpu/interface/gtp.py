@@ -135,7 +135,8 @@ class GTPEngine:
     def __init__(self, player, name: str = "rocalphago-tpu",
                  version: str = "0.1", metrics=None,
                  resilient: bool = True,
-                 hang_timeout_s: float | None = None):
+                 hang_timeout_s: float | None = None,
+                 serve_pool=None):
         from rocalphago_tpu.interface.resilient import ResilientPlayer
 
         self.player = player
@@ -154,6 +155,10 @@ class GTPEngine:
                 player, metrics=metrics,
                 hang_timeout_s=hang_timeout_s)
         self.illegal_from_player = 0  # engine-level final-guard count
+        # serve-backed players (rocalphago_tpu/serve) surface their
+        # pool's live stats through the probes: explicit serve_pool,
+        # else discovered off the primary (SessionPlayer.pool)
+        self._serve_pool = serve_pool
         self.name = name
         self.version = version
         self.size = self._player_board() or 19
@@ -410,9 +415,18 @@ class GTPEngine:
         return self._serve.primary if self._serve is not None \
             else self.player
 
+    def _pool(self):
+        """The serving pool behind this engine's player, if any."""
+        if self._serve_pool is not None:
+            return self._serve_pool
+        return getattr(self._primary_player(), "pool", None)
+
     def cmd_rocalphago_health(self, args):
         """Degradation-ladder health: counts per rung, p50/p99
-        genmove latency, last fallback reason, sims actually run."""
+        genmove latency, last fallback reason, sims actually run.
+        Serve-backed engines add the pool block (live sessions,
+        queue depth, batch occupancy, sheds — docs/SERVING.md), the
+        fields an LB health check keys on."""
         if self._serve is None:
             raise ValueError("resilient serving disabled")
         s = self._serve.stats()
@@ -426,6 +440,9 @@ class GTPEngine:
             "hits": getattr(primary, "deadline_hits", 0),
             "last_hit": bool(getattr(primary, "last_deadline_hit",
                                      False))}
+        pool = self._pool()
+        if pool is not None:
+            s["serve"] = pool.stats()
         return json.dumps(s, sort_keys=True)
 
     def cmd_rocalphago_stats(self, args):
@@ -470,6 +487,9 @@ class GTPEngine:
             },
             "ladder": (self._serve.stats()
                        if self._serve is not None else None),
+            # the serving pool's live stats (serve-backed player)
+            "serve": (self._pool().stats()
+                      if self._pool() is not None else None),
             # the live process-wide metric registry (ladder-rung
             # counters, genmove/chunk latency histograms, deadline
             # margin — obs.registry; schema docs/OBSERVABILITY.md)
@@ -691,6 +711,16 @@ def main(argv=None):
                     help="raw legacy serving: player exceptions "
                          "become ? error replies (forfeits under "
                          "most controllers)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve-backed player: this engine's game is "
+                         "one session of a rocalphago_tpu.serve pool "
+                         "(shared batching evaluator, admission "
+                         "control, pool stats on the probes); needs "
+                         "--value")
+    ap.add_argument("--serve-slo-ms", type=float, default=None,
+                    help="per-genmove SLO for the serve pool in ms "
+                         "(anytime answer on expiry; default "
+                         "ROCALPHAGO_SERVE_SLO_MS / off)")
     a = ap.parse_args(argv)
     from rocalphago_tpu.runtime.compilecache import enable_compile_cache
 
@@ -704,11 +734,34 @@ def main(argv=None):
         metrics = MetricsLogger(a.metrics, echo=False)
         # genmove spans + compile events join the serving metrics
         trace.configure(metrics)
+    pool = None
+    if a.serve:
+        from rocalphago_tpu.models.nn_util import NeuralNetBase
+        from rocalphago_tpu.serve.sessions import ServePool
+
+        if not a.value:
+            raise SystemExit("--serve needs a --value model")
+        policy = NeuralNetBase.load_model(a.policy)
+        value = NeuralNetBase.load_model(a.value)
+        pool = ServePool(
+            value, policy, n_sim=a.playouts, metrics=metrics,
+            hang_timeout_s=a.genmove_timeout,
+            slo_s=(a.serve_slo_ms / 1e3
+                   if a.serve_slo_ms is not None else None))
+        pool.warm()
+        # the session arrives ladder-wrapped; the engine adopts it
+        player = pool.open_session(
+            resilient=not a.no_resilient).player
+    else:
+        player = make_player(a)
     try:
-        run_gtp(make_player(a), metrics=metrics,
+        run_gtp(player, metrics=metrics,
                 resilient=not a.no_resilient,
-                hang_timeout_s=a.genmove_timeout)
+                hang_timeout_s=a.genmove_timeout,
+                serve_pool=pool)
     finally:
+        if pool is not None:
+            pool.close()
         # end-of-session registry snapshot (same idiom as the
         # trainers): obs_report's encode/dispatch sections read their
         # histograms from this event, so a serving run's metrics file

@@ -54,9 +54,14 @@ from rocalphago_tpu.runtime.watchdog import Watchdog
 #: ladder rungs, strongest first (the order the ladder walks them)
 RUNGS = ("search", "reduced", "policy", "fallback")
 
-#: reason codes a degradation event may carry
-REASONS = ("transient_error", "error", "hang", "illegal_from_player",
-           "fallback_error", "barrier_fault")
+#: reason codes a degradation event may carry. ``overload`` is the
+#: serving pool's load-shed signal (:class:`~rocalphago_tpu.serve.
+#: admission.EvaluatorOverload`): the shared evaluator's bounded
+#: queue refused the session's leaf evals, and the ladder IS the
+#: per-session shed policy — step down to the reduced-sims retry
+#: (less load), then the raw policy net (no evaluator at all).
+REASONS = ("transient_error", "overload", "error", "hang",
+           "illegal_from_player", "fallback_error", "barrier_fault")
 
 
 class SearchHang(RuntimeError):
@@ -229,6 +234,13 @@ class ResilientPlayer:
             return "illegal_from_player"
         if isinstance(exc, SearchHang):
             return "hang"
+        # exceptions may name their own ladder reason (duck-typed so
+        # serve.admission need not be imported here): the pool's
+        # EvaluatorOverload carries "overload", keeping load sheds
+        # distinct from generic transient flake in every probe
+        named = getattr(exc, "degradation_reason", None)
+        if isinstance(named, str) and named in REASONS:
+            return named
         return "transient_error" if is_transient(exc) else "error"
 
     def _note(self, rung: str, reason: str, exc, t0: float,
@@ -302,11 +314,14 @@ class ResilientPlayer:
             reason = self._classify(e)
             self._note("search", reason, e, t0, turn)
             self._last_reason = reason
-        # rung 2: reduced-sims retry — transient flake only (a
-        # re-dispatch after a hang would hang again, after a
+        # rung 2: reduced-sims retry — transient flake and load sheds
+        # only (a re-dispatch after a hang would hang again, after a
         # programming error would re-raise, after an illegal move
-        # would return it again)
-        if reason == "transient_error":
+        # would return it again). Under overload the reduced budget
+        # IS the shed: a quarter of the leaf evals re-enters the
+        # queue, and if even that sheds, the policy rung below costs
+        # the evaluator nothing.
+        if reason in ("transient_error", "overload"):
             try:
                 return self._run("reduced", self._reduced_call,
                                  state), "reduced"

@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 import time
 
 
@@ -50,11 +51,21 @@ def sanitize(value):
 class MetricsLogger:
     """Line-buffered JSONL event stream (``with``-able: closing is
     ``close``; a crashed process that never exits the ``with`` loses
-    at most the in-flight line — tests/test_runtime.py pins that)."""
+    at most the in-flight line — tests/test_runtime.py pins that).
+
+    THREAD-SAFE: one logger is shared by every session of a serving
+    pool (degradation events, watchdog stalls, spans from N session
+    threads plus the evaluator's dispatcher), so emission is a single
+    ``write()`` call under a lock — interleaved events can never tear
+    each other's lines, and ``close`` can race an emit without
+    writing to a closed file (pinned by the concurrent-emit test in
+    ``tests/test_obs.py``). Serialization happens OUTSIDE the lock;
+    only the file write is held."""
 
     def __init__(self, path: str | None, echo: bool = True):
         self.path = path
         self.echo = echo
+        self._lock = threading.Lock()
         if path:
             parent = os.path.dirname(path)
             if parent:
@@ -68,8 +79,10 @@ class MetricsLogger:
         high-rate telemetry (spans, compile events, registry
         snapshots)."""
         rec = sanitize({"event": event, "time": time.time(), **fields})
-        if self._f:
-            self._f.write(json.dumps(rec, allow_nan=False) + "\n")
+        line = json.dumps(rec, allow_nan=False) + "\n"
+        with self._lock:
+            if self._f:
+                self._f.write(line)
 
     def log(self, event: str, **fields) -> None:
         fields = sanitize(fields)
@@ -81,9 +94,10 @@ class MetricsLogger:
             print(f"[{event}] {shown}", flush=True)
 
     def close(self) -> None:
-        if self._f:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f:
+                self._f.close()
+                self._f = None
 
     def __enter__(self) -> "MetricsLogger":
         return self

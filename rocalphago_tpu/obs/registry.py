@@ -11,7 +11,13 @@ of a run (event ``registry``), which is how histograms reach
 Design points:
 
 * metrics are keyed by ``name`` plus sorted ``labels`` (Prometheus
-  identity: ``name{k="v"}``), get-or-create, thread-safe;
+  identity: ``name{k="v"}``), get-or-create, thread-safe — audited
+  for the serving pool's many-sessions emit pattern: ``_get`` holds
+  the registry lock, every mutate holds the metric's own lock, and
+  ``Histogram.observe``'s bisect runs lock-free only over the
+  immutable ``edges`` tuple (the concurrent-emit test in
+  ``tests/test_obs.py`` hammers counters/histograms from N threads
+  and pins exact totals);
 * histograms are BOUNDED: a fixed ascending edge list (default
   :data:`DEFAULT_EDGES`, latency-shaped) plus one overflow bucket —
   constant memory however many observations arrive; ``observe`` is a

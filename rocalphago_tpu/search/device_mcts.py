@@ -70,6 +70,28 @@ from rocalphago_tpu.search.clock import MoveClock
 from rocalphago_tpu.search.selfplay import sensible_mask
 
 
+class SimStep(NamedTuple):
+    """One simulation's device-side context between SELECT/EXPAND and
+    EVALUATE — the seam the serving subsystem's cross-game leaf
+    batching cuts the search at (``rocalphago_tpu/serve``):
+    ``prepare_sim`` descends + steps and returns this (with
+    ``eval_states`` = the leaf states to evaluate), an EXTERNAL
+    evaluator produces ``(priors, values)`` for those states — for
+    serving, coalesced with other games' leaves into one device batch
+    — and ``apply_sim`` writes the node + backs the value up. The
+    fused in-search path composes the same two halves around its own
+    ``eval_batch``, so the split path is the fused path by
+    construction, not a re-implementation."""
+
+    node: jax.Array         # i32 [B] node the descent ended on
+    safe_action: jax.Array  # i32 [B] selected edge (pass where none)
+    expanding: jax.Array    # bool [B] True = a new leaf was stepped
+    eval_states: GoState    # [B, ...] states the evaluator must
+    #   score. Where ``expanding`` these ARE the stepped children
+    #   (the only rows the apply half writes), so one materialized
+    #   GoState serves both the evaluator and the node write.
+
+
 class DeviceTree(NamedTuple):
     """Per-game search slab (leading axis = game batch B).
 
@@ -289,14 +311,12 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             (start_node, start_action, -v_child, visits, value_sum))
         return visits, value_sum
 
-    def simulate(params_p, params_v, tree: DeviceTree,
-                 root_actions=None) -> DeviceTree:
-        """One lockstep simulation across the whole game batch.
-        ``root_actions`` (i32 [B], -1 = free) forces each game's first
-        edge — the Gumbel searcher's scheduled candidates."""
-        if root_actions is None:
-            root_actions = jnp.full(
-                (tree.n_nodes.shape[0],), -1, jnp.int32)
+    def prepare_sim(tree: DeviceTree, root_actions) -> SimStep:
+        """SELECT + EXPAND half of one lockstep simulation: descend,
+        step the selected edge, and return the :class:`SimStep` whose
+        ``eval_states`` an evaluator must score. ``root_actions``
+        (i32 [B], -1 = free) forces each game's first edge — the
+        Gumbel searcher's scheduled candidates."""
         node, action = jax.vmap(_descend_one)(
             tree.prior, tree.visits, tree.value_sum, tree.child,
             tree.states.done, root_actions, tree.root)
@@ -309,9 +329,6 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         new_states_b = vstep(parent_states, safe_action)
 
         expanding = action >= 0                       # bool [B]
-        full = tree.n_nodes >= m
-        idx = jnp.where(expanding & ~full,
-                        jnp.minimum(tree.n_nodes, m - 1), 0)
 
         # evaluate: expanded games evaluate the new child state;
         # terminal descends evaluate the terminal node's own state
@@ -319,7 +336,24 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             lambda a, b: jnp.where(
                 expanding.reshape((-1,) + (1,) * (a.ndim - 1)), a, b),
             new_states_b, parent_states)
-        priors, values = eval_batch(params_p, params_v, eval_states)
+        return SimStep(node=node, safe_action=safe_action,
+                       expanding=expanding, eval_states=eval_states)
+
+    def apply_sim(tree: DeviceTree, ctx: SimStep, priors,
+                  values) -> DeviceTree:
+        """WRITE + BACKUP half of one simulation: store the evaluated
+        leaf (where expanding & slab not full) and back ``values`` up
+        the path. ``(priors, values)`` must be the evaluation of
+        ``ctx.eval_states`` — from the in-search ``eval_batch`` or an
+        external (cross-game batching) evaluator; the two compose to
+        exactly the fused ``simulate``."""
+        node, safe_action = ctx.node, ctx.safe_action
+        # the written rows are exactly the expanding ones, where
+        # eval_states IS the stepped child (SimStep docstring)
+        expanding, new_states_b = ctx.expanding, ctx.eval_states
+        full = tree.n_nodes >= m
+        idx = jnp.where(expanding & ~full,
+                        jnp.minimum(tree.n_nodes, m - 1), 0)
 
         # write the new node (only where expanding & not full)
         write = expanding & ~full
@@ -362,6 +396,29 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
 
         return DeviceTree(states, prior, visits, value_sum, child,
                           parent, paction, n_nodes, tree.root)
+
+    def simulate(params_p, params_v, tree: DeviceTree,
+                 root_actions=None) -> DeviceTree:
+        """One lockstep simulation across the whole game batch —
+        :func:`prepare_sim` → :func:`eval_batch` → :func:`apply_sim`
+        fused into the caller's trace."""
+        if root_actions is None:
+            root_actions = jnp.full(
+                (tree.n_nodes.shape[0],), -1, jnp.int32)
+        ctx = prepare_sim(tree, root_actions)
+        priors, values = eval_batch(params_p, params_v,
+                                    ctx.eval_states)
+        return apply_sim(tree, ctx, priors, values)
+
+    def advance_sim(tree: DeviceTree, ctx: SimStep, priors, values,
+                    root_actions):
+        """Serving's steady-state program: APPLY this simulation and
+        PREPARE the next in ONE compiled call — halves the
+        per-simulation dispatch count of the split path and lets XLA
+        fuse the node write into the next descent's reads. Returns
+        ``(tree', ctx')``."""
+        tree = apply_sim(tree, ctx, priors, values)
+        return tree, prepare_sim(tree, root_actions)
 
     def _root_stats(tree: DeviceTree):
         idx = tree.root[:, None, None]
@@ -554,6 +611,18 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     search.root_stats = jax.jit(_root_stats)
     search.run_chunked = run_chunked
     search.simulate = simulate          # forced-root hook (Gumbel)
+    # injectable-evaluator surface (rocalphago_tpu/serve): the serving
+    # subsystem drives prepare_sim → [shared cross-game evaluator] →
+    # apply_sim per simulation, with eval_batch as the evaluator's
+    # compiled program (padded to a few fixed batch sizes). The fused
+    # paths above compose the SAME two halves around the in-trace
+    # eval, so the split path cannot drift from the fused one.
+    search.prepare_sim = jax.jit(prepare_sim)
+    search.apply_sim = jax.jit(apply_sim)
+    search.advance_sim = jax.jit(advance_sim)
+    search.assemble_tree = jax.jit(_assemble_tree)
+    search.eval_batch = jaxobs.track("device_mcts.eval_batch",
+                                     jax.jit(eval_batch))
     search.advance_root = advance_root  # subtree reuse across moves
     search.max_nodes = max_nodes        # the slab size actually built
     search.last_ran = None              # sims the last chunked run ran

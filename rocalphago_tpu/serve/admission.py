@@ -1,0 +1,120 @@
+"""Admission control for the serving pool: bounded queue, session cap.
+
+A serving process protects itself at two boundaries:
+
+* **sessions** — :meth:`AdmissionController.admit_session` refuses to
+  open a game past ``max_sessions`` (:class:`AdmissionError`; the
+  front end replies "try another replica" — the LB reads the live
+  count off the ``rocalphago-health`` probe);
+* **evaluation rows** — the shared evaluator's queue is bounded at
+  ``queue_rows`` pending leaf rows. A submit past the bound is SHED:
+  :class:`EvaluatorOverload` is raised back into the submitting
+  session, whose :class:`~rocalphago_tpu.interface.resilient.
+  ResilientPlayer` ladder steps it down (reason ``overload`` →
+  reduced-sims retry → raw policy move → rules fallback) — per-session
+  load-shedding instead of unbounded queueing, so a burst degrades
+  the burst's games gracefully rather than blowing every session's
+  latency SLO.
+
+Both decisions are counted (``serve_sheds_total{kind=}``,
+``serve_sessions_live``) so the probes and the load balancer see
+pressure before users do.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from rocalphago_tpu.obs import registry as obs_registry
+
+#: default cap on concurrently open sessions (env override)
+MAX_SESSIONS_ENV = "ROCALPHAGO_SERVE_MAX_SESSIONS"
+#: default bound on pending evaluation rows (env override)
+QUEUE_ROWS_ENV = "ROCALPHAGO_SERVE_QUEUE"
+
+
+class AdmissionError(RuntimeError):
+    """Session admission refused: the pool is at ``max_sessions``."""
+
+
+class EvaluatorOverload(OSError):
+    """The evaluator's bounded queue is full; this submit was shed.
+
+    An ``OSError`` so :func:`rocalphago_tpu.runtime.retries.
+    is_transient` classifies it transient (load passes; a cheaper
+    retry is safe), with ``degradation_reason`` naming the ladder's
+    reason code so sheds are visible as ``overload`` — not folded
+    into generic transient flake — in the health probe and metrics.
+    """
+
+    #: read by ``ResilientPlayer._classify``
+    degradation_reason = "overload"
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    return int(raw) if raw else default
+
+
+class AdmissionController:
+    """Thread-safe counters + bounds shared by pool and evaluator."""
+
+    def __init__(self, max_sessions: int | None = None,
+                 queue_rows: int | None = None):
+        self.max_sessions = (_env_int(MAX_SESSIONS_ENV, 256)
+                             if max_sessions is None else max_sessions)
+        self.queue_rows = (_env_int(QUEUE_ROWS_ENV, 1024)
+                           if queue_rows is None else queue_rows)
+        self._lock = threading.Lock()
+        self.live_sessions = 0
+        self.session_rejects = 0
+        self.queue_sheds = 0
+        self._live_g = obs_registry.gauge("serve_sessions_live")
+        self._shed_queue_c = obs_registry.counter(
+            "serve_sheds_total", kind="queue_full")
+        self._shed_sess_c = obs_registry.counter(
+            "serve_sheds_total", kind="session_reject")
+
+    # ------------------------------------------------------- sessions
+
+    def admit_session(self) -> None:
+        with self._lock:
+            if self.live_sessions >= self.max_sessions:
+                self.session_rejects += 1
+                self._shed_sess_c.inc()
+                raise AdmissionError(
+                    f"pool at capacity ({self.live_sessions}/"
+                    f"{self.max_sessions} sessions)")
+            self.live_sessions += 1
+            self._live_g.set(self.live_sessions)
+
+    def release_session(self) -> None:
+        with self._lock:
+            self.live_sessions = max(0, self.live_sessions - 1)
+            self._live_g.set(self.live_sessions)
+
+    # ---------------------------------------------------- eval queue
+
+    def admit_rows(self, pending_rows: int, rows: int) -> None:
+        """Raise :class:`EvaluatorOverload` (counted) when accepting
+        ``rows`` more pending evaluation rows would cross the bound.
+        Called under the evaluator's queue lock — pure check + count,
+        never blocks."""
+        if pending_rows + rows > self.queue_rows:
+            with self._lock:
+                self.queue_sheds += 1
+            self._shed_queue_c.inc()
+            raise EvaluatorOverload(
+                f"evaluator queue full ({pending_rows} pending + "
+                f"{rows} > {self.queue_rows} rows)")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "live_sessions": self.live_sessions,
+                "max_sessions": self.max_sessions,
+                "queue_rows": self.queue_rows,
+                "session_rejects": self.session_rejects,
+                "queue_sheds": self.queue_sheds,
+            }

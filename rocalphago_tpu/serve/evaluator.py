@@ -1,0 +1,338 @@
+"""The shared batching evaluator: cross-game leaf evaluation.
+
+One dispatcher thread owns ONE jit-compiled policy+value program
+(``search.eval_batch`` from :func:`rocalphago_tpu.search.device_mcts.
+make_device_mcts`) compiled at a few FIXED batch sizes. Sessions
+submit pending leaf states (typically one row per live search per
+simulation); the dispatcher coalesces whole requests across sessions
+into one device batch, pads to the nearest compiled size (padded rows
+replicate row 0 and are sliced off — per-row programs, so real rows
+are bit-independent of the padding; pinned by
+``tests/test_serve.py``), evaluates, and hands each request back its
+slice.
+
+Dispatch policy (docs/SERVING.md):
+
+* **fill target** — dispatch as soon as pending rows reach
+  ``min(max_batch, live sessions)``: every live search has at most
+  one leaf in flight, so a full convoy is the most that can ever
+  arrive and waiting past it is pure stall. With no admission
+  controller attached the target is ``max_batch``.
+* **max wait** — a partial batch is flushed when its OLDEST request
+  has waited ``max_wait_us`` (degraded sessions stop submitting; the
+  tail must not stall the fleet). ``ROCALPHAGO_SERVE_MAX_WAIT_US``
+  overrides the 500 µs default.
+* **bounded queue** — ``submit`` past the admission controller's
+  ``queue_rows`` bound sheds (:class:`~rocalphago_tpu.serve.
+  admission.EvaluatorOverload`) instead of queueing; the session's
+  resilience ladder absorbs it.
+
+A failed batch (injected fault at the ``serve.eval`` barrier, or a
+real device error) fails ONLY the requests in that batch — their
+futures carry the exception, the dispatcher loop survives, and every
+other session keeps being served (the soak test's core claim).
+
+Batch sizes default to ``1,8,32,128,256`` (clipped to the admission
+session cap); ``ROCALPHAGO_SERVE_BATCH_SIZES`` overrides with a
+comma list. Each size is one XLA program, compiled on first use (or
+ahead of time via ``ServePool.warm``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from rocalphago_tpu.obs import registry as obs_registry
+from rocalphago_tpu.runtime import faults
+
+MAX_WAIT_ENV = "ROCALPHAGO_SERVE_MAX_WAIT_US"
+BATCH_SIZES_ENV = "ROCALPHAGO_SERVE_BATCH_SIZES"
+
+#: batch-occupancy histogram edges (real rows / compiled size)
+OCC_EDGES = (0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+
+def default_batch_sizes(cap: int | None = None) -> tuple:
+    """The compiled-size ladder: env override or ``1,8,32,64,256``,
+    clipped to ``cap`` (the session cap — no point compiling a batch
+    no convoy can fill). ``cap`` itself joins the ladder: the full
+    convoy — every live session's leaf, the steady-state batch — must
+    be a compiled size, not padded up to one (a cap of 48 padded to
+    256 would waste 4× the eval)."""
+    raw = os.environ.get(BATCH_SIZES_ENV, "")
+    sizes = (tuple(int(s) for s in raw.split(",") if s.strip())
+             if raw else (1, 8, 32, 64, 256))
+    sizes = tuple(sorted(set(s for s in sizes if s > 0)))
+    if not sizes:
+        raise ValueError(f"no usable batch sizes in {raw!r}")
+    if cap is not None and cap >= sizes[0]:
+        sizes = tuple(sorted(
+            set(s for s in sizes if s <= cap) | {cap}))
+    return sizes
+
+
+class _Pending:
+    """A submitted evaluation request: rows in, a future out."""
+
+    __slots__ = ("states", "rows", "t_submit", "_event", "_result",
+                 "_exc")
+
+    def __init__(self, states, rows: int):
+        self.states = states
+        self.rows = rows
+        self.t_submit = time.monotonic()
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def _finish(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def result(self, timeout: float | None = None):
+        """Block for the batch containing this request; returns
+        ``(priors [rows, A], values [rows])`` or re-raises the
+        batch's failure. ``timeout`` (tests) raises TimeoutError."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"evaluation not served within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class BatchingEvaluator:
+    """Coalesce leaf-eval requests from many sessions into fixed-size
+    device batches (module docstring has the dispatch policy).
+
+    Parameters
+    ----------
+    eval_fn : ``(params_p, params_v, states[B]) -> (priors, values)``
+        — a jitted per-row program (``search.eval_batch``); one
+        compile per distinct padded size.
+    params_p, params_v : the weights, bound for the pool's lifetime.
+    batch_sizes : compiled-size ladder (default
+        :func:`default_batch_sizes`).
+    max_wait_us : partial-batch flush age (default env / 500 µs).
+    admission : optional :class:`~rocalphago_tpu.serve.admission.
+        AdmissionController` — provides the queue bound and the
+        live-session fill target.
+    start : tests pass False to drive/fill the queue by hand.
+    """
+
+    def __init__(self, eval_fn, params_p, params_v,
+                 batch_sizes=None, max_wait_us: float | None = None,
+                 admission=None, start: bool = True):
+        self._eval_fn = eval_fn
+        self._params_p = params_p
+        self._params_v = params_v
+        cap = admission.max_sessions if admission is not None else None
+        self.batch_sizes = (tuple(sorted(batch_sizes)) if batch_sizes
+                            else default_batch_sizes(cap))
+        self.max_batch = self.batch_sizes[-1]
+        if max_wait_us is None:
+            raw = os.environ.get(MAX_WAIT_ENV, "")
+            max_wait_us = float(raw) if raw else 500.0
+        self.max_wait_s = max_wait_us / 1e6
+        self.admission = admission
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._pending_rows = 0
+        self._stop = False
+        # dispatch accounting (stats() + the serve probes)
+        self.batches = 0
+        self.failures = 0
+        self.rows_total = 0
+        self.padded_total = 0
+        self._occ_h = obs_registry.histogram("serve_batch_occupancy",
+                                             edges=OCC_EDGES)
+        self._wait_h = obs_registry.histogram(
+            "serve_queue_wait_seconds")
+        self._rows_c = obs_registry.counter("serve_eval_rows_total")
+        self._fail_c = obs_registry.counter(
+            "serve_eval_failures_total")
+        self._depth_g = obs_registry.gauge("serve_queue_depth")
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-evaluator", daemon=True)
+        if start:
+            self._thread.start()
+
+    # ------------------------------------------------------- client
+
+    def submit(self, states, rows: int | None = None) -> _Pending:
+        """Enqueue a [rows]-batched GoState for evaluation. Raises
+        :class:`~rocalphago_tpu.serve.admission.EvaluatorOverload`
+        when the bounded queue is full (the shed path) — the caller's
+        resilience ladder owns what happens next."""
+        if rows is None:
+            rows = int(states.board.shape[0])
+        if rows > self.max_batch:
+            raise ValueError(
+                f"request of {rows} rows exceeds the largest "
+                f"compiled batch ({self.max_batch})")
+        req = _Pending(states, rows)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("evaluator is closed")
+            if self.admission is not None:
+                self.admission.admit_rows(self._pending_rows, rows)
+            self._queue.append(req)
+            self._pending_rows += rows
+            self._cond.notify_all()
+        return req
+
+    def evaluate(self, states, rows: int | None = None,
+                 timeout: float | None = None):
+        """Blocking submit: ``(priors, values)`` for ``states``."""
+        return self.submit(states, rows).result(timeout)
+
+    def eval_direct(self, states):
+        """Run the compiled eval program directly, bypassing the
+        queue — warmup (compile each ladder size ahead of traffic)
+        and the degraded paths that must not add queue load."""
+        return self._eval_fn(self._params_p, self._params_v, states)
+
+    # ---------------------------------------------------- dispatcher
+
+    def _fill_target(self) -> int:
+        live = (self.admission.live_sessions
+                if self.admission is not None else 0)
+        return min(self.max_batch, live) if live > 0 else \
+            self.max_batch
+
+    def _padded_size(self, rows: int) -> int:
+        for s in self.batch_sizes:
+            if s >= rows:
+                return s
+        return self.max_batch
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(0.1)
+                if self._stop and not self._queue:
+                    return
+                # dispatch policy: fill to target, else flush when
+                # the oldest request has aged out (close() can clear
+                # the queue under us — re-check it each wake)
+                while not self._stop and self._queue:
+                    if self._pending_rows >= self._fill_target():
+                        break
+                    age = time.monotonic() - self._queue[0].t_submit
+                    if age >= self.max_wait_s:
+                        break
+                    self._cond.wait(self.max_wait_s - age)
+                take, total = [], 0
+                while self._queue and (
+                        total + self._queue[0].rows <= self.max_batch):
+                    req = self._queue.popleft()
+                    take.append(req)
+                    total += req.rows
+                self._pending_rows -= total
+                depth = self._pending_rows
+            self._depth_g.set(depth)
+            if take:
+                self._dispatch(take, total)
+
+    def _dispatch(self, take: list, total: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        now = time.monotonic()
+        for req in take:
+            self._wait_h.observe(now - req.t_submit)
+        size = self._padded_size(total)
+        self.batches += 1
+        try:
+            # the soak tests' injection point: a fault here fails
+            # exactly this batch's requests, never the dispatcher
+            faults.barrier("serve.eval", iteration=self.batches)
+            states = take[0].states
+            if len(take) > 1:
+                states = jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs, axis=0),
+                    *[r.states for r in take])
+            if size > total:
+                # pad rows replicate row 0 (valid states, no NaN
+                # hazards) and are sliced off below — per-row
+                # programs make real rows independent of them
+                pad = size - total
+                states = jax.tree.map(
+                    lambda x: jnp.concatenate(
+                        [x, jnp.broadcast_to(
+                            x[:1], (pad,) + x.shape[1:])], axis=0),
+                    states)
+            priors, values = self.eval_direct(states)
+        except Exception as e:  # noqa: BLE001 — fail the batch, not
+            #                     the dispatcher (classified by the
+            #                     sessions' resilience ladders)
+            self.failures += 1
+            self._fail_c.inc()
+            for req in take:
+                req._fail(e)
+            return
+        self.rows_total += total
+        self.padded_total += size
+        self._rows_c.inc(total)
+        self._occ_h.observe(total / size)
+        obs_registry.counter("serve_eval_batches_total",
+                             size=str(size)).inc()
+        offset = 0
+        for req in take:
+            req._finish((priors[offset:offset + req.rows],
+                         values[offset:offset + req.rows]))
+            offset += req.rows
+
+    # ------------------------------------------------------ lifecycle
+
+    def drain_once(self) -> None:
+        """Tests (``start=False``): run one dispatch round inline."""
+        with self._cond:
+            take, total = [], 0
+            while self._queue and (
+                    total + self._queue[0].rows <= self.max_batch):
+                req = self._queue.popleft()
+                take.append(req)
+                total += req.rows
+            self._pending_rows -= total
+        if take:
+            self._dispatch(take, total)
+
+    def close(self) -> None:
+        """Stop the dispatcher; pending requests fail (closed)."""
+        with self._cond:
+            self._stop = True
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._pending_rows = 0
+            self._cond.notify_all()
+        for req in leftovers:
+            req._fail(RuntimeError("evaluator closed"))
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # ---------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Probe snapshot (`rocalphago-health`'s ``serve`` block)."""
+        with self._cond:
+            depth = self._pending_rows
+        return {
+            "batches": self.batches,
+            "rows": self.rows_total,
+            "failures": self.failures,
+            "queue_depth": depth,
+            "batch_occupancy": (
+                round(self.rows_total / self.padded_total, 4)
+                if self.padded_total else None),
+            "batch_sizes": list(self.batch_sizes),
+            "max_wait_us": round(self.max_wait_s * 1e6, 1),
+        }

@@ -54,7 +54,10 @@ def load_records(path: str, date: str, platform: str | None):
                    # encode A/B axes (bench_encode.py): every
                    # gating/phase1/impl side is its own row
                    r.get("gating"), r.get("phase1"),
-                   r.get("chase_impl"))
+                   r.get("chase_impl"),
+                   # serving sweep axes (bench_serve.py): each
+                   # session count × drive mode is its own row
+                   r.get("sessions"), r.get("mode"))
             prev = latest.get(key)
             if prev is None or str(r.get("date")) >= str(prev.get("date")):
                 latest[key] = r
@@ -65,7 +68,8 @@ def load_records(path: str, date: str, platform: str | None):
 
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
-                "vs_baseline", "mfu", "host_gap_frac", "us_per_pos"}
+                "vs_baseline", "mfu", "host_gap_frac", "us_per_pos",
+                "sessions"}
 
 
 def render_table(records) -> str:
@@ -78,10 +82,14 @@ def render_table(records) -> str:
     dispatch A/B; ``pipeline_depth`` in config names the side). The
     µs/pos column renders ``us_per_pos`` — the encode A/B's
     per-position cost (``benchmarks/bench_encode.py``), keyed by the
-    gating/phase1/impl fields that stay visible in config."""
+    gating/phase1/impl fields that stay visible in config. The
+    sessions column keys the serving sweep (``bench_serve.py``:
+    moves/sec vs concurrent-session count — read the batched-mode
+    rows top to bottom for the scaling curve; p50/p99/occupancy stay
+    in config)."""
     lines = ["| metric | value | unit | MFU | host gap | µs/pos "
-             "| config |",
-             "|---|---|---|---|---|---|---|"]
+             "| sessions | config |",
+             "|---|---|---|---|---|---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -93,9 +101,11 @@ def render_table(records) -> str:
         gap = "—" if gap in (None, "") else f"{100.0 * float(gap):.2f}%"
         upp = r.get("us_per_pos")
         upp = "—" if upp in (None, "") else f"{float(upp):g}"
+        sess = r.get("sessions")
+        sess = "—" if sess in (None, "") else str(sess)
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
                      f" | {r.get('unit', '?')} | {u} | {gap} | {upp}"
-                     f" | {cfg} |")
+                     f" | {sess} | {cfg} |")
     return "\n".join(lines)
 
 

@@ -57,6 +57,18 @@ cd "$(dirname "$0")/.."
 # sync-gap-strictly-higher A/B, the donation/retry refusal, and the
 # step-on-done no-op lemma; the deadline-overshoot-at-depth tests
 # live in tests/test_serving_chaos.py. See docs/PERFORMANCE.md.
+# Serving (tests/test_serve.py, tier-1): the cross-game batching
+# subsystem (rocalphago_tpu/serve; docs/SERVING.md) — evaluator
+# coalescing/max-wait/padding semantics (padded rows pinned
+# bit-ignored), bounded-queue sheds stepping the resilience ladder
+# down (reason `overload`), session admission caps, the probes'
+# serve block, and the multi-session SOAK under an installed fault
+# plan (one failed eval batch + one watchdog-abandoned hang; every
+# other session keeps being served). The split search path
+# (prepare_sim/advance_sim/apply_sim) is pinned bit-identical to the
+# fused search in tests/test_device_mcts.py, and the concurrent-emit
+# test in tests/test_obs.py pins the MetricsLogger/registry
+# thread-safety the many-session emit pattern relies on.
 # Static analysis (jaxlint, docs/STATIC_ANALYSIS.md): the JAX-aware
 # lint — donation reuse, retry-wrapping-donators, host syncs and
 # Python branches on tracers in jit bodies, PRNG key reuse,

@@ -118,7 +118,7 @@ STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
 preprocess chase_xla chase_pls encode_base encode_shared4 \
 encode_shared1 encode_shared2 encode_shared8 encode_split4 \
 encode_pallas encode_incr_seq encode_incr_batch encode_incr_selfplay \
-devmcts9 devmcts_gumbel selfplay16 \
+devmcts9 devmcts_gumbel serve_small serve_fleet selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
 train_trace preprocess_trace tournament headline_sized headline"
 n_steps=$(echo $STEPS | wc -w)
@@ -171,6 +171,16 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             encode_incr_selfplay) run encode_incr_selfplay env ROCALPHAGO_ENCODE_INCR=1 python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;
             devmcts9)    run devmcts9    python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
             devmcts_gumbel) run devmcts_gumbel python benchmarks/bench_device_mcts.py --board 9 --sims 32 --gumbel --reps 2 ;;
+            # serve_*: the cross-game serving sweep (bench_serve.py;
+            # docs/SERVING.md) — aggregate moves/sec + p99 genmove
+            # latency vs concurrent sessions, batched evaluator vs
+            # the per-session unbatched A/B. Split small/fleet so a
+            # short window still banks the decidable low-count pair;
+            # serve_fleet is the 64→256 continuation the 1-core CPU
+            # host saturates out of; the threaded latency arm is
+            # host-bound, skip on chip time.
+            serve_small) run serve_small python benchmarks/bench_serve.py --sessions 1,8 --reps 2 --skip-threaded ;;
+            serve_fleet) run serve_fleet python benchmarks/bench_serve.py --sessions 64,256 --reps 2 --skip-threaded ;;
             bisect)      run bisect      python scripts/tpu_crash_bisect.py --log "$LOG/bisect.jsonl" ;;
             selfplay16)  run selfplay16  python benchmarks/bench_selfplay.py --batch-sweep 16 --reps 2 ;;
             selfplay64)  run selfplay64  python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;

@@ -268,23 +268,38 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
                     "phase1": 4, "chase_impl": "xla",
                     "us_per_pos": 246.8,
                     "date": "2026-07-31T01:05:00"}),   # distinct gating
+        json.dumps({"metric": "serve_moves_per_s", "value": 88.0,
+                    "unit": "moves/s", "platform": "tpu",
+                    "sessions": 8, "mode": "batched",
+                    "date": "2026-07-31T01:00:00"}),   # serving sweep
+        json.dumps({"metric": "serve_moves_per_s", "value": 120.0,
+                    "unit": "moves/s", "platform": "tpu",
+                    "sessions": 64, "mode": "batched",
+                    "date": "2026-07-31T01:00:00"}),   # distinct count
     ]) + "\n")
     recs = bench_report.load_records(str(log), "2026-07-31", "tpu")
-    # pipeline_depth (and the encode gating/phase1/impl axes) are part
-    # of the config key: each A/B side is a distinct row, not a newer
-    # duplicate of its sibling
+    # pipeline_depth (and the encode gating/phase1/impl axes, and the
+    # serving sessions×mode axes) are part of the config key: each
+    # A/B side is a distinct row, not a newer duplicate of its sibling
     assert sorted((r["value"], r.get("batch")) for r in recs) \
-        == [(2.0, 64), (3.0, 64), (9.0, 256), (50.0, 16), (100.0, 16)]
+        == [(2.0, 64), (3.0, 64), (9.0, 256), (50.0, 16), (88.0, None),
+            (100.0, 16), (120.0, None)]
     table = bench_report.render_table(recs)
-    # MFU / host-gap / µs-per-pos columns: '—' when a record has
-    # none, the value when it does
-    assert "| m | 2.0 | u | — | — | — | batch=64 |" in table
-    assert "| m | 9.0 | u | 12.3% | — | — | batch=256 |" in table
-    assert ("| m | 3.0 | u | — | 4.21% | — | "
+    # MFU / host-gap / µs-per-pos / sessions columns: '—' when a
+    # record has none, the value when it does
+    assert "| m | 2.0 | u | — | — | — | — | batch=64 |" in table
+    assert "| m | 9.0 | u | 12.3% | — | — | — | batch=256 |" in table
+    assert ("| m | 3.0 | u | — | 4.21% | — | — | "
             "batch=64, pipeline_depth=1 |" in table)
-    assert ("| encode_ab | 100.0 | u | — | — | 123.4 | "
+    assert ("| encode_ab | 100.0 | u | — | — | 123.4 | — | "
             "batch=16, chase_impl=xla, gating=shared, phase1=4 |"
             in table)
+    # the serving sweep keys by session count: both rows survive and
+    # the sessions column carries the count (moves/sec-vs-sessions)
+    assert ("| serve_moves_per_s | 88.0 | moves/s | — | — | — | 8 | "
+            "mode=batched |" in table)
+    assert ("| serve_moves_per_s | 120.0 | moves/s | — | — | — | 64 |"
+            " mode=batched |" in table)
 
     probe = tmp_path / "probe.log"
     probe.write_text(

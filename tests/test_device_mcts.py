@@ -96,6 +96,29 @@ def test_chunked_sims_equal_monolithic(searcher):
     np.testing.assert_array_equal(q_mono, q_chunk)
 
 
+def test_split_sim_path_matches_fused(searcher):
+    """The serving seam (prepare_sim → eval_batch → apply_sim, the
+    cross-game-batching drive in ``rocalphago_tpu/serve``) must be
+    the fused search exactly: same halves, same eval program, so a
+    pooled session's visits are bit-identical to run_sims."""
+    roots = new_states(CFG, 2)
+    tree_f = searcher.init(None, None, roots)
+    tree_f = searcher.run_sims(None, None, tree_f, k=12)
+    v_f, q_f = jax.device_get(searcher.root_stats(tree_f))
+
+    priors0, _ = searcher.eval_batch(None, None, roots)
+    tree_s = searcher.assemble_tree(roots, priors0)
+    free = jnp.full((2,), -1, jnp.int32)
+    for _ in range(12):
+        ctx = searcher.prepare_sim(tree_s, free)
+        priors, values = searcher.eval_batch(None, None,
+                                             ctx.eval_states)
+        tree_s = searcher.apply_sim(tree_s, ctx, priors, values)
+    v_s, q_s = jax.device_get(searcher.root_stats(tree_s))
+    np.testing.assert_array_equal(v_f, v_s)
+    np.testing.assert_array_equal(q_f, q_s)
+
+
 def test_capacity_bound_keeps_searching():
     """A full slab must stop allocating but keep evaluating — visit
     counts still total n_sim and nothing crashes."""

@@ -230,6 +230,54 @@ def test_metrics_logger_write_is_file_only(tmp_path, capsys):
     assert [r["event"] for r in _records(path)] == ["span", "loud"]
 
 
+def test_concurrent_emit_from_many_sessions(tmp_path):
+    """The serving pool's emit pattern — N session threads
+    interleaving logger events with registry counter/histogram
+    updates through ONE MetricsLogger — must lose nothing and tear
+    nothing: every line strict-parses, counts are exact, and the
+    histogram saw every observation (the thread-safety satellite of
+    the serve PR; registry audit in obs/registry.py's docstring)."""
+    import threading
+
+    from rocalphago_tpu.obs import registry
+
+    n_threads, n_events = 8, 150
+    path = tmp_path / "m.jsonl"
+    reg = registry.Registry()
+    c = reg.counter("emit_total")
+    h = reg.histogram("emit_seconds")
+    with MetricsLogger(str(path), echo=False) as log:
+        ready = threading.Barrier(n_threads)
+
+        def emit(tid):
+            ready.wait()
+            for i in range(n_events):
+                log.write("span", tid=tid, i=i)
+                log.log("degradation", tid=tid, i=i, rung="policy")
+                c.inc()
+                h.observe(0.001 * (i % 7))
+
+        threads = [threading.Thread(target=emit, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    lines = path.read_text().splitlines()
+    assert len(lines) == n_threads * n_events * 2
+
+    def reject(const):
+        raise ValueError(f"bare {const}")
+
+    recs = [json.loads(ln, parse_constant=reject) for ln in lines]
+    per_thread = {}
+    for r in recs:
+        per_thread.setdefault(r["tid"], []).append(r)
+    assert all(len(v) == n_events * 2 for v in per_thread.values())
+    assert c.value == n_threads * n_events
+    assert h.snapshot()["count"] == n_threads * n_events
+
+
 # --------------------------------------------- jaxobs compile track
 
 def test_compile_tracking_first_vs_second_call(tmp_path):

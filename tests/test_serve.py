@@ -1,0 +1,360 @@
+"""Serving subsystem (``rocalphago_tpu/serve``): the cross-game
+batching evaluator, session pool, admission control, and the soak
+proof that one session's failure never stalls the shared evaluator.
+
+Fast tier (all of this file): the batcher's dispatch policy
+(coalescing across sessions, max-wait flush of a partial batch,
+pad-to-compiled-size with padded rows bit-ignored), bounded-queue
+rejection stepping the resilience ladder down (reason ``overload``),
+session admission caps, the GTP probes' ``serve`` block, and a
+multi-session soak under an installed fault plan (one transient
+evaluator fault + one hung session abandoned by the watchdog while
+every other session keeps being served).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.interface.gtp import GTPEngine
+from rocalphago_tpu.interface.resilient import ResilientPlayer
+from rocalphago_tpu.io.metrics import MetricsLogger
+from rocalphago_tpu.runtime import faults
+from rocalphago_tpu.runtime.faults import InjectedFault
+from rocalphago_tpu.runtime.jsonl import read_jsonl
+from rocalphago_tpu.serve import (
+    AdmissionController,
+    AdmissionError,
+    BatchingEvaluator,
+    EvaluatorOverload,
+    ServePool,
+)
+
+SIZE = 5
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    """Tests install plans programmatically; always restore the
+    env-derived (empty) plan afterwards."""
+    yield
+    faults.install(None)
+
+
+@pytest.fixture(scope="module")
+def nets():
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+
+    pol = CNNPolicy(("board", "ones"), board=SIZE, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(("board", "ones", "color"), board=SIZE, layers=1,
+                   filters_per_layer=2)
+    return pol, val
+
+
+@pytest.fixture(scope="module")
+def pool(nets):
+    """One warm 5×5 pool shared by the module (XLA compiles
+    dominate); tests open/close their own sessions and read stat
+    DELTAS, never absolute process-wide counters."""
+    pol, val = nets
+    p = ServePool(val, pol, n_sim=6, max_sessions=4,
+                  batch_sizes=(1, 2, 4), max_wait_us=2000)
+    p.warm()
+    yield p
+    p.close()
+
+
+def _states(cfg, batch):
+    from rocalphago_tpu.engine.jaxgo import new_states
+
+    return new_states(cfg, batch)
+
+
+# ------------------------------------------------------------ batcher
+
+def test_evaluator_coalesces_across_sessions(pool):
+    """Concurrent submits from several threads land in ONE device
+    batch (the tentpole economics): a generous max-wait evaluator
+    sharing the pool's compiled program serves three 1-row requests
+    as a single padded-4 dispatch."""
+    ev = BatchingEvaluator(
+        pool.search.eval_batch, pool.policy.params, pool.value.params,
+        batch_sizes=(1, 2, 4), max_wait_us=200_000)
+    try:
+        results, ready = [None] * 3, threading.Barrier(3)
+
+        def client(i):
+            st = _states(pool.cfg, 1)
+            ready.wait()
+            results[i] = ev.evaluate(st)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert ev.batches == 1, (
+            f"3 concurrent 1-row submits took {ev.batches} batches")
+        assert ev.rows_total == 3 and ev.padded_total == 4
+        for priors, values in results:
+            assert priors.shape == (1, SIZE * SIZE + 1)
+            assert values.shape == (1,)
+    finally:
+        ev.close()
+
+
+def test_max_wait_flushes_partial_batch(pool):
+    """A lone pending request must not wait for a batch that will
+    never fill: the max-wait clock flushes it."""
+    ev = BatchingEvaluator(
+        pool.search.eval_batch, pool.policy.params, pool.value.params,
+        batch_sizes=(1, 2, 4), max_wait_us=1000)
+    try:
+        t0 = time.monotonic()
+        priors, values = ev.evaluate(_states(pool.cfg, 1), timeout=10)
+        dt = time.monotonic() - t0
+        assert priors.shape[0] == 1 and values.shape[0] == 1
+        assert dt < 5.0, f"1-row flush took {dt:.2f}s"
+        assert ev.batches == 1 and ev.rows_total == 1
+        assert ev.padded_total == 1        # padded to compiled size 1
+    finally:
+        ev.close()
+
+
+def test_padded_rows_are_bit_ignored(pool):
+    """Pad-to-compiled-size correctness: the eval program is per-row,
+    so a real row's output is bit-identical whatever the pad rows
+    contain — and the evaluator's padded answer equals the direct
+    program's, sliced."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = pool.cfg
+    real = _states(cfg, 2)
+    # two distinguishable real rows: play a stone in row 1
+    from rocalphago_tpu.engine.jaxgo import step
+
+    real = jax.tree.map(
+        lambda a, b: jnp.concatenate([a[:1], b[:1]]), real,
+        jax.vmap(lambda s: step(cfg, s, jnp.int32(7)))(real))
+
+    def padded_with(pad_states):
+        return jax.tree.map(
+            lambda r, p: jnp.concatenate([r, p[:2]]), real, pad_states)
+
+    pad_a = padded_with(jax.tree.map(           # row-0 replicas
+        lambda x: jnp.broadcast_to(x[:1], (2,) + x.shape[1:]), real))
+    pad_b = padded_with(_states(cfg, 2))        # fresh empty states
+    pa, va = pool.evaluator.eval_direct(pad_a)
+    pb, vb = pool.evaluator.eval_direct(pad_b)
+    np.testing.assert_array_equal(np.asarray(pa[:2]),
+                                  np.asarray(pb[:2]))
+    np.testing.assert_array_equal(np.asarray(va[:2]),
+                                  np.asarray(vb[:2]))
+    # the queue path pads exactly like pad_a (row-0 replicas)
+    pq, vq = pool.evaluator.evaluate(real)
+    np.testing.assert_array_equal(np.asarray(pq),
+                                  np.asarray(pa[:2]))
+    np.testing.assert_array_equal(np.asarray(vq),
+                                  np.asarray(va[:2]))
+
+
+def test_bounded_queue_sheds_past_the_row_bound(pool):
+    """Submits past ``queue_rows`` raise EvaluatorOverload (counted);
+    the queued requests still get served."""
+    adm = AdmissionController(max_sessions=4, queue_rows=2)
+    ev = BatchingEvaluator(
+        pool.search.eval_batch, pool.policy.params, pool.value.params,
+        batch_sizes=(1, 2, 4), admission=adm, start=False)
+    r1 = ev.submit(_states(pool.cfg, 1))
+    r2 = ev.submit(_states(pool.cfg, 1))
+    with pytest.raises(EvaluatorOverload):
+        ev.submit(_states(pool.cfg, 1))
+    assert adm.queue_sheds == 1
+    ev.drain_once()
+    for r in (r1, r2):
+        priors, values = r.result(timeout=10)
+        assert priors.shape[0] == 1
+    ev.close()
+
+
+# ------------------------------------------------- ladder step-down
+
+class _OverloadThenServe:
+    """Primary that sheds on its first call, then serves — the
+    ladder's overload → reduced-retry success path."""
+
+    n_sim = 8
+
+    def __init__(self):
+        self.sim_limit = None
+        self.limits_seen = []
+
+    def get_move(self, state):
+        self.limits_seen.append(self.sim_limit)
+        if len(self.limits_seen) == 1:
+            raise EvaluatorOverload("queue full")
+        moves = state.get_legal_moves(include_eyes=False)
+        return moves[0] if moves else None
+
+
+def test_overload_reason_steps_down_to_reduced():
+    primary = _OverloadThenServe()
+    ladder = ResilientPlayer(primary)
+    st = pygo.GameState(size=SIZE)
+    mv = ladder.get_move(st)
+    assert mv is not None and st.is_legal(mv)
+    assert ladder.last_rung == "reduced"
+    assert ladder.reasons.get("overload") == 1
+    # the reduced rung really capped the budget (n_sim // 4)
+    assert primary.limits_seen == [None, 2]
+
+
+def test_overloaded_pool_degrades_to_policy_rung(pool):
+    """queue_rows=0 sheds every leaf eval: search and reduced rungs
+    both overload, the raw-policy rung (no evaluator) serves."""
+    sess = pool.open_session()
+    bound = pool.admission.queue_rows
+    sheds0 = pool.admission.queue_sheds
+    try:
+        pool.admission.queue_rows = 0
+        st = pygo.GameState(size=SIZE)
+        mv = sess.get_move(st)
+        assert mv is not None and st.is_legal(mv)
+        assert sess.player.last_rung == "policy"
+        assert sess.player.reasons.get("overload", 0) >= 2
+        assert pool.admission.queue_sheds > sheds0
+    finally:
+        pool.admission.queue_rows = bound
+        sess.close()
+
+
+# ------------------------------------------------------- admission
+
+def test_session_admission_cap(pool):
+    sessions = [pool.open_session() for _ in range(4)]
+    try:
+        with pytest.raises(AdmissionError):
+            pool.open_session()
+        assert pool.admission.session_rejects == 1
+    finally:
+        sessions[0].close()
+    try:
+        extra = pool.open_session()      # freed slot admits again
+        extra.close()
+    finally:
+        for s in sessions[1:]:
+            s.close()
+    assert pool.admission.live_sessions == 0
+
+
+# ----------------------------------------------------- GTP probes
+
+def test_probes_carry_serve_fields(pool):
+    """`rocalphago-health`/`rocalphago-stats` expose the pool block —
+    live sessions, queue depth, batch occupancy, sheds — the LB
+    health-check schema (docs/SERVING.md)."""
+    sess = pool.open_session()
+    try:
+        engine = GTPEngine(sess.player, serve_pool=pool)
+        reply, _ = engine.handle("genmove b")
+        assert reply.startswith("=")
+        health = json.loads(engine.cmd_rocalphago_health([]))
+        serve = health["serve"]
+        assert serve["sessions"]["live"] == 1
+        assert serve["sessions"]["max"] == 4
+        assert "depth" in serve["queue"]
+        assert "sheds" in serve["queue"]
+        assert 0 < serve["evaluator"]["batch_occupancy"] <= 1
+        assert serve["warmed"] is True
+        stats = json.loads(engine.cmd_rocalphago_stats([]))
+        assert stats["serve"]["evaluator"]["rows"] >= 7  # root + sims
+        # pool discovery also works without the explicit handle
+        # (SessionPlayer.pool via the resilient wrapper's primary)
+        engine2 = GTPEngine(sess.player)
+        health2 = json.loads(engine2.cmd_rocalphago_health([]))
+        assert health2["serve"]["sessions"]["live"] == 1
+    finally:
+        sess.close()
+
+
+# ------------------------------------------------------------- soak
+
+def test_soak_faults_and_hang_do_not_stall_the_evaluator(pool,
+                                                         tmp_path):
+    """The satellite soak: three concurrent sessions under a fault
+    plan injecting (1) one transient evaluator fault — failing
+    exactly one batch, whose sessions step down and retry — and
+    (2) one 1.5 s hang inside one session's search rung, abandoned
+    by that session's watchdog at 0.4 s. Every session finishes all
+    its moves with legal vertices, exactly one session records the
+    hang, and the shared evaluator keeps serving throughout and
+    after."""
+    metrics_path = tmp_path / "metrics.jsonl"
+    metrics = MetricsLogger(str(metrics_path), echo=False)
+    sessions = [pool.open_session() for _ in range(3)]
+    for s in sessions:
+        s.player.hang_timeout_s = 0.4
+        s.player.metrics = metrics
+    faults.install(
+        "io_error@serve.eval:5,sleep@iter2.serve.search=1.5")
+    fails0 = pool.evaluator.failures
+    moves_per_session = 3
+    games = [pygo.GameState(size=SIZE) for _ in sessions]
+    errors: list = []
+
+    def play(sess, game):
+        try:
+            for _ in range(moves_per_session):
+                mv = sess.get_move(game)
+                assert mv is None or game.is_legal(mv)
+                game.do_move(mv)
+        except Exception as e:  # noqa: BLE001 — must not happen
+            errors.append(e)
+
+    threads = [threading.Thread(target=play, args=(s, g))
+               for s, g in zip(sessions, games)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    wall = time.monotonic() - t0
+    faults.install(None)
+    try:
+        assert not errors, f"session raised: {errors!r}"
+        assert all(not t.is_alive() for t in threads)
+        # every session served every move
+        assert all(g.turns_played == moves_per_session
+                   for g in games)
+        # exactly one batch failed, and only its sessions degraded
+        # for it (transient InjectedFault → reduced retry)
+        assert pool.evaluator.failures == fails0 + 1
+        # exactly one session was abandoned as hung — the watchdog
+        # touched nobody else
+        hangs = [s.player.reasons.get("hang", 0) for s in sessions]
+        assert sorted(hangs) == [0, 0, 1], hangs
+        # the 1.5 s sleeper did not serialize the fleet: the ladder
+        # abandoned it at 0.4 s and the other sessions kept moving
+        assert wall < 60, f"soak took {wall:.1f}s"
+        # the shared evaluator survived both faults
+        out = pool.evaluator.evaluate(_states(pool.cfg, 1),
+                                      timeout=10)
+        assert out[0].shape[0] == 1
+        # degradations are on the shared metrics stream, every line
+        # parseable (the thread-safety satellite's integration face)
+        metrics.close()
+        events = list(read_jsonl(str(metrics_path)))
+        kinds = {e.get("reason") for e in events
+                 if e.get("event") == "degradation"}
+        assert "hang" in kinds
+        assert "transient_error" in kinds
+    finally:
+        for s in sessions:
+            s.close()
