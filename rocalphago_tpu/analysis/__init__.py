@@ -5,20 +5,25 @@ buffer donation (a donated array must never be read again — PR 4's
 `donates_buffers` discipline), jit-boundary purity (no host syncs or
 Python control flow on tracers inside compiled bodies), PRNG key
 hygiene (never consume the same key twice), retrace discipline
-(static arguments must be hashable and low-cardinality), and the
+(static arguments must be hashable and low-cardinality), the
 documented observability/resilience inventories (every metric, span,
-fault barrier and ``ROCALPHAGO_*`` env knob is contract, not
-incidental string). Each of these has cost a debugging cycle when
-violated; none is caught by the type system or the test suite until
-the bad path actually runs.
+fault barrier, serve-probe field and ``ROCALPHAGO_*`` env knob is
+contract, not incidental string), and the threaded serve stack's
+lock discipline (``# guarded-by:`` annotations, a cycle-free
+lock-acquisition graph — docs/CONCURRENCY.md). Each of these has
+cost a debugging cycle when violated; none is caught by the type
+system or the test suite until the bad path actually runs.
 
 This package proves them *before* code runs: an AST-based rule
-framework (:mod:`.core`), five rule families (:mod:`.rules`), a
+framework (:mod:`.core`), six rule families (:mod:`.rules`), a
 committed baseline for grandfathered findings (:mod:`.baseline`),
 per-line suppression comments, and text/JSON reporters
 (:mod:`.reporters`). ``scripts/lint.py`` is the CLI; the self-lint
 test in ``tests/test_jaxlint.py`` keeps the shipped tree clean in
-tier-1. See docs/STATIC_ANALYSIS.md for the rule catalog and the
+tier-1. The concurrency model is also checked at RUNTIME by
+:mod:`.lockcheck` (``ROCALPHAGO_LOCKCHECK=1`` instrumented locks,
+observed-vs-static graph reconciliation in the serve soak). See
+docs/STATIC_ANALYSIS.md for the rule catalog and the
 suppression/baseline workflow.
 
 Stdlib-only by design (``ast`` + ``re`` + ``json``): the linter must

@@ -37,7 +37,11 @@ class LintConfig:
     docs_observability: str = "docs/OBSERVABILITY.md"
     docs_resilience: str = "docs/RESILIENCE.md"
     docs_knobs: str = "docs/KNOBS.md"
+    docs_serving: str = "docs/SERVING.md"
     report_modules: tuple = ("scripts/obs_report.py",)
+    #: module whose ``ServePool.stats`` dict is the serve-probe
+    #: block producer (diffed against docs_serving's JSON schema)
+    serve_probe_module: str = "rocalphago_tpu/serve/sessions.py"
 
 
 _KEY_MAP = {
@@ -46,7 +50,9 @@ _KEY_MAP = {
     "docs.observability": "docs_observability",
     "docs.resilience": "docs_resilience",
     "docs.knobs": "docs_knobs",
+    "docs.serving": "docs_serving",
     "report_modules": "report_modules",
+    "serve_probe_module": "serve_probe_module",
 }
 
 

@@ -100,12 +100,20 @@ class ModuleInfo:
 #: ``ctx.modules``.
 MODULE_RULES: dict = {}
 PROJECT_RULES: dict = {}
+#: rule id -> family name (the defining rules/ module: "donation",
+#: "concurrency", ...) — lets the CLI's --rules accept a whole family
+RULE_FAMILIES: dict = {}
+
+
+def _family_of(fn) -> str:
+    return fn.__module__.rsplit(".", 1)[-1]
 
 
 def module_rule(rule_id: str, summary: str):
     def deco(fn):
         assert rule_id not in MODULE_RULES and rule_id not in PROJECT_RULES
         MODULE_RULES[rule_id] = (fn, summary)
+        RULE_FAMILIES[rule_id] = _family_of(fn)
         fn.rule_id = rule_id
         return fn
     return deco
@@ -115,9 +123,27 @@ def project_rule(rule_id: str, summary: str):
     def deco(fn):
         assert rule_id not in MODULE_RULES and rule_id not in PROJECT_RULES
         PROJECT_RULES[rule_id] = (fn, summary)
+        RULE_FAMILIES[rule_id] = _family_of(fn)
         fn.rule_id = rule_id
         return fn
     return deco
+
+
+def expand_rule_names(names) -> set[str]:
+    """Resolve a mix of rule ids and family names ("concurrency",
+    "donation", …) to rule ids; unknown tokens pass through so the
+    CLI can report them."""
+    _load_rules()
+    out: set[str] = set()
+    families: dict[str, set] = {}
+    for rid, fam in RULE_FAMILIES.items():
+        families.setdefault(fam, set()).add(rid)
+    for name in names:
+        if name in families:
+            out |= families[name]
+        else:
+            out.add(name)
+    return out
 
 
 def _load_rules() -> None:

@@ -6,7 +6,9 @@ walk order is not evaluation order (``carry = f(carry)`` evaluates
 the RHS — including the argument read — before the store), so this
 module flattens each scope into a list of ``read`` / ``write`` /
 ``call`` events in evaluation order, with loop extents recorded so a
-rule can reason about "the next iteration touches it again".
+rule can reason about "the next iteration touches it again", and
+``with`` extents (context names + event ranges) so the concurrency
+family can reason about "this call happens while that lock is held".
 
 Approximations (deliberate, baseline-absorbable): ``if``/``else``
 arms are concatenated linearly; ``try`` flows linearly; nested
@@ -35,6 +37,18 @@ class ScopeEvents:
     scope: ast.AST            # FunctionDef or Module
     events: list
     loops: list               # (start_idx, end_idx) per loop, any order
+    #: (ctx_names tuple, start_idx, end_idx, With node) per ``with``
+    #: statement — ctx_names are the dotted context expressions
+    #: (``self._lock``; a Call context contributes its callee name).
+    #: The concurrency family reads lock-held extents off these.
+    withs: list = dataclasses.field(default_factory=list)
+
+    def enclosing_withs(self, i: int):
+        """Every with-extent containing event index ``i``, outermost
+        first (list of (ctx_names, start, end, node))."""
+        hits = [w for w in self.withs if w[1] <= i < w[2]]
+        hits.sort(key=lambda w: w[1])
+        return hits
 
     def enclosing_loop(self, i: int):
         """Innermost loop range containing event index ``i``."""
@@ -49,6 +63,7 @@ class _Walker:
     def __init__(self):
         self.events: list = []
         self.loops: list = []
+        self.withs: list = []
 
     # -- expressions (reads, calls) ----------------------------------
     def expr(self, node) -> None:
@@ -126,11 +141,18 @@ class _Walker:
             self.stmts(st.body)
             self.stmts(st.orelse)
         elif isinstance(st, (ast.With, ast.AsyncWith)):
+            ctx_names = []
             for item in st.items:
                 self.expr(item.context_expr)
+                name = _ctx_name(item.context_expr)
+                if name:
+                    ctx_names.append(name)
                 if item.optional_vars is not None:
                     self.write_target(item.optional_vars, None)
+            start = len(self.events)
             self.stmts(st.body)
+            self.withs.append((tuple(ctx_names), start,
+                               len(self.events), st))
         elif isinstance(st, ast.Try):
             self.stmts(st.body)
             for h in st.handlers:
@@ -160,12 +182,26 @@ def dotted_callee(value) -> str | None:
     return None
 
 
+def _ctx_name(expr) -> str | None:
+    """Dotted name of a ``with`` context expression: ``self._lock``
+    directly, or the callee of a Call context (``span("x")`` →
+    ``span``)."""
+    from rocalphago_tpu.analysis.jaxmodel import dotted
+    name = dotted(expr)
+    if name is not None:
+        return name
+    if isinstance(expr, ast.Call):
+        return dotted(expr.func)
+    return None
+
+
 def scope_events(scope) -> ScopeEvents:
     """Flatten one scope (FunctionDef body or Module body) into
     evaluation-order events."""
     w = _Walker()
     w.stmts(scope.body)
-    return ScopeEvents(scope=scope, events=w.events, loops=w.loops)
+    return ScopeEvents(scope=scope, events=w.events, loops=w.loops,
+                       withs=w.withs)
 
 
 def iter_scopes(tree):

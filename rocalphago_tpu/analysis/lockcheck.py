@@ -1,0 +1,316 @@
+"""Runtime lock-order harness: the dynamic half of the concurrency
+model.
+
+The static family (:mod:`.rules.concurrency`) proves the DECLARED
+lock model — guarded attributes, a cycle-free acquisition graph —
+without running anything. This module checks the OBSERVED behavior
+against the same model: instrumented ``Lock``/``RLock``/``Condition``
+wrappers record per-thread held-sets, build the observed lock-order
+graph edge by edge, and raise :class:`LockOrderInversion` the moment
+an acquisition closes a cycle (the deadlock that would otherwise
+need the right interleaving to fire). A ``Condition.wait`` while the
+thread still holds ANOTHER checked lock raises
+:class:`BlockingUnderLock` — the runtime form of the
+``blocking-call-under-lock`` rule.
+
+Enabled by ``ROCALPHAGO_LOCKCHECK=1`` (off = the factories return
+plain ``threading`` primitives; zero overhead). The serve stack,
+``MetricsLogger``, and the trace/native module locks construct
+through :func:`make_lock`/:func:`make_rlock`/:func:`make_condition`,
+each passing a SITE label equal to its static lock identity
+(``BatchingEvaluator._cond``, ``ServePool._lock``, ``trace._lock``
+…), so :func:`observed_edges` and the static graph from
+:func:`rocalphago_tpu.analysis.rules.concurrency.build_lock_graph`
+speak the same names. The reconciliation test
+(``tests/test_lockcheck.py``) runs the PR-8 serve soak under the
+harness and asserts every observed edge exists in the static graph —
+an observed edge the model lacks means the model (or the resolver)
+is wrong, not the code.
+
+Two metrics land in the existing obs registry per site:
+``lock_wait_seconds{site=}`` (acquire wait when the lock was
+contended) and ``lock_contention_total{site=}`` (count of contended
+acquires). The registry's own internals stay UN-instrumented — the
+sink of these metrics cannot be self-instrumented without recursing
+(the same reason the inventory family's ``PRODUCER_EXCLUDE`` lists
+the registry module).
+
+Stdlib-only, like the rest of :mod:`rocalphago_tpu.analysis`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+LOCKCHECK_ENV = "ROCALPHAGO_LOCKCHECK"
+
+
+def enabled() -> bool:
+    return os.environ.get(LOCKCHECK_ENV, "") not in ("", "0")
+
+
+class LockOrderInversion(RuntimeError):
+    """An acquisition closed a cycle in the observed lock-order
+    graph: some other code path takes these locks in the opposite
+    order, so the right interleaving deadlocks."""
+
+
+class BlockingUnderLock(RuntimeError):
+    """A blocking wait ran while the thread held another checked
+    lock — every thread needing that lock stalls behind the wait."""
+
+
+# ------------------------------------------------------- observed graph
+
+_state_lock = threading.Lock()
+_edges: dict = {}          # (from_site, to_site) -> count  # guarded-by: _state_lock
+_tls = threading.local()
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def held_sites() -> tuple:
+    """The calling thread's currently held checked-lock sites, in
+    acquisition order (RLock reentries collapsed)."""
+    out = []
+    for site in _held_stack():
+        if site not in out:
+            out.append(site)
+    return tuple(out)
+
+
+def observed_edges() -> set:
+    """Every (held_site, acquired_site) pair observed so far — the
+    runtime acquisition graph the reconciliation test diffs against
+    the static one."""
+    with _state_lock:
+        return set(_edges)
+
+
+def reset() -> None:
+    """Drop the observed graph (tests)."""
+    with _state_lock:
+        _edges.clear()
+
+
+def _reaches(src: str, dst: str, edges) -> list | None:
+    """DFS path src → dst over ``edges`` keys; returns the path as a
+    list of sites or None. Called under ``_state_lock``."""
+    adj: dict = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    stack = [(src, [src])]
+    seen = set()
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        if node in seen:
+            continue
+        seen.add(node)
+        for nxt in adj.get(node, ()):
+            stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquired(site: str) -> None:
+    stack = _held_stack()
+    cycle = None
+    if site not in stack:
+        new = [(h, site) for h in dict.fromkeys(stack)]
+        if new:
+            with _state_lock:
+                for edge in new:
+                    fresh = edge not in _edges
+                    _edges[edge] = _edges.get(edge, 0) + 1
+                    if fresh and cycle is None:
+                        back = _reaches(site, edge[0], _edges)
+                        if back is not None:
+                            cycle = back + [site]
+    stack.append(site)
+    if cycle is not None:
+        raise LockOrderInversion(
+            f"acquiring '{site}' while holding {held_sites()[:-1]} "
+            f"closes the cycle {' -> '.join(cycle)}")
+
+
+def _note_released(site: str) -> None:
+    stack = _held_stack()
+    # release the innermost matching entry (RLock reentry pops one)
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == site:
+            del stack[i]
+            return
+
+
+def _observe(site: str, wait_s: float, contended: bool) -> None:
+    # lazy import: obs.registry constructs ITS locks plainly, so this
+    # emission never touches a checked lock (no self-instrumentation)
+    from rocalphago_tpu.obs import registry as obs_registry
+    if contended:
+        obs_registry.counter("lock_contention_total", site=site).inc()
+    obs_registry.histogram("lock_wait_seconds", site=site).observe(
+        wait_s)
+
+
+# ------------------------------------------------------------ wrappers
+
+
+class CheckedLock:
+    """``threading.Lock``/``RLock`` wrapper with held-set, order and
+    contention accounting. Site = the lock's static identity."""
+
+    def __init__(self, site: str, inner=None):
+        self.site = site
+        self._inner = inner if inner is not None else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        contended = False
+        wait = 0.0
+        ok = self._inner.acquire(blocking=False)
+        if not ok:
+            contended = True
+            if not blocking:
+                self._observe_failed()
+                return False
+            t0 = time.monotonic()
+            ok = self._inner.acquire(True, timeout)
+            wait = time.monotonic() - t0
+        if ok:
+            try:
+                _note_acquired(self.site)
+            except LockOrderInversion:
+                # unwind: the caller never sees the lock as held
+                self._inner.release()
+                _note_released(self.site)
+                raise
+            _observe(self.site, wait, contended)
+        return ok
+
+    def _observe_failed(self) -> None:
+        _observe(self.site, 0.0, True)
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_released(self.site)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self) -> "CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class CheckedRLock(CheckedLock):
+    """Reentrant variant: the held stack counts reentries, so a
+    nested acquire of the SAME site adds no edge and release pops
+    one level."""
+
+    def __init__(self, site: str):
+        super().__init__(site, threading.RLock())
+
+
+class CheckedCondition:
+    """``threading.Condition`` wrapper over a :class:`CheckedLock`.
+    ``wait`` re-books the held-set around the release/reacquire the
+    condition performs, and FLAGS a wait made while the thread holds
+    any OTHER checked lock (:class:`BlockingUnderLock`)."""
+
+    def __init__(self, site: str):
+        self.site = site
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    # -- lock surface -------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        contended = False
+        wait = 0.0
+        ok = self._lock.acquire(blocking=False)
+        if not ok:
+            contended = True
+            if not blocking:
+                _observe(self.site, 0.0, True)
+                return False
+            t0 = time.monotonic()
+            ok = self._lock.acquire(True, timeout)
+            wait = time.monotonic() - t0
+        if ok:
+            try:
+                _note_acquired(self.site)
+            except LockOrderInversion:
+                self._lock.release()
+                _note_released(self.site)
+                raise
+            _observe(self.site, wait, contended)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        _note_released(self.site)
+
+    def __enter__(self) -> "CheckedCondition":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- condition surface --------------------------------------------
+    def wait(self, timeout: float | None = None):
+        others = [s for s in held_sites() if s != self.site]
+        if others:
+            raise BlockingUnderLock(
+                f"Condition '{self.site}' .wait() while holding "
+                f"{others} — the wait releases only its OWN lock; "
+                "the others stay held for the full wait")
+        _note_released(self.site)       # wait releases the lock...
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _note_acquired(self.site)   # ...and reacquires before return
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        t0 = time.monotonic()
+        while not predicate():
+            left = None if timeout is None else \
+                timeout - (time.monotonic() - t0)
+            if left is not None and left <= 0:
+                return predicate()
+            self.wait(left)
+        return True
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+# ------------------------------------------------------------ factories
+
+
+def make_lock(site: str):
+    """A ``threading.Lock`` — checked (site-labelled) when
+    ``ROCALPHAGO_LOCKCHECK=1``, plain otherwise. Site must be the
+    lock's static identity (``Class.attr`` / ``module._name``)."""
+    return CheckedLock(site) if enabled() else threading.Lock()
+
+
+def make_rlock(site: str):
+    return CheckedRLock(site) if enabled() else threading.RLock()
+
+
+def make_condition(site: str):
+    return CheckedCondition(site) if enabled() else \
+        threading.Condition()

@@ -3,5 +3,5 @@ rule with :mod:`rocalphago_tpu.analysis.core`; the catalog lives in
 docs/STATIC_ANALYSIS.md."""
 
 from rocalphago_tpu.analysis.rules import (  # noqa: F401
-    donation, inventory, prng, retrace, tracer,
+    concurrency, donation, inventory, prng, retrace, tracer,
 )

@@ -1,0 +1,724 @@
+"""Lock discipline for the threaded serve stack, statically proven.
+
+PR 8 made the stack genuinely multi-threaded: a dispatcher thread
+coalescing eval batches under a ``Condition``, thread-per-session
+players, admission locks, the watchdog/hang-protection workers, the
+data-prefetch thread. The conventions that keep that correct — who
+may touch ``ServePool._sessions``, in what order locks nest, what
+must never run inside a critical section — live in comments and
+would otherwise fail first in production, under load, as a deadlock
+or a torn read. This family checks them at lint time against ONE
+declared model, the same model the runtime harness
+(:mod:`rocalphago_tpu.analysis.lockcheck`) checks at test time.
+
+The declared model:
+
+* **lock attributes** — ``self._lock = threading.Lock()`` (also
+  ``RLock``/``Condition`` and the :mod:`..lockcheck` factories
+  ``make_lock``/``make_rlock``/``make_condition``), or a module-level
+  ``_lock = ...``. A lock's identity is ``Class.attr`` (or
+  ``module.name`` for module-level locks) — the SAME labels the
+  lockcheck wrappers carry at runtime, so the observed and static
+  graphs reconcile.
+* **guarded attributes** — a ``# guarded-by: self._lock`` comment on
+  the attribute's defining assignment declares which lock protects
+  it. ``__init__``/``__del__`` are construction/teardown and exempt.
+
+Rules:
+
+* ``unguarded-attr-access`` — a guarded attribute touched by a
+  method without holding its declared lock;
+* ``guarded-by-unknown-lock`` — the annotation names a lock the
+  class/module never creates (typo guard: a misspelled annotation
+  would silently guard nothing);
+* ``lock-order-inversion`` — a cycle in the whole-project static
+  lock-acquisition graph. Edges come from lexically nested ``with``
+  extents AND from calls made while holding a lock, resolved by
+  method name across modules (``self.admission.admit_rows(...)``
+  under the evaluator's condition reaches the admission lock) with a
+  transitive may-acquire fixpoint — the registry→metrics→trace style
+  cross-module chains are one edge each. Test scaffolding is
+  excluded (``tests/`` may seed inversions deliberately);
+* ``blocking-call-under-lock`` — ``.join()``, ``Event.wait()``,
+  blocking ``queue.get/put``, ``time.sleep``,
+  ``.block_until_ready()`` and file writes inside a held-lock
+  extent (a ``Condition.wait`` on the HELD lock is the sanctioned
+  pattern and exempt: it releases while waiting);
+* ``callback-under-lock`` — user code escaping a held critical
+  section (a call through a function-valued parameter or a
+  ``*_fn``/``*_cb``/``*_hook``/``callback`` attribute), the classic
+  re-entrancy trap: the callback may try to take the same lock, or
+  observe the structure mid-update;
+* ``thread-no-join`` — a started thread whose owning scope (the
+  class for ``self._thread``, the enclosing function for locals) has
+  no reachable ``join()``: no bounded stop path, so ``close()``
+  can't promise quiescence (the data-prefetch worker bug). Abandon-
+  by-design threads are baselined with a justification.
+
+Everything is stdlib ``ast`` over :mod:`..events`' evaluation-order
+streams (with-extents included); no jax, inside the 30 s budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+import builtins
+
+from rocalphago_tpu.analysis.core import Finding, module_rule, project_rule
+from rocalphago_tpu.analysis.events import scope_events
+from rocalphago_tpu.analysis.jaxmodel import dotted, last_segment
+
+#: constructors that create a lock (threading + the lockcheck factories)
+LOCK_FACTORIES = ("Lock", "RLock", "Condition",
+                  "make_lock", "make_rlock", "make_condition")
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+CALLBACK_RE = re.compile(r"(?:^|_)(?:fn|cb|hook|callback)$")
+
+#: modules whose ``with`` scaffolding must not feed the project lock
+#: graph (tests seed inversions deliberately; fixtures are strings)
+GRAPH_EXCLUDE = ("tests/",)
+
+#: names the unique-def call-resolution fallback must never claim:
+#: ``seen.add(x)`` is a set method even if exactly one class defines
+#: ``add``; ``set(x)`` is the builtin even if Gauge defines ``set``
+_BUILTIN_NAMES = frozenset(dir(builtins))
+_BUILTIN_METHODS = frozenset(
+    n for t in (dict, list, set, frozenset, str, bytes, tuple)
+    for n in dir(t)) | frozenset(
+        ("close", "write", "read", "flush", "readline", "acquire",
+         "release"))
+
+
+def _norm_lock(name: str | None) -> str | None:
+    """``self._lock`` → ``_lock``; bare names unchanged."""
+    if name is None:
+        return None
+    return name[5:] if name.startswith("self.") else name
+
+
+# ------------------------------------------------------------ module model
+
+
+class ClassModel:
+    def __init__(self, node: ast.ClassDef, module_rel: str):
+        self.node = node
+        self.name = node.name
+        self.module = module_rel
+        self.locks: dict[str, int] = {}        # attr -> lineno
+        self.guarded: dict[str, tuple] = {}    # attr -> (lock, lineno)
+        self.methods: list = []                # FunctionDef nodes
+        self.attr_types: dict[str, str] = {}   # self.X -> ClassName
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+class ModuleModel:
+    """Per-module lock/guard/thread model, cached on the ModuleInfo."""
+
+    def __init__(self, mod):
+        self.rel = mod.rel
+        base = mod.rel.rsplit("/", 1)[-1]
+        self.basename = base[:-3] if base.endswith(".py") else base
+        self.classes: list[ClassModel] = []
+        self.mod_locks: dict[str, int] = {}
+        self.mod_guarded: dict[str, tuple] = {}
+        self.functions: list = []              # module-level defs
+        self._build(mod)
+
+    def mod_lock_id(self, name: str) -> str:
+        return f"{self.basename}.{name}"
+
+    def _annotation(self, mod, lineno: int) -> str | None:
+        m = GUARDED_RE.search(mod.line(lineno))
+        return _norm_lock(m.group(1)) if m else None
+
+    def _scan_assign(self, mod, st, cls: ClassModel | None) -> None:
+        """One Assign/AnnAssign: lock construction or guarded attr."""
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        value = getattr(st, "value", None)
+        is_lock = (isinstance(value, ast.Call)
+                   and last_segment(dotted(value.func)) in LOCK_FACTORIES)
+        guard = self._annotation(mod, st.lineno)
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) and cls is not None \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                if is_lock:
+                    cls.locks.setdefault(tgt.attr, st.lineno)
+                elif guard:
+                    cls.guarded.setdefault(tgt.attr, (guard, st.lineno))
+            elif isinstance(tgt, ast.Name) and cls is None:
+                if is_lock:
+                    self.mod_locks.setdefault(tgt.id, st.lineno)
+                elif guard:
+                    self.mod_guarded.setdefault(tgt.id,
+                                                (guard, st.lineno))
+
+    def _build(self, mod) -> None:
+        for st in mod.tree.body:
+            if isinstance(st, (ast.Assign, ast.AnnAssign)):
+                self._scan_assign(mod, st, None)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append(st)
+            elif isinstance(st, ast.ClassDef):
+                cm = ClassModel(st, mod.rel)
+                for sub in ast.walk(st):
+                    if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        self._scan_assign(mod, sub, cm)
+                for sub in st.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        cm.methods.append(sub)
+                self.classes.append(cm)
+
+
+def _model(mod) -> ModuleModel:
+    cached = getattr(mod, "_conc_model", None)
+    if cached is None:
+        cached = mod._conc_model = ModuleModel(mod)
+    return cached
+
+
+def _held_walk(fndef, lock_names: set, visit) -> None:
+    """Drive ``visit(node, held)`` over a function body with the set
+    of held lock names (normalized: ``_lock``, not ``self._lock``)
+    maintained across ``with`` extents. Nested defs/lambdas are
+    separate runtime frames and are skipped (they do not hold the
+    lock when later invoked)."""
+
+    def walk(node, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            add = set()
+            for item in node.items:
+                walk(item.context_expr, held)
+                name = _norm_lock(dotted(item.context_expr))
+                if name in lock_names:
+                    add.add(name)
+                if item.optional_vars is not None:
+                    walk(item.optional_vars, held)
+            inner = held | frozenset(add)
+            for st in node.body:
+                walk(st, inner)
+            return
+        visit(node, held)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for st in fndef.body:
+        walk(st, frozenset())
+
+
+EXEMPT_METHODS = ("__init__", "__del__")
+
+
+# ---------------------------------------------------------------- rule 1/2
+
+
+@module_rule(
+    "unguarded-attr-access",
+    "a `# guarded-by:` attribute touched without holding its lock")
+def unguarded_attr_access(mod, ctx):
+    findings = []
+    model = _model(mod)
+    for cm in model.classes:
+        if not cm.guarded:
+            continue
+        lock_names = set(cm.locks)
+        for fndef in cm.methods:
+            if fndef.name in EXEMPT_METHODS:
+                continue
+
+            def visit(node, held, _f=fndef):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr in cm.guarded:
+                    lock, _ = cm.guarded[node.attr]
+                    if lock not in held:
+                        findings.append(mod.finding(
+                            "unguarded-attr-access", node,
+                            f"'{_f.name}' touches 'self.{node.attr}' "
+                            f"without holding 'self.{lock}' (declared "
+                            f"guarded-by at line "
+                            f"{cm.guarded[node.attr][1]})"))
+
+            _held_walk(fndef, lock_names, visit)
+    # module-level guarded names used by module functions
+    if model.mod_guarded:
+        lock_names = set(model.mod_locks)
+        scopes = list(model.functions)
+        for cm in model.classes:
+            scopes.extend(cm.methods)
+        for fndef in scopes:
+            def visit(node, held, _f=fndef):
+                if isinstance(node, ast.Name) \
+                        and node.id in model.mod_guarded:
+                    lock, ln = model.mod_guarded[node.id]
+                    if lock not in held:
+                        findings.append(mod.finding(
+                            "unguarded-attr-access", node,
+                            f"'{_f.name}' touches module global "
+                            f"'{node.id}' without holding '{lock}' "
+                            f"(declared guarded-by at line {ln})"))
+
+            _held_walk(fndef, lock_names, visit)
+    return findings
+
+
+@module_rule(
+    "guarded-by-unknown-lock",
+    "a `# guarded-by:` annotation naming a lock that does not exist")
+def guarded_by_unknown_lock(mod, ctx):
+    findings = []
+    model = _model(mod)
+    for cm in model.classes:
+        for attr, (lock, lineno) in cm.guarded.items():
+            if lock not in cm.locks:
+                findings.append(mod.finding(
+                    "guarded-by-unknown-lock", lineno,
+                    f"'{cm.name}.{attr}' is declared guarded by "
+                    f"'{lock}' but {cm.name} creates no such lock — "
+                    "typo, or the lock moved"))
+    for name, (lock, lineno) in model.mod_guarded.items():
+        if lock not in model.mod_locks:
+            findings.append(mod.finding(
+                "guarded-by-unknown-lock", lineno,
+                f"module global '{name}' is declared guarded by "
+                f"'{lock}' but this module creates no such lock"))
+    return findings
+
+
+# ---------------------------------------------------------------- rule 3/4
+
+#: receivers whose ``.join`` is path/string joining, not thread join
+_JOIN_EXEMPT_RECV = ("path", "sep", "linesep")
+
+_FILE_RECV = ("f", "_f", "fh", "_fh", "file", "_file", "stream",
+              "_stream")
+
+
+def _blocking_reason(call: ast.Call, held: frozenset) -> str | None:
+    """Why this call must not run under a lock (None = not blocking).
+    ``held`` lets the sanctioned ``cond.wait()``-on-the-held-lock
+    pattern through."""
+    name = dotted(call.func)
+    if name is None:
+        return None
+    seg = last_segment(name)
+    recv = name[: -(len(seg) + 1)] if "." in name else ""
+    recv_seg = last_segment(recv) if recv else ""
+    if seg == "join":
+        if not recv or recv_seg in _JOIN_EXEMPT_RECV:
+            return None
+        return f"'{name}()' joins (blocks until another thread exits)"
+    if seg == "wait":
+        if _norm_lock(recv) in held:
+            return None      # Condition.wait on the held lock: legal
+        return f"'{name}()' waits on an event/another thread"
+    if seg in ("get", "put"):
+        if "queue" not in (recv_seg or "").lower() and recv_seg != "q":
+            return None
+        for kw in call.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return None
+        return f"'{name}()' is a blocking queue op"
+    if seg == "sleep":
+        return f"'{name}()' sleeps"
+    if seg == "block_until_ready":
+        return f"'{name}()' synchronizes with the device"
+    if seg == "write" and recv_seg in _FILE_RECV:
+        return f"'{name}()' is a file write (OS-paced I/O)"
+    return None
+
+
+@module_rule(
+    "blocking-call-under-lock",
+    "a blocking operation (join/wait/queue/sleep/device-sync/file "
+    "write) inside a held-lock extent")
+def blocking_call_under_lock(mod, ctx):
+    findings = []
+    model = _model(mod)
+    scopes: list[tuple] = [(f, set(model.mod_locks))
+                           for f in model.functions]
+    for cm in model.classes:
+        names = set(cm.locks) | set(model.mod_locks)
+        scopes.extend((f, names) for f in cm.methods)
+    for fndef, lock_names in scopes:
+        def visit(node, held, _f=fndef):
+            if not held or not isinstance(node, ast.Call):
+                return
+            reason = _blocking_reason(node, held)
+            if reason:
+                findings.append(mod.finding(
+                    "blocking-call-under-lock", node,
+                    f"{reason} while '{_f.name}' holds "
+                    f"{sorted(held)} — every other thread needing "
+                    "the lock stalls behind it; move it outside the "
+                    "critical section"))
+
+        _held_walk(fndef, lock_names, visit)
+    return findings
+
+
+@module_rule(
+    "callback-under-lock",
+    "user code (a function-valued parameter or *_fn/*_cb/*_hook "
+    "attribute) invoked while holding a lock")
+def callback_under_lock(mod, ctx):
+    findings = []
+    model = _model(mod)
+    scopes: list[tuple] = [(f, set(model.mod_locks))
+                           for f in model.functions]
+    for cm in model.classes:
+        names = set(cm.locks) | set(model.mod_locks)
+        scopes.extend((f, names) for f in cm.methods)
+    for fndef, lock_names in scopes:
+        a = fndef.args
+        params = {p.arg for p in (*a.posonlyargs, *a.args,
+                                  *a.kwonlyargs)} - {"self", "cls"}
+
+        def visit(node, held, _f=fndef, _params=params):
+            if not held or not isinstance(node, ast.Call):
+                return
+            func = node.func
+            hit = None
+            if isinstance(func, ast.Name) and func.id in _params:
+                hit = f"parameter '{func.id}'"
+            elif isinstance(func, ast.Attribute) \
+                    and CALLBACK_RE.search(func.attr):
+                hit = f"callback attribute '{dotted(func)}'"
+            if hit:
+                findings.append(mod.finding(
+                    "callback-under-lock", node,
+                    f"{hit} invoked while '{_f.name}' holds "
+                    f"{sorted(held)} — user code inside a critical "
+                    "section can re-enter the lock or observe state "
+                    "mid-update; call it after release"))
+
+        _held_walk(fndef, lock_names, visit)
+    return findings
+
+
+# ------------------------------------------------------------------ rule 5
+
+
+def _thread_bindings(fndef) -> list:
+    """(binding kind, name, node) for every ``threading.Thread(...)``
+    constructed in ``fndef`` (nested defs included — the worker
+    pattern builds threads in closures). Binding: the Assign target
+    (``self._thread`` → class scope, plain name → function scope);
+    an unbound construction binds to the function scope."""
+    out = []
+    for node in ast.walk(fndef):
+        if not (isinstance(node, ast.Call)
+                and last_segment(dotted(node.func)) == "Thread"):
+            continue
+        out.append(node)
+    return out
+
+
+def _has_join(tree) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" \
+                and last_segment(dotted(node.func.value) or "") \
+                not in _JOIN_EXEMPT_RECV \
+                and not isinstance(node.func.value, ast.Constant):
+            return True
+    return False
+
+
+@module_rule(
+    "thread-no-join",
+    "a started thread with no reachable join (no bounded stop path)")
+def thread_no_join(mod, ctx):
+    """A thread assigned to ``self.X`` must be joined somewhere in
+    its class (the ``close()``/``stop()`` contract); a local thread
+    must be joined in its enclosing function. Daemon-ness is not an
+    excuse: a daemon prefetch worker with no join means ``close()``
+    returns while the worker still touches the dataset. Deliberate
+    abandonment (hang protection discarding a wedged worker) is a
+    baseline entry with a justification, not a pass."""
+    findings = []
+    model = _model(mod)
+
+    def check(fndef, owner_tree, owner_desc):
+        for call in _thread_bindings(fndef):
+            # self-attribute binding → the CLASS is the owning scope
+            tree = owner_tree
+            where = owner_desc
+            if not _has_join(tree):
+                findings.append(mod.finding(
+                    "thread-no-join", call,
+                    f"thread constructed in '{fndef.name}' is never "
+                    f"joined anywhere in {where} — no bounded "
+                    "stop/close path; a caller cannot wait for "
+                    "quiescence"))
+
+    for fndef in model.functions:
+        check(fndef, fndef, f"function '{fndef.name}'")
+    for cm in model.classes:
+        for fndef in cm.methods:
+            # locals inside a method: the method scope may join (the
+            # worker pattern); otherwise fall back to the class scope
+            # (self._thread joined by close()/stop()).
+            if _has_join(fndef):
+                continue
+            check(fndef, cm.node, f"class '{cm.name}'")
+    return findings
+
+
+# ----------------------------------------------------- acquisition graph
+
+
+def _method_key(model: ModuleModel, cm: ClassModel | None,
+                fndef) -> str:
+    if cm is not None:
+        return f"{cm.name}.{fndef.name}"
+    return f"{model.basename}.{fndef.name}"
+
+
+def _lock_ids_for(model: ModuleModel, cm: ClassModel | None,
+                  names: tuple) -> list:
+    """Lock identities acquired by one ``with`` statement's context
+    names, resolved against the class then the module."""
+    out = []
+    for raw in names:
+        n = _norm_lock(raw)
+        if cm is not None and n in cm.locks:
+            out.append(cm.lock_id(n))
+        elif n in model.mod_locks:
+            out.append(model.mod_lock_id(n))
+    return out
+
+
+def build_lock_graph(ctx) -> dict:
+    """The whole-project static lock-acquisition graph.
+
+    Returns ``{"locks": {id: (module, line)}, "edges": {(a, b):
+    [(module, line, via), ...]}}`` where an edge ``a → b`` means
+    "some code path acquires ``b`` while holding ``a``" — either a
+    lexically nested ``with``, or a call made under ``a`` that (by
+    the transitive may-acquire fixpoint, resolved by method name
+    across modules) can reach ``b``. This is the graph the runtime
+    harness reconciles its OBSERVED edges against: every observed
+    edge must appear here, or the declared model is wrong.
+    """
+    cached = ctx.cache.get("lock_graph")
+    if cached is not None:
+        return cached
+    locks: dict[str, tuple] = {}
+    # method key ("Class.method" / "mod.func") -> scope info
+    methods: dict[str, dict] = {}
+    #: simple def name -> [method keys] and -> global def count; the
+    #: unique-name fallback resolves a call only when the project
+    #: defines that name EXACTLY once ("admit_rows"), never for
+    #: builtin-colliding names ("close", "get") — a file handle's
+    #: .close() must not alias some class's lock-taking close()
+    name_index: dict[str, list] = {}
+    def_count: dict[str, int] = {}
+    class_names: dict[str, str] = {}     # ClassName -> "__init__" key
+
+    models = []
+    for mod in ctx.modules:
+        if any(mod.rel.startswith(p) for p in GRAPH_EXCLUDE):
+            continue
+        model = _model(mod)
+        models.append((mod, model))
+        for cm in model.classes:
+            for attr, ln in cm.locks.items():
+                locks[cm.lock_id(attr)] = (mod.rel, ln)
+            class_names.setdefault(cm.name, f"{cm.name}.__init__")
+            # self.X = ClassName(...): the typed-receiver map
+            for fndef in cm.methods:
+                for sub in ast.walk(fndef):
+                    if isinstance(sub, ast.Assign) \
+                            and isinstance(sub.value, ast.Call):
+                        tname = dotted(sub.value.func)
+                        if tname is None or "." in tname:
+                            continue
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Attribute) \
+                                    and isinstance(tgt.value, ast.Name) \
+                                    and tgt.value.id == "self":
+                                cm.attr_types.setdefault(tgt.attr,
+                                                         tname)
+        for name, ln in model.mod_locks.items():
+            locks[model.mod_lock_id(name)] = (mod.rel, ln)
+
+    for mod, model in models:
+        scopes = [(None, f) for f in model.functions]
+        for cm in model.classes:
+            scopes.extend((cm, f) for f in cm.methods)
+        own_funcs = {f.name for f in model.functions}
+        for cm, fndef in scopes:
+            key = _method_key(model, cm, fndef)
+            ev = scope_events(fndef)
+            extents = []
+            for names, start, end, node in ev.withs:
+                for lid in _lock_ids_for(model, cm, names):
+                    extents.append((lid, start, end, node))
+            info = {"direct": {e[0] for e in extents},
+                    "extents": extents, "module": mod.rel, "ev": ev,
+                    "class": cm, "own_funcs": own_funcs,
+                    "basename": model.basename}
+            methods[key] = info
+            name_index.setdefault(fndef.name, []).append(key)
+            def_count[fndef.name] = def_count.get(fndef.name, 0) + 1
+
+    def resolve(call: ast.Call, info) -> list:
+        """Method keys a call site may reach: typed receiver first
+        (``self.m``, ``self.X.m`` via the attr-type map), then
+        same-module defs/constructors, then the unique-name
+        fallback. Unresolvable calls contribute no edge — a missed
+        edge is a model gap the runtime reconciliation surfaces,
+        while a fabricated edge is a false deadlock report."""
+        name = dotted(call.func)
+        if not name:
+            return []
+        seg = last_segment(name)
+        cm = info["class"]
+        if "." in name:
+            recv = name[: -(len(seg) + 1)]
+            if recv == "self" and cm is not None:
+                k = f"{cm.name}.{seg}"
+                if k in methods:
+                    return [k]
+            if recv.startswith("self.") and "." not in recv[5:] \
+                    and cm is not None:
+                tname = cm.attr_types.get(recv[5:])
+                if tname:
+                    k = f"{tname}.{seg}"
+                    return [k] if k in methods else []
+            if seg in _BUILTIN_METHODS:
+                return []
+        else:
+            if seg in info["own_funcs"]:
+                return [f"{info['basename']}.{seg}"]
+            if seg in class_names:
+                k = class_names[seg]
+                return [k] if k in methods else []
+            if seg in _BUILTIN_NAMES:
+                return []
+        if def_count.get(seg) == 1:
+            return list(name_index[seg])
+        return []
+
+    for key, info in methods.items():
+        info["calls"] = set()
+        for e in info["ev"].events:
+            if e.kind == "call":
+                info["calls"].update(resolve(e.call, info))
+
+    # transitive may-acquire fixpoint over the resolved call graph
+    may = {k: set(v["direct"]) for k, v in methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, info in methods.items():
+            for k2 in info["calls"]:
+                extra = may[k2] - may[k]
+                if extra:
+                    may[k] |= extra
+                    changed = True
+
+    edges: dict[tuple, list] = {}
+
+    def add_edge(a, b, module, line, via):
+        if a == b:
+            return
+        edges.setdefault((a, b), []).append((module, line, via))
+
+    for k, info in methods.items():
+        ev = info["ev"]
+        for lid, start, end, node in info["extents"]:
+            # nested with extents: outer -> inner
+            for lid2, s2, e2, n2 in info["extents"]:
+                if lid2 != lid and start <= s2 and e2 <= end \
+                        and (s2, e2) != (start, end):
+                    add_edge(lid, lid2, info["module"], n2.lineno,
+                             f"nested with in {k}")
+            # calls under the lock: edge to everything they may acquire
+            for i in range(start, end):
+                e = ev.events[i]
+                if e.kind != "call":
+                    continue
+                for k2 in resolve(e.call, info):
+                    for lid2 in may[k2]:
+                        add_edge(lid, lid2, info["module"],
+                                 e.call.lineno, f"{k} calls {k2}")
+    out = {"locks": locks, "edges": edges}
+    ctx.cache["lock_graph"] = out
+    return out
+
+
+@project_rule(
+    "lock-order-inversion",
+    "a cycle in the static lock-acquisition graph (deadlock under "
+    "the right interleaving)")
+def lock_order_inversion(ctx):
+    graph = build_lock_graph(ctx)
+    edges = graph["edges"]
+    adj: dict[str, set] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    # Tarjan-free SCC via iterative DFS twice (Kosaraju) — graphs
+    # here are tiny (tens of locks)
+    order, seen = [], set()
+
+    def dfs(start, graph_adj, visitor):
+        stack = [(start, iter(graph_adj.get(start, ())))]
+        seen.add(start)
+        while stack:
+            node, it = stack[-1]
+            for nxt in it:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, iter(graph_adj.get(nxt, ()))))
+                    break
+            else:
+                stack.pop()
+                visitor(node)
+
+    nodes = set(adj) | {b for bs in adj.values() for b in bs}
+    for n in sorted(nodes):
+        if n not in seen:
+            dfs(n, adj, order.append)
+    radj: dict[str, set] = {}
+    for a, bs in adj.items():
+        for b in bs:
+            radj.setdefault(b, set()).add(a)
+    seen.clear()
+    comp: dict[str, int] = {}
+    cid = 0
+    for n in reversed(order):
+        if n not in seen:
+            members: list = []
+            dfs(n, radj, members.append)
+            for m in members:
+                comp[m] = cid
+            cid += 1
+    findings = []
+    for (a, b), sites in sorted(edges.items()):
+        if comp.get(a) is not None and comp.get(a) == comp.get(b):
+            module, line, via = sites[0]
+            cycle = sorted(x for x in comp if comp[x] == comp[a])
+            findings.append(Finding(
+                path=module, line=line, rule="lock-order-inversion",
+                message=f"acquiring '{b}' while holding '{a}' "
+                        f"({via}) is part of an acquisition cycle "
+                        f"{{{', '.join(cycle)}}} — two threads "
+                        "taking the locks in opposite orders "
+                        "deadlock; pick one global order",
+                snippet=f"edge:{a}->{b}"))
+    return findings

@@ -39,11 +39,14 @@ from rocalphago_tpu.analysis.core import Finding, project_rule
 from rocalphago_tpu.analysis.jaxmodel import dotted, last_segment
 
 #: modules whose registry/trace calls DEFINE the api, not metrics
-#: (obs/jaxobs.py is a genuine producer — jax_compiles_total — so
-#: only the registry/trace definition modules are excluded)
+#: (obs/jaxobs.py is a genuine producer — jax_compiles_total — and
+#: so is analysis/lockcheck.py — lock_wait_seconds — so only the
+#: registry/trace definition modules and the rule modules, whose
+#: docstrings/messages quote metric idioms, are excluded)
 PRODUCER_EXCLUDE = ("rocalphago_tpu/obs/registry.py",
                     "rocalphago_tpu/obs/trace.py",
-                    "rocalphago_tpu/analysis/",
+                    "rocalphago_tpu/analysis/rules/",
+                    "rocalphago_tpu/analysis/core.py",
                     "tests/", "scripts/obs_report.py")
 BARRIER_EXCLUDE = ("rocalphago_tpu/runtime/faults.py",
                    "rocalphago_tpu/analysis/", "tests/")
@@ -480,6 +483,98 @@ def report_unknown_metric(ctx):
                         "code path produces — its section will "
                         "render empty forever"))
         findings = [f for f in findings]
+    return findings
+
+
+@project_rule(
+    "serve-probe-drift",
+    "the documented serve health-probe block schema vs the fields "
+    "ServePool.stats actually emits")
+def serve_probe_drift(ctx):
+    """The ``"serve"`` block in ``rocalphago-health`` /
+    ``rocalphago-stats`` is the LB health-check schema
+    (docs/SERVING.md's fenced JSON example). Its producer is the
+    dict literal ``ServePool.stats`` returns
+    (``config.serve_probe_module``); this rule flattens both to
+    dotted key paths and diffs BOTH directions — the same pattern as
+    the metric/barrier tables."""
+    import json as _json
+
+    doc = ctx.read_doc(ctx.config.docs_serving)
+    if doc is None:
+        return []
+
+    def flatten_json(d, prefix=""):
+        out = set()
+        for k, v in d.items():
+            out.add(prefix + k)
+            if isinstance(v, dict):
+                out |= flatten_json(v, prefix + k + ".")
+        return out
+
+    documented = None
+    for block in re.findall(r"```json\s*\n(.*?)```", doc, re.S):
+        if '"serve"' not in block:
+            continue
+        try:
+            data = _json.loads(block)
+        except ValueError:
+            continue
+        serve = data.get("serve")
+        if isinstance(serve, dict):
+            documented = flatten_json(serve)
+            break
+    if documented is None:
+        return []
+
+    def flatten_dict_node(node, prefix=""):
+        out = {}
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                path = prefix + k.value
+                out[path] = k.lineno
+                if isinstance(v, ast.Dict):
+                    out.update(flatten_dict_node(v, path + "."))
+        return out
+
+    produced = None
+    mod = next((m for m in ctx.modules
+                if m.rel == ctx.config.serve_probe_module), None)
+    if mod is not None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == "ServePool":
+                for fn in node.body:
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) \
+                            and fn.name == "stats":
+                        for sub in ast.walk(fn):
+                            if isinstance(sub, ast.Return) \
+                                    and isinstance(sub.value, ast.Dict):
+                                produced = flatten_dict_node(sub.value)
+    if produced is None:
+        return []
+
+    findings = []
+    for key, line in sorted(produced.items()):
+        if key not in documented:
+            findings.append(Finding(
+                path=mod.rel, line=line, rule="serve-probe-drift",
+                message=f"serve-probe field '{key}' is emitted by "
+                        f"ServePool.stats but missing from the "
+                        f"schema in {ctx.config.docs_serving} — load "
+                        "balancers key on that block; document it",
+                snippet=f"probe:{key}"))
+    for key in sorted(documented - set(produced)):
+        findings.append(Finding(
+            path=ctx.config.docs_serving,
+            line=_doc_line_of(doc, key.rsplit(".", 1)[-1]),
+            rule="serve-probe-drift",
+            message=f"documented serve-probe field '{key}' is "
+                    "emitted by no code path — an LB health check "
+                    "reading it sees nothing; update the schema or "
+                    "restore the field",
+            snippet=f"doc-probe:{key}"))
     return findings
 
 

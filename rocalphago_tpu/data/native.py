@@ -13,18 +13,19 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from rocalphago_tpu.analysis import lockcheck
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO, "native", "goreplay.cpp")
 _LIB = os.path.join(_REPO, "native", "libgoreplay.so")
 
-_lock = threading.Lock()
-_lib = None
-_tried = False
+_lock = lockcheck.make_lock("native._lock")
+_lib = None               # guarded-by: _lock
+_tried = False            # guarded-by: _lock
 
 
 def _build() -> bool:

@@ -193,7 +193,10 @@ class ResilientPlayer:
         wd = Watchdog(timeout, metrics=self.metrics,
                       abort_fn=abandoned.set, name=f"serve.{rung}",
                       exit=False, poll_s=min(0.05, timeout / 4.0))
-        worker = threading.Thread(
+        # abandoned BY DESIGN on hang: joining a wedged search would
+        # re-import the hang the ladder exists to escape — the daemon
+        # worker's result is discarded (docs/CONCURRENCY.md)
+        worker = threading.Thread(  # jaxlint: disable=thread-no-join
             target=work, daemon=True, name=f"genmove-{rung}")
         with wd:
             worker.start()

@@ -26,7 +26,6 @@ from __future__ import annotations
 import json
 import math
 import os
-import threading
 import time
 
 
@@ -34,6 +33,9 @@ import time
 # in runtime (stdlib-only) so light scripts can import it without
 # pulling this package's jax/orbax dependencies
 from rocalphago_tpu.runtime.jsonl import read_jsonl  # noqa: F401
+# instrumented-lock factory (plain threading.Lock unless
+# ROCALPHAGO_LOCKCHECK=1) — also stdlib-only
+from rocalphago_tpu.analysis import lockcheck
 
 
 def sanitize(value):
@@ -65,14 +67,14 @@ class MetricsLogger:
     def __init__(self, path: str | None, echo: bool = True):
         self.path = path
         self.echo = echo
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("MetricsLogger._lock")
         if path:
             parent = os.path.dirname(path)
             if parent:
                 os.makedirs(parent, exist_ok=True)
-            self._f = open(path, "a", buffering=1)
+            self._f = open(path, "a", buffering=1)  # guarded-by: self._lock
         else:
-            self._f = None
+            self._f = None                          # guarded-by: self._lock
 
     def write(self, event: str, **fields) -> None:
         """File-only emission (no console echo) — the channel for

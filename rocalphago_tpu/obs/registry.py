@@ -157,17 +157,32 @@ class Registry:
     """Get-or-create metric store; see module docstring."""
 
     def __init__(self):
+        # deliberately a PLAIN lock, never a lockcheck wrapper: the
+        # lockcheck harness emits ITS metrics through this registry,
+        # so instrumenting the registry's own lock would recurse
         self._lock = threading.Lock()
-        self._metrics: dict = {}       # key -> metric object
-        self._kinds: dict = {}         # key -> "counter"|...
-        self._families: dict = {}      # key -> (name, labels)
+        self._metrics: dict = {}       # guarded-by: self._lock
+        self._kinds: dict = {}         # guarded-by: self._lock
+        self._families: dict = {}      # guarded-by: self._lock
 
     def _get(self, kind: str, name: str, labels: dict, make):
         key = _key(name, labels)
         with self._lock:
             m = self._metrics.get(key)
+            if m is not None:
+                if self._kinds[key] != kind:
+                    raise ValueError(
+                        f"metric {key!r} already registered as "
+                        f"{self._kinds[key]}, not {kind}")
+                return m
+        # miss: construct OUTSIDE the lock (make is caller code — a
+        # critical section must not run it), insert with a re-check;
+        # a racing creator wins and the spare build is dropped
+        built = make()
+        with self._lock:
+            m = self._metrics.get(key)
             if m is None:
-                m = make()
+                m = built
                 self._metrics[key] = m
                 self._kinds[key] = kind
                 self._families[key] = (name, dict(labels))

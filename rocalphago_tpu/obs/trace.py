@@ -35,9 +35,11 @@ from __future__ import annotations
 import threading
 import time
 
-_lock = threading.Lock()
-_stacks: dict = {}        # thread ident -> list of open span frames
-_names: dict = {}         # thread ident -> thread name
+from rocalphago_tpu.analysis import lockcheck
+
+_lock = lockcheck.make_lock("trace._lock")
+_stacks: dict = {}        # guarded-by: _lock — ident -> open frames
+_names: dict = {}         # guarded-by: _lock — ident -> thread name
 _sink = None
 _enabled = True
 

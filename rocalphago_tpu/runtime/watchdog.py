@@ -53,6 +53,10 @@ class Watchdog:
         self.exit = exit
         self.stalls = 0
         self._poll_s = poll_s or min(1.0, deadline_s / 4.0)
+        # deliberately lock-free (so deliberately NOT `# guarded-by:`
+        # annotated): one writer (beat) and one reader (_watch), and
+        # a torn/stale read of a monotonic float only shifts a stall
+        # report by one poll — see docs/CONCURRENCY.md's benign list
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(

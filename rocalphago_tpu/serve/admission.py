@@ -24,8 +24,8 @@ pressure before users do.
 from __future__ import annotations
 
 import os
-import threading
 
+from rocalphago_tpu.analysis import lockcheck
 from rocalphago_tpu.obs import registry as obs_registry
 
 #: default cap on concurrently open sessions (env override)
@@ -66,10 +66,10 @@ class AdmissionController:
                              if max_sessions is None else max_sessions)
         self.queue_rows = (_env_int(QUEUE_ROWS_ENV, 1024)
                            if queue_rows is None else queue_rows)
-        self._lock = threading.Lock()
-        self.live_sessions = 0
-        self.session_rejects = 0
-        self.queue_sheds = 0
+        self._lock = lockcheck.make_lock("AdmissionController._lock")
+        self.live_sessions = 0            # guarded-by: self._lock
+        self.session_rejects = 0          # guarded-by: self._lock
+        self.queue_sheds = 0              # guarded-by: self._lock
         self._live_g = obs_registry.gauge("serve_sessions_live")
         self._shed_queue_c = obs_registry.counter(
             "serve_sheds_total", kind="queue_full")
@@ -93,6 +93,12 @@ class AdmissionController:
         with self._lock:
             self.live_sessions = max(0, self.live_sessions - 1)
             self._live_g.set(self.live_sessions)
+
+    def live(self) -> int:
+        """Locked read of the live-session count (the evaluator's
+        fill target polls this once per dispatch round)."""
+        with self._lock:
+            return self.live_sessions
 
     # ---------------------------------------------------- eval queue
 

@@ -45,6 +45,7 @@ import threading
 import time
 from collections import deque
 
+from rocalphago_tpu.analysis import lockcheck
 from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime import faults
 
@@ -142,10 +143,10 @@ class BatchingEvaluator:
             max_wait_us = float(raw) if raw else 500.0
         self.max_wait_s = max_wait_us / 1e6
         self.admission = admission
-        self._cond = threading.Condition()
-        self._queue: deque = deque()
-        self._pending_rows = 0
-        self._stop = False
+        self._cond = lockcheck.make_condition("BatchingEvaluator._cond")
+        self._queue: deque = deque()      # guarded-by: self._cond
+        self._pending_rows = 0            # guarded-by: self._cond
+        self._stop = False                # guarded-by: self._cond
         # dispatch accounting (stats() + the serve probes)
         self.batches = 0
         self.failures = 0
@@ -202,7 +203,7 @@ class BatchingEvaluator:
     # ---------------------------------------------------- dispatcher
 
     def _fill_target(self) -> int:
-        live = (self.admission.live_sessions
+        live = (self.admission.live()
                 if self.admission is not None else 0)
         return min(self.max_batch, live) if live > 0 else \
             self.max_batch

@@ -41,9 +41,9 @@ the same way one pool serves one board size.
 from __future__ import annotations
 
 import os
-import threading
 import time
 
+from rocalphago_tpu.analysis import lockcheck
 from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime.deadline import Deadline
 from rocalphago_tpu.serve.admission import AdmissionController
@@ -331,9 +331,9 @@ class ServePool:
             batch_sizes=batch_sizes, max_wait_us=max_wait_us,
             admission=self.admission)
         self.warmed = False
-        self._lock = threading.Lock()
-        self._sessions: dict = {}
-        self._next_id = 0
+        self._lock = lockcheck.make_lock("ServePool._lock")
+        self._sessions: dict = {}         # guarded-by: self._lock
+        self._next_id = 0                 # guarded-by: self._lock
         self._move_h = obs_registry.histogram("serve_genmove_seconds")
         self._sims_c = obs_registry.counter("serve_session_sims_total")
 

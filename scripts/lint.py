@@ -68,7 +68,8 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated rule ids to run")
+                    help="comma-separated rule ids or family names "
+                         "(e.g. concurrency) to run")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current findings "
@@ -87,7 +88,8 @@ def main(argv=None) -> int:
 
     if a.list_rules:
         for rid, summary in rule_catalog().items():
-            print(f"{rid:26s} {summary}")
+            fam = _core.RULE_FAMILIES.get(rid, "")
+            print(f"{rid:26s} [{fam}] {summary}")
         return 0
     if a.write_knobs:
         path = write_knobs(root, config)
@@ -96,7 +98,10 @@ def main(argv=None) -> int:
 
     only = None
     if a.rules:
-        only = {r.strip() for r in a.rules.split(",") if r.strip()}
+        # tokens may be rule ids OR family names ("concurrency",
+        # "donation", …) — a family expands to its rules
+        only = _core.expand_rule_names(
+            r.strip() for r in a.rules.split(",") if r.strip())
         unknown = only - set(rule_catalog())
         if unknown:
             print(f"jaxlint: unknown rule(s): {sorted(unknown)}",

@@ -72,13 +72,25 @@ cd "$(dirname "$0")/.."
 # Static analysis (jaxlint, docs/STATIC_ANALYSIS.md): the JAX-aware
 # lint — donation reuse, retry-wrapping-donators, host syncs and
 # Python branches on tracers in jit bodies, PRNG key reuse,
-# float/unhashable static args, mutable-global capture, and the
-# metric/span/barrier/ROCALPHAGO_* knob inventories diffed against
-# docs/{OBSERVABILITY,RESILIENCE,KNOBS}.md — runs first (stdlib-only,
-# ~2 s, budgeted <30 s) and fails the tier on any unbaselined
-# finding. tests/test_jaxlint.py re-runs it in-process (self-lint)
-# plus per-rule fixture coverage, so `pytest tests/` alone still
-# enforces it.
+# float/unhashable static args, mutable-global capture, the
+# metric/span/barrier/serve-probe/ROCALPHAGO_* knob inventories
+# diffed against docs/{OBSERVABILITY,RESILIENCE,SERVING,KNOBS}.md,
+# and the CONCURRENCY family (docs/CONCURRENCY.md): guarded-by
+# annotated shared state, a cycle-free whole-project lock-
+# acquisition graph, no blocking calls or user callbacks inside
+# critical sections, every thread joinable — runs first (stdlib-
+# only, all 6 families a few seconds, budgeted <30 s) and fails the
+# tier on any unbaselined finding. tests/test_jaxlint.py re-runs it
+# in-process (self-lint) plus per-rule fixture coverage, so
+# `pytest tests/` alone still enforces it.
+#
+# Concurrency proofing (runtime half): tests/test_lockcheck.py
+# units the ROCALPHAGO_LOCKCHECK=1 instrumented locks (observed
+# lock-order graph, cycle raise, held-sets, blocking-while-held,
+# contention metrics); the serve SOAK and the concurrent-emit test
+# each run once more under the harness, with the soak reconciling
+# every OBSERVED lock edge against the STATIC acquisition graph
+# (tests/test_serve.py::test_soak_under_lockcheck_...).
 python scripts/lint.py --check
 
 ARGS=()

@@ -506,6 +506,279 @@ def read():
             assert any(knob in m for m in fs)
 
 
+# ------------------------------------------------------- rule family 6
+# concurrency / lock discipline
+
+
+class TestConcurrencyRules:
+    def test_unguarded_write_fires(self):
+        src = """
+import threading
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}      # guarded-by: self._lock
+    def open(self, sid):
+        with self._lock:
+            self._sessions[sid] = 1
+    def leak(self, sid):
+        self._sessions.pop(sid)
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "unguarded-attr-access"]
+        assert len(fs) == 1
+        assert "leak" in fs[0].message and "_sessions" in fs[0].message
+
+    def test_guarded_access_under_lock_is_clean(self):
+        src = """
+import threading
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}      # guarded-by: self._lock
+    def open(self, sid):
+        with self._lock:
+            self._sessions[sid] = 1
+    def close(self, sid):
+        with self._lock:
+            self._sessions.pop(sid, None)
+"""
+        assert rules_of(src) == set()
+
+    def test_module_level_guarded_global(self):
+        src = """
+import threading
+_lock = threading.Lock()
+_stacks = {}      # guarded-by: _lock
+def good(k):
+    with _lock:
+        return _stacks.get(k)
+def bad(k):
+    return _stacks.get(k)
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "unguarded-attr-access"]
+        assert len(fs) == 1 and "bad" in fs[0].message
+
+    def test_guarded_by_unknown_lock_fires(self):
+        src = """
+import threading
+class P:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0      # guarded-by: self._mutex
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "guarded-by-unknown-lock"]
+        assert len(fs) == 1 and "_mutex" in fs[0].message
+
+    def test_lock_order_inversion_fires(self):
+        src = """
+import threading
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+        assert "lock-order-inversion" in rules_of(src)
+
+    def test_consistent_order_is_clean(self):
+        src = """
+import threading
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+    def two(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+        assert rules_of(src) == set()
+
+    def test_cross_class_call_mediated_inversion(self):
+        # the registry→metrics→trace shape: the cycle closes through
+        # CALLS under a held lock, resolved across classes
+        src = """
+import threading
+class M:
+    def __init__(self):
+        self._m = threading.Lock()
+    def locked_touch(self, other):
+        with self._m:
+            other.touch()
+    def ping(self):
+        with self._m:
+            pass
+class T:
+    def __init__(self):
+        self._t = threading.Lock()
+    def touch(self):
+        with self._t:
+            pass
+    def locked_back(self, m):
+        with self._t:
+            m.ping()
+"""
+        assert "lock-order-inversion" in rules_of(src)
+
+    def test_blocking_calls_under_lock_fire(self):
+        src = """
+import threading, time
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=time.sleep)
+        self._f = open("/dev/null", "w")
+    def bad_join(self):
+        with self._lock:
+            self._thread.join()
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)
+    def bad_write(self):
+        with self._lock:
+            self._f.write("x")
+    def stop(self):
+        self._thread.join(timeout=1)
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "blocking-call-under-lock"]
+        assert len(fs) == 3
+
+    def test_condition_wait_on_held_lock_is_clean(self):
+        src = """
+import threading
+class C:
+    def __init__(self):
+        self._cond = threading.Condition()
+    def drain(self):
+        with self._cond:
+            self._cond.wait(0.1)
+"""
+        assert rules_of(src) == set()
+
+    def test_callback_under_lock_fires(self):
+        src = """
+import threading
+_lock = threading.Lock()
+class R:
+    def __init__(self, abort_fn):
+        self._lock = threading.Lock()
+        self._abort_fn = abort_fn
+    def bad(self):
+        with self._lock:
+            self._abort_fn()
+def run(make):
+    with _lock:
+        return make()
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "callback-under-lock"]
+        assert len(fs) == 2
+
+    def test_callback_outside_lock_is_clean(self):
+        src = """
+import threading
+_lock = threading.Lock()
+def run(make):
+    built = make()
+    with _lock:
+        return built
+"""
+        assert rules_of(src) == set()
+
+    def test_thread_without_join_fires(self):
+        src = """
+import threading
+def fire_and_forget(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+"""
+        fs = [f for f in lint_source(src)
+              if f.rule == "thread-no-join"]
+        assert len(fs) == 1
+
+    def test_joined_thread_is_clean(self):
+        src = """
+import threading
+class Prefetch:
+    def __init__(self, work):
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+    def close(self):
+        self._thread.join(timeout=5.0)
+def bounded(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+"""
+        assert rules_of(src) == set()
+
+
+SERVE_DOC = '''
+## Probes
+
+```json
+{"serve": {
+  "sessions": {"live": 3},
+  "queue": {"depth": 0},
+  "warmed": true}}
+```
+'''
+
+
+class TestServeProbeRule:
+    CFG = dict(serve_probe_module="<fixture>.py",
+               docs_serving="docs/SERVING.md")
+    DOCS = {"docs/SERVING.md": SERVE_DOC}
+
+    def test_matching_schema_is_clean(self):
+        src = """
+class ServePool:
+    def stats(self):
+        return {
+            "sessions": {"live": self._live},
+            "queue": {"depth": self._depth},
+            "warmed": self.warmed,
+        }
+"""
+        fs = [f for f in lint_source(src, config=LintConfig(**self.CFG),
+                                     docs=self.DOCS)
+              if f.rule == "serve-probe-drift"]
+        assert fs == []
+
+    def test_drift_fires_both_directions(self):
+        src = """
+class ServePool:
+    def stats(self):
+        return {
+            "sessions": {"live": self._live, "rogue": 1},
+            "warmed": self.warmed,
+        }
+"""
+        fs = [f for f in lint_source(src, config=LintConfig(**self.CFG),
+                                     docs=self.DOCS)
+              if f.rule == "serve-probe-drift"]
+        msgs = " | ".join(f.message for f in fs)
+        # produced-but-undocumented: sessions.rogue; documented-but-
+        # unproduced: queue + queue.depth
+        assert "sessions.rogue" in msgs
+        assert "queue.depth" in msgs
+
+
 # ----------------------------------------------- suppression + baseline
 
 
@@ -621,9 +894,31 @@ baseline = ".b.json"
                     "mutable-global-in-jit", "undocumented-metric",
                     "stale-metric-doc", "undocumented-span",
                     "undocumented-barrier", "stale-barrier-doc",
-                    "knob-doc-drift", "report-unknown-metric"):
+                    "knob-doc-drift", "report-unknown-metric",
+                    "serve-probe-drift", "unguarded-attr-access",
+                    "guarded-by-unknown-lock", "lock-order-inversion",
+                    "blocking-call-under-lock", "callback-under-lock",
+                    "thread-no-join"):
             assert rid in ids
         assert len(rule_catalog()) == len(ids)
+
+    def test_six_families_and_family_expansion(self):
+        from rocalphago_tpu.analysis.core import (
+            RULE_FAMILIES, expand_rule_names,
+        )
+        all_rule_ids()      # force registration
+        assert set(RULE_FAMILIES.values()) == {
+            "concurrency", "donation", "inventory", "prng",
+            "retrace", "tracer"}
+        conc = expand_rule_names(["concurrency"])
+        assert conc == {"unguarded-attr-access",
+                        "guarded-by-unknown-lock",
+                        "lock-order-inversion",
+                        "blocking-call-under-lock",
+                        "callback-under-lock", "thread-no-join"}
+        # non-family tokens pass through untouched
+        assert expand_rule_names(["prng-key-reuse"]) == \
+            {"prng-key-reuse"}
 
 
 # ---------------------------------------------------------- self-lint
@@ -691,6 +986,39 @@ class TestSelfLint:
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 1
         assert "prng-key-reuse" in out.stdout
+
+    def test_cli_flags_seeded_concurrency_violations(self, tmp_path):
+        """The concurrency family's acceptance gate: a seeded
+        lock-order inversion AND a seeded unguarded write exit 1
+        naming each rule."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "racy.py").write_text(
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self._seen = {}   # guarded-by: self._a\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+            "    def write(self, k):\n"
+            "        self._seen[k] = 1\n")
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.jaxlint]\ninclude = [\"pkg\"]\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+             "--root", str(tmp_path), "--rules", "concurrency"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 1
+        assert "lock-order-inversion" in out.stdout
+        assert "unguarded-attr-access" in out.stdout
 
 
 class TestFindingModel:

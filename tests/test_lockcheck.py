@@ -1,0 +1,145 @@
+"""Runtime lock-order harness (``rocalphago_tpu/analysis/lockcheck``).
+
+Units for the instrumented primitives: observed-edge recording and
+cycle detection on a seeded A→B/B→A inversion, held-set bookkeeping
+under RLock reentry, the blocking-wait-while-holding flag, the
+contention/wait metrics, and the disabled-by-default contract (the
+factories hand back plain ``threading`` primitives unless
+``ROCALPHAGO_LOCKCHECK=1``). The integration face — the serve soak
+as a deadlock detector plus the observed⊆static reconciliation —
+lives in ``tests/test_serve.py``; the static half's rule fixtures in
+``tests/test_jaxlint.py``. Stdlib-only, no jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from rocalphago_tpu.analysis import lockcheck
+from rocalphago_tpu.obs import registry as obs_registry
+
+
+@pytest.fixture
+def checked(monkeypatch):
+    monkeypatch.setenv(lockcheck.LOCKCHECK_ENV, "1")
+    lockcheck.reset()
+    yield lockcheck
+    lockcheck.reset()
+
+
+def test_disabled_factories_return_plain_primitives(monkeypatch):
+    monkeypatch.delenv(lockcheck.LOCKCHECK_ENV, raising=False)
+    assert not lockcheck.enabled()
+    lk = lockcheck.make_lock("X._lock")
+    assert not isinstance(lk, lockcheck.CheckedLock)
+    with lk:
+        pass                      # a plain threading.Lock
+    cond = lockcheck.make_condition("X._cond")
+    assert isinstance(cond, threading.Condition)
+
+
+def test_edges_recorded_and_inversion_raises(checked):
+    a = checked.make_lock("A._lock")
+    b = checked.make_lock("B._lock")
+    with a:
+        with b:
+            assert checked.held_sites() == ("A._lock", "B._lock")
+    assert checked.observed_edges() == {("A._lock", "B._lock")}
+    # the seeded inversion: B then A closes the cycle immediately
+    with pytest.raises(checked.LockOrderInversion) as ei:
+        with b:
+            with a:
+                pass
+    assert "A._lock" in str(ei.value) and "B._lock" in str(ei.value)
+    # the failed acquire unwound: nothing held, lock A re-usable
+    assert checked.held_sites() == ()
+    with a:
+        pass
+
+
+def test_rlock_reentry_holds_once_no_self_edge(checked):
+    r = checked.make_rlock("R._lock")
+    with r:
+        with r:
+            assert checked.held_sites() == ("R._lock",)
+        assert checked.held_sites() == ("R._lock",)
+    assert checked.held_sites() == ()
+    assert checked.observed_edges() == set()
+
+
+def test_condition_wait_while_holding_flags(checked):
+    outer = checked.make_lock("Outer._lock")
+    cond = checked.make_condition("C._cond")
+    with outer:
+        with cond:
+            with pytest.raises(checked.BlockingUnderLock):
+                cond.wait(0.01)
+    # a lone wait is the sanctioned pattern: releases + reacquires
+    with cond:
+        cond.wait(0.01)
+        assert checked.held_sites() == ("C._cond",)
+    assert checked.held_sites() == ()
+
+
+def test_condition_coordinates_threads(checked):
+    """The wrapper still works as a Condition: a waiter is woken by
+    a notifier, with correct held-set bookkeeping on both sides."""
+    cond = checked.make_condition("W._cond")
+    box = {"ready": False, "seen": False}
+
+    def waiter():
+        with cond:
+            while not box["ready"]:
+                cond.wait(1.0)
+            box["seen"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        box["ready"] = True
+        cond.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive() and box["seen"]
+
+
+def test_contention_and_wait_metrics(checked):
+    lk = checked.make_lock("Contended._lock")
+    lk.acquire()
+
+    def contend():
+        lk.acquire()
+        lk.release()
+
+    t = threading.Thread(target=contend)
+    t.start()
+    time.sleep(0.05)
+    lk.release()
+    t.join(timeout=5)
+    snap = obs_registry.snapshot()
+    assert snap["counters"][
+        'lock_contention_total{site="Contended._lock"}'] >= 1
+    hist = snap["histograms"][
+        'lock_wait_seconds{site="Contended._lock"}']
+    assert hist["count"] >= 2      # both acquires observed a wait
+
+
+def test_transitive_cycle_detected(checked):
+    """A→B and B→C recorded, then C→A must raise: the cycle check
+    walks the whole observed graph, not just the direct reverse."""
+    a = checked.make_lock("TA._lock")
+    b = checked.make_lock("TB._lock")
+    c = checked.make_lock("TC._lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(checked.LockOrderInversion):
+        with c:
+            with a:
+                pass

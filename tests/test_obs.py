@@ -230,16 +230,28 @@ def test_metrics_logger_write_is_file_only(tmp_path, capsys):
     assert [r["event"] for r in _records(path)] == ["span", "loud"]
 
 
-def test_concurrent_emit_from_many_sessions(tmp_path):
+@pytest.mark.parametrize("with_lockcheck", [False, True],
+                         ids=["plain", "lockcheck"])
+def test_concurrent_emit_from_many_sessions(tmp_path, monkeypatch,
+                                            with_lockcheck):
     """The serving pool's emit pattern — N session threads
     interleaving logger events with registry counter/histogram
     updates through ONE MetricsLogger — must lose nothing and tear
     nothing: every line strict-parses, counts are exact, and the
     histogram saw every observation (the thread-safety satellite of
-    the serve PR; registry audit in obs/registry.py's docstring)."""
+    the serve PR; registry audit in obs/registry.py's docstring).
+    The lockcheck variant rebuilds the logger with the instrumented
+    lock (ROCALPHAGO_LOCKCHECK=1), turning the same hammering into a
+    race/deadlock detector: any lock-order cycle or blocking-while-
+    held raises out of a worker and fails the count asserts."""
     import threading
 
+    from rocalphago_tpu.analysis import lockcheck
     from rocalphago_tpu.obs import registry
+
+    if with_lockcheck:
+        monkeypatch.setenv(lockcheck.LOCKCHECK_ENV, "1")
+        lockcheck.reset()
 
     n_threads, n_events = 8, 150
     path = tmp_path / "m.jsonl"

@@ -286,17 +286,12 @@ def test_probes_carry_serve_fields(pool):
 
 # ------------------------------------------------------------- soak
 
-def test_soak_faults_and_hang_do_not_stall_the_evaluator(pool,
-                                                         tmp_path):
-    """The satellite soak: three concurrent sessions under a fault
-    plan injecting (1) one transient evaluator fault — failing
-    exactly one batch, whose sessions step down and retry — and
-    (2) one 1.5 s hang inside one session's search rung, abandoned
-    by that session's watchdog at 0.4 s. Every session finishes all
-    its moves with legal vertices, exactly one session records the
-    hang, and the shared evaluator keeps serving throughout and
-    after."""
-    metrics_path = tmp_path / "metrics.jsonl"
+def _run_soak(pool, metrics_path):
+    """The soak body (see ``test_soak_faults_and_hang_...``): three
+    concurrent sessions under one transient evaluator fault + one
+    hung search rung; asserts isolation, legality and evaluator
+    liveness. Shared by the plain run and the lockcheck-enabled
+    run."""
     metrics = MetricsLogger(str(metrics_path), echo=False)
     sessions = [pool.open_session() for _ in range(3)]
     for s in sessions:
@@ -358,3 +353,63 @@ def test_soak_faults_and_hang_do_not_stall_the_evaluator(pool,
     finally:
         for s in sessions:
             s.close()
+
+
+def test_soak_faults_and_hang_do_not_stall_the_evaluator(pool,
+                                                         tmp_path):
+    """The satellite soak: three concurrent sessions under a fault
+    plan injecting (1) one transient evaluator fault — failing
+    exactly one batch, whose sessions step down and retry — and
+    (2) one 1.5 s hang inside one session's search rung, abandoned
+    by that session's watchdog at 0.4 s. Every session finishes all
+    its moves with legal vertices, exactly one session records the
+    hang, and the shared evaluator keeps serving throughout and
+    after."""
+    _run_soak(pool, tmp_path / "metrics.jsonl")
+
+
+def test_soak_under_lockcheck_reconciles_static_graph(
+        pool, nets, tmp_path, monkeypatch):
+    """The soak as a race/deadlock detector: ROCALPHAGO_LOCKCHECK=1
+    swaps every serve-stack lock for the instrumented wrappers
+    (rocalphago_tpu/analysis/lockcheck.py), which raise on any
+    observed lock-order cycle or wait-while-holding. Afterwards the
+    OBSERVED acquisition graph must be a subset of the STATIC graph
+    the concurrency lint family built — an observed edge the model
+    lacks means the declared model is wrong (docs/CONCURRENCY.md)."""
+    import os
+
+    from rocalphago_tpu.analysis import load_config, lockcheck
+    from rocalphago_tpu.analysis.core import (
+        LintContext, discover_files, parse_modules,
+    )
+    from rocalphago_tpu.analysis.rules.concurrency import (
+        build_lock_graph,
+    )
+
+    monkeypatch.setenv(lockcheck.LOCKCHECK_ENV, "1")
+    lockcheck.reset()
+    pol, val = nets
+    # fresh pool so every lock is constructed CHECKED; the injected
+    # searcher shares the module pool's compiled programs
+    with ServePool(val, pol, n_sim=6, max_sessions=4,
+                   batch_sizes=(1, 2, 4), max_wait_us=2000,
+                   searcher=pool.search) as checked_pool:
+        checked_pool.warm()
+        _run_soak(checked_pool, tmp_path / "metrics.jsonl")
+    observed = lockcheck.observed_edges()
+    assert observed, "lockcheck observed no lock nesting at all"
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = load_config(repo)
+    mods, _ = parse_modules(repo, discover_files(repo, cfg))
+    static = build_lock_graph(LintContext(repo, cfg, mods))
+    unmodeled = observed - set(static["edges"])
+    assert not unmodeled, (
+        f"observed lock-order edges missing from the static "
+        f"acquisition graph: {sorted(unmodeled)}")
+    # the production site labels ARE static lock identities
+    assert set(static["locks"]) >= {
+        "BatchingEvaluator._cond", "ServePool._lock",
+        "AdmissionController._lock", "MetricsLogger._lock",
+        "trace._lock", "native._lock"}
