@@ -1,0 +1,173 @@
+"""Actor/learner scaling for the zero loop (docs/SCALE.md).
+
+Measures, per actor count, on one mesh: games-ingested/min into the
+replay buffer, learner steps/s, and the learner-idle fraction — vs
+the synchronous loop's baseline, whose self-play phase fraction IS
+its learner idleness (the update waits out every self-play phase).
+The actor/learner split exists to push that idle fraction down: the
+sweep runs the decoupled configuration (free-running actors,
+prioritized-recency sampling), where the learner's cadence is no
+longer gated on fresh games — it waits only for the initial fill.
+Device sections share a ``DispatchGang`` (``training/actor.py``):
+on one mesh, concurrent play/learn programs with collectives must
+not interleave.
+
+CPU: run with a virtual 8-device mesh (the default here — the
+``--no-force-host-devices`` flag disables the XLA override for real
+accelerators, where the platform's own devices form the mesh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+# the virtual-device override must land before jax imports (no
+# conftest here); harmless but pointless on TPU, hence the flag
+if ("--no-force-host-devices" not in sys.argv
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+from benchmarks._harness import report, std_parser  # noqa: E402
+
+
+def main() -> None:
+    import time
+
+    import jax
+    import optax
+
+    from rocalphago_tpu.data.replay import ReplayBuffer
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.parallel import mesh as meshlib
+    from rocalphago_tpu.training.actor import (
+        DispatchGang,
+        ParamsPublisher,
+        SelfplayActor,
+    )
+    from rocalphago_tpu.training.learner import ZeroLearner
+    from rocalphago_tpu.training.zero import (
+        init_zero_state,
+        make_zero_iteration,
+    )
+
+    ap = std_parser(__doc__)
+    ap.add_argument("--actors", default="1,2,4",
+                    help="comma-separated actor counts to sweep")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="learner steps measured per actor count")
+    ap.add_argument("--move-limit", type=int, default=16)
+    ap.add_argument("--sims", type=int, default=4)
+    ap.add_argument("--sim-chunk", type=int, default=2)
+    ap.add_argument("--replay-chunk", type=int, default=8)
+    ap.add_argument("--no-force-host-devices", action="store_true",
+                    help="keep the platform's real devices (TPU)")
+    ap.set_defaults(board=5, batch=8)
+    args = ap.parse_args()
+
+    feats = ("board", "ones")
+    vfeats = feats + ("color",)
+    pol = CNNPolicy(feats, board=args.board, layers=1,
+                    filters_per_layer=4)
+    val = CNNValue(vfeats, board=args.board, layers=1,
+                   filters_per_layer=4)
+    cfg = GoConfig(size=args.board)
+    tx_p, tx_v = optax.sgd(0.01), optax.sgd(0.01)
+    n_dev = len(jax.devices())
+    while args.batch % n_dev:
+        n_dev -= 1
+    mesh = meshlib.make_mesh(n_dev)
+    mesh_shape = (f"{mesh.shape[meshlib.DATA_AXIS]}"
+                  f"x{mesh.shape[meshlib.MODEL_AXIS]}")
+    iteration = make_zero_iteration(
+        cfg, feats, vfeats, pol.module.apply, val.module.apply,
+        tx_p, tx_v, batch=args.batch, move_limit=args.move_limit,
+        n_sim=args.sims, max_nodes=16, sim_chunk=args.sim_chunk,
+        replay_chunk=args.replay_chunk, mesh=mesh)
+    state0 = meshlib.replicate(mesh, init_zero_state(
+        pol.params, val.params, tx_p, tx_v, seed=0))
+
+    # ---------------- synchronous baseline: selfplay-phase fraction
+    def sync_iter(state):
+        _, game_key = jax.random.split(unpack_rng(state.rng))
+        t0 = time.monotonic()
+        games = jax.device_get(iteration.play(
+            state.policy_params, state.value_params, game_key))
+        t1 = time.monotonic()
+        state, m = iteration.learn(state, games)
+        float(jax.device_get(m["policy_loss"]))    # sync
+        return state, t1 - t0, time.monotonic() - t1
+
+    state, _, _ = sync_iter(state0)                # compile
+    t_play = t_learn = 0.0
+    reps = max(args.reps, 2)
+    t0 = time.monotonic()
+    for _ in range(reps):
+        state, dp, dl = sync_iter(state)
+        t_play += dp
+        t_learn += dl
+    sync_dt = time.monotonic() - t0
+    selfplay_frac = t_play / max(t_play + t_learn, 1e-9)
+    report("zero_sync_games_per_min",
+           reps * args.batch * 60.0 / sync_dt, "games/min",
+           batch=args.batch, board=args.board, actors=0,
+           mesh_shape=mesh_shape,
+           selfplay_frac=round(selfplay_frac, 4))
+
+    # ---------------- actor/learner sweep
+    for n_actors in [int(x) for x in str(args.actors).split(",")]:
+        buf = ReplayBuffer(capacity=max(2 * n_actors, 4))
+        pub = ParamsPublisher()
+        gang = DispatchGang()
+        actors = []
+        for i in range(n_actors):
+            rng = pack_rng(jax.random.fold_in(
+                unpack_rng(state0.rng), i + 1))
+            actors.append(SelfplayActor(
+                iteration.play, pub, buf, rng, name=f"a{i}",
+                lockstep=False, pace=False, poll_s=0.1, gang=gang))
+        learner = ZeroLearner(iteration.learn, buf, sample=True,
+                              gang=gang)
+        pub.publish(state0.policy_params, state0.value_params,
+                    version=0)
+        for ac in actors:
+            ac.start()
+        state = state0
+        t0 = time.monotonic()
+        for step in range(args.steps):
+            out = learner.step(state, timeout=300.0)
+            if out is None:
+                err = next((ac.error for ac in actors if ac.error),
+                           None)
+                raise RuntimeError(
+                    f"learner starved at step {step} "
+                    f"(actor error: {err})")
+            state, m, _ = out
+            pub.publish(state.policy_params, state.value_params,
+                        version=step + 1)
+        dt = time.monotonic() - t0
+        ingested = buf.ingested_games
+        buf.close()
+        for ac in actors:
+            ac.stop()
+        idle = round(learner.idle_frac, 4)
+        report("zero_ingest_games_per_min",
+               ingested * 60.0 / dt, "games/min",
+               batch=args.batch, board=args.board, actors=n_actors,
+               mesh_shape=mesh_shape, learner_idle_frac=idle,
+               sync_selfplay_frac=round(selfplay_frac, 4))
+        report("zero_learner_steps_per_s", args.steps / dt,
+               "steps/s", batch=args.batch, board=args.board,
+               actors=n_actors, mesh_shape=mesh_shape,
+               learner_idle_frac=idle)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,392 @@
+"""Replay-buffer service: the hand-off point between self-play actors
+and the sharded learner (docs/SCALE.md).
+
+A bounded, thread-safe ring of finished self-play batches
+(:class:`ZeroGames`). Producers (``training/actor.py``) ``put``
+batches — blocking when full (pacing) or evicting the oldest
+(free-run) — and consumers take them out either FIFO
+(:meth:`ReplayBuffer.next_batch`, the bit-exact lockstep path) or by
+prioritized-recency draw (:meth:`ReplayBuffer.sample`, geometric from
+the newest entry, which approximates the KataGo-style sliding window
+without ever blocking the learner on a specific game).
+
+Durability and transport:
+
+- crash-safe spill: with ``spill_dir`` set, every accepted entry is
+  persisted via :func:`rocalphago_tpu.runtime.atomic.atomic_write_json`
+  (tmp + fsync + rename — a crash never leaves a torn file) and
+  removed again when consumed or evicted; :meth:`ReplayBuffer.restore`
+  reloads whatever survived, skipping anything unreadable.
+- tolerant-JSONL ingest: :class:`JsonlIngester` tails ``*.jsonl``
+  shards written by out-of-process actors (one game record per line),
+  consuming only newline-terminated lines so a writer crashed
+  mid-line never poisons the stream — the torn tail is simply re-read
+  on the next poll once completed.
+
+Observability (all emitted OUTSIDE the buffer lock):
+``replay_fill_games`` gauge, ``replay_ingest_games_total`` counter,
+``replay_ingest_per_min`` gauge, ``replay_sample_staleness_seconds``
+histogram (age of each consumed/sampled entry),
+``replay_evicted_games_total`` + ``replay_spilled_total`` counters.
+Blocking waits are tagged :func:`rocalphago_tpu.runtime.watchdog
+.waiting_on` ``("replay_fill")`` so a starving learner's stall events
+are distinguishable from a hang.
+
+This module is deliberately jax-free (numpy only): report scripts and
+out-of-process ingest helpers can import it without touching a
+backend.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import NamedTuple
+
+import numpy as np
+
+from rocalphago_tpu.analysis import lockcheck
+from rocalphago_tpu.obs import registry
+from rocalphago_tpu.runtime import atomic, watchdog
+
+CAPACITY_ENV = "ROCALPHAGO_REPLAY_CAPACITY"
+SAMPLE_P_ENV = "ROCALPHAGO_REPLAY_SAMPLE_P"
+
+
+def default_capacity() -> int:
+    """Buffer capacity in entries (one entry = one self-play batch)."""
+    return int(os.environ.get(CAPACITY_ENV, "8"))
+
+
+def default_sample_p() -> float:
+    """Geometric recency parameter for :meth:`ReplayBuffer.sample`."""
+    return float(os.environ.get(SAMPLE_P_ENV, "0.5"))
+
+
+class ZeroGames(NamedTuple):
+    """One finished self-play batch — the unit the buffer stores.
+
+    Raw recorder dtypes, exactly as ``training.zero``'s self-play
+    returns them (the learner does its own float casts, so a
+    host round-trip through the buffer stays bit-exact):
+
+    - ``actions``: ``[T, B]`` int32 move indices per ply
+    - ``live``: ``[T, B]`` bool — ply happened before the game ended
+    - ``visits``: ``[T, B, A]`` visit counts (int32) or improved-
+      policy targets (float32, gumbel mode)
+    - ``winners``: ``[B]`` int32 (+1 black / -1 white / 0 draw)
+    - ``finished``: ``[B]`` bool — game ended by two passes
+    """
+
+    actions: np.ndarray
+    live: np.ndarray
+    visits: np.ndarray
+    winners: np.ndarray
+    finished: np.ndarray
+
+
+class ReplayEntry(NamedTuple):
+    """A buffered batch plus its provenance: ``seq`` (ingest order),
+    ``version`` (params snapshot that played it — staleness = learner
+    version minus this) and ``t_ingest`` (monotonic, for age)."""
+
+    seq: int
+    version: int
+    games: ZeroGames
+    t_ingest: float
+
+
+def games_to_record(games: ZeroGames, version: int = 0,
+                    seq: int = 0) -> dict:
+    """JSON-serializable record preserving shapes and dtypes."""
+    rec = {"version": int(version), "seq": int(seq)}
+    for name, arr in zip(ZeroGames._fields, games):
+        a = np.asarray(arr)
+        rec[name] = a.tolist()
+        rec[name + "_dtype"] = str(a.dtype)
+    return rec
+
+
+def record_to_games(rec: dict) -> tuple[ZeroGames, int]:
+    """Inverse of :func:`games_to_record`; raises ``KeyError`` /
+    ``TypeError`` / ``ValueError`` on malformed records (callers
+    treat those as torn input and skip)."""
+    arrs = [np.asarray(rec[name], dtype=np.dtype(rec[name + "_dtype"]))
+            for name in ZeroGames._fields]
+    return ZeroGames(*arrs), int(rec.get("version", 0))
+
+
+class ReplayBuffer:
+    """Bounded thread-safe ring of :class:`ReplayEntry`.
+
+    ``capacity`` is in entries; ``put(block=True)`` paces producers
+    (waits for a FIFO consumer to make room), ``put(block=False)``
+    evicts the oldest entry instead — the right mode when the
+    consumer is :meth:`sample`, which never removes entries.
+    """
+
+    def __init__(self, capacity: int | None = None, *,
+                 sample_p: float | None = None,
+                 spill_dir: str | None = None, seed: int = 0):
+        self.capacity = (default_capacity() if capacity is None
+                         else int(capacity))
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.sample_p = (default_sample_p() if sample_p is None
+                         else float(sample_p))
+        if not 0.0 < self.sample_p <= 1.0:
+            raise ValueError(f"sample_p must be in (0, 1], "
+                             f"got {self.sample_p}")
+        self.spill_dir = spill_dir
+        self._cond = lockcheck.make_condition("ReplayBuffer._cond")
+        self._entries: list[ReplayEntry] = []  # guarded-by: self._cond
+        self._seq = 0                          # guarded-by: self._cond
+        self._closed = False                   # guarded-by: self._cond
+        self._ingested = 0                     # guarded-by: self._cond
+        self._t_first: float | None = None     # guarded-by: self._cond
+        self._rng = np.random.default_rng(seed)  # guarded-by: self._cond
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    # ------------------------------------------------------- producers
+
+    def put(self, games: ZeroGames, version: int = 0,
+            block: bool = False, timeout: float | None = None) -> bool:
+        """Append a batch; True if accepted, False on timeout/closed.
+
+        ``block=True`` waits for room (producer pacing — bounds
+        sample staleness by construction); ``block=False`` evicts the
+        oldest entry when full.
+        """
+        games = ZeroGames(*(np.asarray(x) for x in games))
+        n_games = int(games.winners.shape[0])
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        evict_seqs: list[int] = []
+        evicted_games = 0
+        with self._cond:
+            while (block and not self._closed
+                   and len(self._entries) >= self.capacity):
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(rem)
+            if self._closed:
+                return False
+            while len(self._entries) >= self.capacity:
+                old = self._entries.pop(0)
+                evict_seqs.append(old.seq)
+                evicted_games += int(old.games.winners.shape[0])
+            entry = ReplayEntry(self._seq, int(version), games,
+                                time.monotonic())
+            self._seq += 1
+            self._entries.append(entry)
+            self._ingested += n_games
+            if self._t_first is None:
+                self._t_first = time.monotonic()
+            fill = sum(int(e.games.winners.shape[0])
+                       for e in self._entries)
+            total, t_first = self._ingested, self._t_first
+            self._cond.notify_all()
+        if self.spill_dir:
+            atomic.atomic_write_json(
+                self._spill_path(entry.seq),
+                games_to_record(games, entry.version, entry.seq),
+                indent=None)
+            registry.counter("replay_spilled_total").inc()
+            for seq in evict_seqs:
+                self._unspill(seq)
+        registry.gauge("replay_fill_games").set(fill)
+        registry.counter("replay_ingest_games_total").inc(n_games)
+        minutes = max(time.monotonic() - t_first, 1e-9) / 60.0
+        registry.gauge("replay_ingest_per_min").set(total / minutes)
+        if evicted_games:
+            registry.counter("replay_evicted_games_total").inc(
+                evicted_games)
+        return True
+
+    # ------------------------------------------------------- consumers
+
+    def next_batch(self, timeout: float | None = None) \
+            -> ReplayEntry | None:
+        """FIFO-pop the oldest entry (the lockstep/bit-exact path).
+
+        Blocks until an entry arrives; None on timeout or when the
+        buffer is closed and drained.
+        """
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with watchdog.waiting_on("replay_fill"):
+            with self._cond:
+                while not self._entries and not self._closed:
+                    rem = (None if deadline is None
+                           else deadline - time.monotonic())
+                    if rem is not None and rem <= 0:
+                        return None
+                    self._cond.wait(rem)
+                if not self._entries:
+                    return None
+                entry = self._entries.pop(0)
+                fill = sum(int(e.games.winners.shape[0])
+                           for e in self._entries)
+                self._cond.notify_all()   # room for paced producers
+        if self.spill_dir:
+            self._unspill(entry.seq)      # consumed — don't restore it
+        self._observe_out(entry, fill)
+        return entry
+
+    def sample(self, timeout: float | None = None) \
+            -> ReplayEntry | None:
+        """Prioritized-recency draw (geometric from the newest entry,
+        parameter ``sample_p``); the entry stays in the ring. Blocks
+        until non-empty; None on timeout/closed-and-empty."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with watchdog.waiting_on("replay_fill"):
+            with self._cond:
+                while not self._entries and not self._closed:
+                    rem = (None if deadline is None
+                           else deadline - time.monotonic())
+                    if rem is not None and rem <= 0:
+                        return None
+                    self._cond.wait(rem)
+                if not self._entries:
+                    return None
+                n = len(self._entries)
+                back = min(int(self._rng.geometric(self.sample_p)) - 1,
+                           n - 1)
+                entry = self._entries[n - 1 - back]
+                fill = sum(int(e.games.winners.shape[0])
+                           for e in self._entries)
+        self._observe_out(entry, fill)
+        return entry
+
+    # ------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Reject further puts and unblock every waiter (consumers
+        drain what's left, then get None)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    @property
+    def fill(self) -> int:
+        with self._cond:
+            return len(self._entries)
+
+    @property
+    def ingested_games(self) -> int:
+        with self._cond:
+            return self._ingested
+
+    # ----------------------------------------------------- persistence
+
+    def restore(self) -> int:
+        """Reload spilled entries after a crash; returns the count.
+
+        Tolerant: unreadable/torn files are skipped. All on-disk
+        files are consumed (removed) and the survivors re-spilled
+        under fresh sequence numbers, so a second crash can't
+        double-restore."""
+        if not self.spill_dir:
+            return 0
+        paths = sorted(glob.glob(
+            os.path.join(self.spill_dir, "entry.*.json")))
+        recovered = []
+        for path in paths:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    rec = json.load(f)
+                recovered.append(record_to_games(rec))
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        for path in paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        n = 0
+        for games, version in recovered:
+            if self.put(games, version=version, block=False):
+                n += 1
+        return n
+
+    def _spill_path(self, seq: int) -> str:
+        return os.path.join(self.spill_dir, f"entry.{seq:08d}.json")
+
+    def _unspill(self, seq: int) -> None:
+        try:
+            os.unlink(self._spill_path(seq))
+        except OSError:
+            pass
+
+    def _observe_out(self, entry: ReplayEntry, fill: int) -> None:
+        registry.histogram("replay_sample_staleness_seconds").observe(
+            time.monotonic() - entry.t_ingest)
+        registry.gauge("replay_fill_games").set(fill)
+
+
+class JsonlIngester:
+    """Tail ``*.jsonl`` shards in a directory into a buffer — the
+    transport for out-of-process actors (each actor process appends
+    game records to its own shard; see docs/SCALE.md).
+
+    Single-consumer by design (no locks): per-shard byte offsets live
+    on the instance, and only newline-terminated lines are consumed —
+    a torn tail (writer mid-append or crashed) is left for the next
+    :meth:`poll`. Records that fail to parse or decode are counted
+    and skipped, never fatal.
+    """
+
+    def __init__(self, buffer: ReplayBuffer, path: str):
+        self.buffer = buffer
+        self.path = path
+        self.skipped = 0
+        self._offsets: dict[str, int] = {}
+
+    def poll(self) -> int:
+        """Ingest every complete new line; returns entries added."""
+        added = 0
+        for shard in sorted(glob.glob(
+                os.path.join(self.path, "*.jsonl"))):
+            try:
+                with open(shard, "rb") as f:
+                    f.seek(self._offsets.get(shard, 0))
+                    data = f.read()
+            except OSError:
+                continue
+            end = data.rfind(b"\n")
+            if end < 0:
+                continue
+            for line in data[:end].splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    games, version = record_to_games(json.loads(line))
+                except (ValueError, KeyError, TypeError):
+                    self.skipped += 1
+                    continue
+                if self.buffer.put(games, version=version):
+                    added += 1
+            self._offsets[shard] = self._offsets.get(shard, 0) + end + 1
+        return added
+
+
+def append_jsonl_record(path: str, games: ZeroGames,
+                        version: int = 0, seq: int = 0) -> None:
+    """Producer side of the JSONL transport: append one record as a
+    single newline-terminated line (the ingester's torn-line rule
+    makes a concurrent reader safe without locking)."""
+    line = json.dumps(games_to_record(games, version, seq),
+                      separators=(",", ":")) + "\n"
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(line)

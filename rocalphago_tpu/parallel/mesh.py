@@ -122,6 +122,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return _cached_sharding(mesh, P())
 
 
+def axis_sharding(mesh: Mesh, axis: int) -> NamedSharding:
+    """Shard dimension ``axis`` over ``data``, all other dimensions
+    replicated — e.g. ``axis=1`` for time-major ``[T, B, ...]`` game
+    histories (the zero replay layout, docs/SCALE.md). The spec is a
+    valid pytree-prefix/partial spec: trailing dimensions beyond
+    ``axis`` are implicitly replicated."""
+    return _cached_sharding(mesh, P(*(None,) * axis, DATA_AXIS))
+
+
 def shard_batch(mesh: Mesh, batch):
     """Place a host pytree of arrays with leading batch axes onto the
     mesh, batch axis split over ``data``."""

@@ -16,18 +16,58 @@ the ``span`` field is the deepest open tracing span across all
 threads (:func:`rocalphago_tpu.obs.trace.where`) at the moment the
 watchdog fired — e.g. ``zero.iteration/zero.selfplay`` — so the
 operator reads the stuck phase straight off ``metrics.jsonl``.
+
+Starvation vs deadlock: a learner blocked on an empty replay buffer
+produces the same no-beat signature as a wedged device program. Code
+that blocks *by design* wraps the wait in :func:`waiting_on`, and the
+stall event gains a ``waiting_on`` field (e.g. ``replay_fill``) so
+soak analysis can tell "waiting for producers" from "hung".
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import sys
 import threading
 import time
 
+from rocalphago_tpu.analysis import lockcheck
 from rocalphago_tpu.obs import trace
 
 STALL_EXIT_CODE = 170
+
+_waiting_lock = lockcheck.make_lock("watchdog._waiting_lock")
+_waiting: dict[int, str] = {}  # guarded-by: _waiting_lock
+
+
+@contextlib.contextmanager
+def waiting_on(phase: str):
+    """Tag the calling thread as deliberately blocked on ``phase``.
+
+    Nested tags restore the outer phase on exit; the registry is
+    keyed by thread ident so concurrent waiters don't clobber each
+    other. The lock is released across the yield — the tag is a
+    plain dict entry while the caller blocks.
+    """
+    ident = threading.get_ident()
+    with _waiting_lock:
+        prev = _waiting.get(ident)
+        _waiting[ident] = phase
+    try:
+        yield
+    finally:
+        with _waiting_lock:
+            if prev is None:
+                _waiting.pop(ident, None)
+            else:
+                _waiting[ident] = prev
+
+
+def waiting_phases() -> tuple[str, ...]:
+    """Sorted distinct phases threads are currently blocked on."""
+    with _waiting_lock:
+        return tuple(sorted(set(_waiting.values())))
 
 
 class Watchdog:
@@ -86,14 +126,18 @@ class Watchdog:
 
     def _log(self, elapsed: float) -> None:
         at = trace.where()          # deepest open span, any thread
+        waits = waiting_phases()
+        waiting = ",".join(waits) if waits else None
         if self.metrics is not None:
             self.metrics.log("stall", watchdog=self.name,
                              elapsed_s=round(elapsed, 1),
-                             deadline_s=self.deadline_s, span=at)
+                             deadline_s=self.deadline_s, span=at,
+                             waiting_on=waiting)
         else:
             print(f"watchdog[{self.name}]: no heartbeat for "
                   f"{elapsed:.0f}s (deadline {self.deadline_s:.0f}s)"
-                  f"{f' in {at}' if at else ''}",
+                  f"{f' in {at}' if at else ''}"
+                  f"{f' waiting on {waiting}' if waiting else ''}",
                   file=sys.stderr)
 
     def _watch(self) -> None:

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from rocalphago_tpu.data.replay import ZeroGames
 from rocalphago_tpu.engine import jaxgo
 from rocalphago_tpu.features.planes import batched_encoder, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
@@ -60,6 +61,19 @@ class ZeroState(NamedTuple):
     opt_value: tuple
     iteration: jax.Array   # int32 []
     rng: jax.Array         # uint32 key data
+
+
+def next_keys(rng_bits):
+    """Step the zero rng chain one iteration: ``(rng_bits) ->
+    (next_rng_bits, game_key)``.
+
+    EXACTLY the split ``iteration`` performs: the game key sequence
+    depends only on the seed rng, never on game content or params —
+    which is what lets a detached self-play actor walk the chain
+    locally and reproduce the synchronous loop's games bit-for-bit
+    (docs/SCALE.md)."""
+    key, game_key = jax.random.split(unpack_rng(rng_bits))
+    return pack_rng(key), game_key
 
 
 def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
@@ -161,8 +175,36 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         # share the ply's one group analysis with the rules step
         return (vstep(states, actions_t, gd), grads_p, grads_v, stats)
 
+    # Explicit in/out shardings (not just internal constraints) when a
+    # mesh is supplied: params/opt-state/grads replicated, the game
+    # batch sharded on `data` (batch-leading for [B]/GoState leaves,
+    # axis 1 for the time-major [T, B, ...] histories). Shardings are
+    # pytree prefixes, so one NamedSharding covers a whole subtree.
+    # This is what lets the detached learner compile ONE program whose
+    # inputs arrive from the replay buffer (host numpy) and land
+    # directly in the right placement — and it makes the collective
+    # layout part of the program's signature instead of an inference.
+    if mesh is None:
+        _replay_jit = functools.partial(jax.jit, donate_argnums=(4,))
+        _update_jit = jax.jit
+    else:
+        _rep = meshlib.replicated(mesh)
+        _dat = meshlib.data_sharding(mesh)
+        _tmaj = meshlib.axis_sharding(mesh, 1)
+        _carry_sh = (_dat, _rep, _rep, _rep)
+        _replay_jit = functools.partial(
+            jax.jit, donate_argnums=(4,),
+            in_shardings=(_rep, _rep, _dat, _dat, _carry_sh,
+                          _tmaj, _tmaj, _tmaj),
+            out_shardings=_carry_sh)
+        _update_jit = functools.partial(
+            jax.jit,
+            in_shardings=(_rep, _rep, _rep, _rep, _dat, _dat, _dat,
+                          _rep),
+            out_shardings=(_rep, _rep))
+
     @jaxobs.track("zero.replay_segment")
-    @functools.partial(jax.jit, donate_argnums=(4,))
+    @_replay_jit
     def replay_segment(policy_params, value_params, winners, finished,
                        carry, actions, live, visits):
         # segment length rides the xs shapes (one compile per distinct
@@ -182,7 +224,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
     replay_segment.donates_buffers = True
 
     @jaxobs.track("zero.apply_updates")
-    @jax.jit
+    @_update_jit
     def apply_updates(state: ZeroState, grads_p, grads_v, stats,
                       winners, finished, num_moves, key):
         up, opt_p = tx_policy.update(grads_p, state.opt_policy,
@@ -212,43 +254,62 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             optax.apply_updates(state.value_params, uv),
             opt_p, opt_v, state.iteration + 1, pack_rng(key)), metrics
 
-    def iteration(state: ZeroState, sp_policy_params=None,
-                  sp_value_params=None):
-        """One iteration. ``sp_*_params`` override which nets PLAY the
-        self-play games (the gated "best"/incumbent pair — AlphaGo's
-        evaluator discipline: the data generator only changes when a
-        candidate demonstrably beats it); gradients always update
-        ``state``'s candidate nets. Default: state's own nets play
-        (ungated self-play)."""
-        key = unpack_rng(state.rng)
-        key, game_key = jax.random.split(key)
+    def play(policy_params, value_params, game_key) -> ZeroGames:
+        """The ACTOR half: search self-play only — no optimizer
+        state, no gradients. Returns the raw game record the replay
+        buffer stores; any params snapshot can play (the gated
+        best pair, a stale actor copy) without touching the learner.
 
-        # phase spans (data = search self-play, step = replay +
-        # update): host wall time per phase — the self-play loop
-        # syncs per ply (its done-fetch), so its span is honest; the
-        # replay spans measure dispatch, with the sync landing in the
-        # caller's metrics fetch (see docs/OBSERVABILITY.md)
+        The self-play span is honest host wall time (the chunk loop
+        syncs per done-poll — see docs/OBSERVABILITY.md)."""
         with trace.span("zero.selfplay", plies=move_limit):
             final, actions, live, visits = selfplay(
-                state.policy_params if sp_policy_params is None
-                else sp_policy_params,
-                state.value_params if sp_value_params is None
-                else sp_value_params, game_key)
+                policy_params, value_params, game_key)
             winners = jax.vmap(
                 functools.partial(jaxgo.winner, cfg))(final)
+        return ZeroGames(actions, live, visits, winners, final.done)
+
+    def learn(state: ZeroState, games: ZeroGames):
+        """The LEARNER half: replay-gradient accumulation + one
+        optimizer step per net, from a recorded :class:`ZeroGames`
+        (device arrays or host numpy — the buffer round-trip is
+        bit-exact because the record keeps raw recorder dtypes).
+
+        Steps ``state.rng`` exactly as the synchronous iteration
+        does (re-deriving the same split ``play``'s caller used), so
+        ``learn(state, play(..., game_key))`` ==
+        ``iteration(state)`` bit-for-bit."""
+        key = unpack_rng(state.rng)
+        key, _ = jax.random.split(key)   # the slot play's key used
+
+        actions = jnp.asarray(games.actions)
+        live = jnp.asarray(games.live)
+        visits = jnp.asarray(games.visits)
+        winners = jnp.asarray(games.winners)
         wf = winners.astype(jnp.float32)
-        finished = final.done.astype(jnp.float32)
+        finished = jnp.asarray(games.finished).astype(jnp.float32)
+        live_f = live.astype(jnp.float32)
+        num_moves = live.sum(axis=0, dtype=jnp.int32)
 
         states = jaxgo.new_states(cfg, batch)
         if mesh is not None:
+            # commit every game array to the placement the jitted
+            # programs declare (device_put reshards legally even for
+            # committed arrays; letting jit see a mismatched
+            # committed sharding would error instead)
             states = meshlib.shard_batch(mesh, states)
+            winners, wf, finished, num_moves = (
+                jax.device_put(x, _dat)
+                for x in (winners, wf, finished, num_moves))
+            actions, live_f, visits = (
+                jax.device_put(x, _tmaj)
+                for x in (actions, live_f, visits))
         grads_p = jax.tree.map(jnp.zeros_like, state.policy_params)
         grads_v = jax.tree.map(jnp.zeros_like, state.value_params)
         # five DISTINCT zero arrays, not one repeated: the replay
         # segment donates the carry, and XLA rejects donating the
         # same buffer twice
         stats = tuple(jnp.float32(0) for _ in range(5))
-        live_f = live.astype(jnp.float32)
         plies = actions.shape[0]
         carry = (states, grads_p, grads_v, stats)
         # pipelined dispatch (runtime.pipeline): the pipeline paces
@@ -269,11 +330,35 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             pipe.finish()
         _, grads_p, grads_v, stats = carry
 
-        num_moves = live.sum(axis=0, dtype=jnp.int32)
         with trace.span("zero.update"):
             return apply_updates(state, grads_p, grads_v, stats,
                                  winners, finished, num_moves, key)
 
+    def iteration(state: ZeroState, sp_policy_params=None,
+                  sp_value_params=None):
+        """One iteration. ``sp_*_params`` override which nets PLAY the
+        self-play games (the gated "best"/incumbent pair — AlphaGo's
+        evaluator discipline: the data generator only changes when a
+        candidate demonstrably beats it); gradients always update
+        ``state``'s candidate nets. Default: state's own nets play
+        (ungated self-play).
+
+        Composed as ``learn(state, play(...))`` — the synchronous
+        path and the actor/learner split (docs/SCALE.md) run the
+        same two halves, so the A/B stays bit-exact for free."""
+        _, game_key = jax.random.split(unpack_rng(state.rng))
+        games = play(
+            state.policy_params if sp_policy_params is None
+            else sp_policy_params,
+            state.value_params if sp_value_params is None
+            else sp_value_params, game_key)
+        return learn(state, games)
+
+    # the halves ARE the public actor/learner API (training/actor.py
+    # and training/learner.py consume them); expose on the composed fn
+    iteration.play = play
+    iteration.learn = learn
+    iteration.batch = batch
     return iteration
 
 
@@ -546,6 +631,26 @@ def run_training(argv=None) -> dict:
     ap.add_argument("--gate-temperature", type=float, default=1.0,
                     help="sampling temperature for gate/ladder match "
                          "play")
+    ap.add_argument("--actor-learner", action="store_true",
+                    help="decouple self-play from the update "
+                         "(docs/SCALE.md): in-process actor threads "
+                         "stream finished games into a bounded "
+                         "replay buffer, and the learner consumes "
+                         "them at its own cadence. With --actors 1 "
+                         "the run is BIT-IDENTICAL to the "
+                         "synchronous loop (lockstep pacing); more "
+                         "actors free-run against the freshest "
+                         "published params")
+    ap.add_argument("--actors", type=int, default=1,
+                    help="self-play actor threads (--actor-learner)")
+    ap.add_argument("--replay-capacity", type=int, default=None,
+                    help="replay buffer capacity in game batches "
+                         "(default $ROCALPHAGO_REPLAY_CAPACITY or 8)")
+    ap.add_argument("--replay-sample", action="store_true",
+                    help="learner draws prioritized-recency samples "
+                         "instead of FIFO batches (breaks the "
+                         "bit-exact A/B; actors evict instead of "
+                         "pacing)")
     ap.add_argument("--iteration-deadline", type=float, default=0.0,
                     help="watchdog: seconds one iteration may take "
                          "before a 'stall' event is logged and the "
@@ -714,76 +819,167 @@ def run_training(argv=None) -> dict:
         watchdog = Watchdog(a.iteration_deadline, metrics=metrics,
                             abort_fn=_stall_abort, name="zero").start()
 
-    for it in range(start, a.iterations):
-        with trace.span("zero.iteration", iteration=it):
-            faults.barrier("zero.pre_iteration", it)
-            t0 = time.time()
-            state, m = run_iteration(state, best_p, best_v)
-            # the fetch below syncs the iteration's device programs,
-            # so zero.iteration is real end-to-end wall time and the
-            # replay spans' async remainder lands inside this span,
-            # not outside it
-            m = {k: float(jax.device_get(v)) for k, v in m.items()}
-            if watchdog is not None:
-                watchdog.beat()
-                last_done["state"] = jax.device_get(state)
-                last_done["step"] = it + 1
-            faults.barrier("zero.post_iteration", it)
-            entry = {"iteration": it, **m,
-                     "games_per_min": a.game_batch * 60.0
-                     / max(time.time() - t0, 1e-9)}
-            metrics.log("iteration", **entry)
-            meta.record_epoch(entry)
-            final = entry
-            if gate and ((it + 1) % gate_every == 0
-                         or it + 1 == a.iterations):
-                with trace.span("zero.gate", iteration=it):
-                    gkey, lkey = jax.random.split(
-                        jax.random.fold_in(gate_root, it))
-                    r = gate.match(state.policy_params, best_p, gkey)
-                    promoted, wilson_lb = gate.decide(r)
-                    if promoted:
-                        best_p, best_v = (state.policy_params,
-                                          state.value_params)
-                        gate.promote(best_p, best_v, it + 1)
-                    metrics.log("gate", iteration=it,
-                                promoted=promoted,
-                                wilson_lb=round(wilson_lb, 4), **r)
-                    # ladder probe: the (possibly new) incumbent vs a
-                    # sampled past best — the monotonicity evidence
-                    # round 4 lacked
-                    snap = gate.sample(a.seed, it)
-                    if snap is not None:
-                        lp, _ = gate.load(snap, jax.device_get(
-                            state.policy_params), jax.device_get(
-                            state.value_params))
-                        lr = gate.match(
-                            best_p, meshlib.replicate(mesh, lp), lkey)
-                        metrics.log("ladder", iteration=it,
-                                    opponent=snap[0], **lr)
-                    faults.barrier("zero.post_gate", it)
-            if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
-                # exports BEFORE the checkpoint save: everything
-                # written before the save that commits step it+1 is
-                # reproduced by a resume from the previous
-                # checkpoint, so a crash at any point leaves
-                # artifacts a resume makes identical to the
-                # uninterrupted run (the save is the commit point)
-                with trace.span("zero.export", iteration=it):
-                    export(it + 1)
-                    faults.barrier("zero.post_export", it)
-                with trace.span("zero.save", iteration=it):
-                    faults.barrier("zero.pre_save", it)
-                    ckpt.save(it + 1, jax.device_get(state))
-                    if faults.active():
-                        # barriers are DETERMINISTIC points: under an
-                        # active fault plan the async save commits
-                        # before post_save, so crash@pre_save/
-                        # post_save cleanly separate uncommitted from
-                        # committed (a real crash can land anywhere —
-                        # the chaos sweep covers that too)
-                        ckpt.wait()
-                    faults.barrier("zero.post_save", it)
+    # actor/learner composition (docs/SCALE.md): actors walk the SAME
+    # rng chain the synchronous loop would (next_keys depends only on
+    # the seed rng, never on game content), play against the published
+    # best pair, and stream host copies into the buffer; the learner
+    # half consumes at its own cadence. Lockstep (1 actor, FIFO) is
+    # bit-identical to the synchronous path — the A/B the acceptance
+    # test pins.
+    rig = None
+    if a.actor_learner:
+        from rocalphago_tpu.data.replay import ReplayBuffer
+        from rocalphago_tpu.training.actor import (
+            DispatchGang,
+            ParamsPublisher,
+            SelfplayActor,
+        )
+        from rocalphago_tpu.training.learner import ZeroLearner
+
+        lockstep = a.actors == 1 and not a.replay_sample
+        buffer = ReplayBuffer(
+            capacity=a.replay_capacity,
+            spill_dir=(os.path.join(a.out_dir, "replay")
+                       if coord else None))
+        publisher = ParamsPublisher()
+        # one gang shared by every device-section owner: concurrent
+        # play/learn SPMD programs over the same mesh can deadlock at
+        # their collective rendezvous (training.actor.DispatchGang)
+        gang = DispatchGang()
+        actors = []
+        for i in range(a.actors):
+            rng = state.rng if lockstep else pack_rng(
+                jax.random.fold_in(unpack_rng(state.rng), i + 1))
+            actors.append(SelfplayActor(
+                iteration.play, publisher, buffer, rng,
+                name=f"a{i}", lockstep=lockstep, start_index=start,
+                games=(a.iterations - start) if lockstep else None,
+                pace=not a.replay_sample, gang=gang, metrics=metrics))
+        learner = ZeroLearner(iteration.learn, buffer, gang=gang,
+                              sample=a.replay_sample, metrics=metrics)
+        publisher.publish(
+            best_p if best_p is not None else state.policy_params,
+            best_v if best_v is not None else state.value_params,
+            version=start)
+        for ac in actors:
+            ac.start()
+        rig = (buffer, publisher, actors, learner)
+        metrics.log("actor_learner", actors=a.actors,
+                    lockstep=lockstep, capacity=buffer.capacity,
+                    sample=a.replay_sample)
+
+    def _learner_iteration():
+        # finite waits so a dead actor surfaces as an error instead
+        # of an indefinite hang (the watchdog would fire anyway, but
+        # with less to say)
+        while True:
+            out = learner.step(state, timeout=5.0)
+            if out is not None:
+                return out
+            err = next((ac.error for ac in actors if ac.error), None)
+            if err is not None:
+                raise RuntimeError(
+                    "self-play actor failed; learner starved") \
+                    from err
+            if buffer.closed:
+                raise RuntimeError("replay buffer closed mid-run")
+
+    try:
+        for it in range(start, a.iterations):
+            with trace.span("zero.iteration", iteration=it):
+                faults.barrier("zero.pre_iteration", it)
+                t0 = time.time()
+                if rig is None:
+                    state, m = run_iteration(state, best_p, best_v)
+                    # the fetch below syncs the iteration's device
+                    # programs, so zero.iteration is real end-to-end
+                    # wall time and the replay spans' async remainder
+                    # lands inside this span, not outside it
+                    m = {k: float(jax.device_get(v))
+                         for k, v in m.items()}
+                else:
+                    # actors produced the games; learn + fetch only
+                    # (the fetch inside learner.step is the sync)
+                    state, m, _ = _learner_iteration()
+                if watchdog is not None:
+                    watchdog.beat()
+                    last_done["state"] = jax.device_get(state)
+                    last_done["step"] = it + 1
+                faults.barrier("zero.post_iteration", it)
+                entry = {"iteration": it, **m,
+                         "games_per_min": a.game_batch * 60.0
+                         / max(time.time() - t0, 1e-9)}
+                metrics.log("iteration", **entry)
+                meta.record_epoch(entry)
+                final = entry
+                if gate and ((it + 1) % gate_every == 0
+                             or it + 1 == a.iterations):
+                    with trace.span("zero.gate", iteration=it):
+                        gkey, lkey = jax.random.split(
+                            jax.random.fold_in(gate_root, it))
+                        r = gate.match(state.policy_params, best_p, gkey)
+                        promoted, wilson_lb = gate.decide(r)
+                        if promoted:
+                            best_p, best_v = (state.policy_params,
+                                              state.value_params)
+                            gate.promote(best_p, best_v, it + 1)
+                        metrics.log("gate", iteration=it,
+                                    promoted=promoted,
+                                    wilson_lb=round(wilson_lb, 4), **r)
+                        # ladder probe: the (possibly new) incumbent vs a
+                        # sampled past best — the monotonicity evidence
+                        # round 4 lacked
+                        snap = gate.sample(a.seed, it)
+                        if snap is not None:
+                            lp, _ = gate.load(snap, jax.device_get(
+                                state.policy_params), jax.device_get(
+                                state.value_params))
+                            lr = gate.match(
+                                best_p, meshlib.replicate(mesh, lp), lkey)
+                            metrics.log("ladder", iteration=it,
+                                        opponent=snap[0], **lr)
+                        faults.barrier("zero.post_gate", it)
+                if rig is not None:
+                    # version it+1 = exactly the pair the synchronous
+                    # loop would hand iteration it+1 (post-gate best,
+                    # or the fresh candidate without gating)
+                    publisher.publish(
+                        best_p if best_p is not None
+                        else state.policy_params,
+                        best_v if best_v is not None
+                        else state.value_params, version=it + 1)
+                if (it + 1) % a.save_every == 0 or it + 1 == a.iterations:
+                    # exports BEFORE the checkpoint save: everything
+                    # written before the save that commits step it+1 is
+                    # reproduced by a resume from the previous
+                    # checkpoint, so a crash at any point leaves
+                    # artifacts a resume makes identical to the
+                    # uninterrupted run (the save is the commit point)
+                    with trace.span("zero.export", iteration=it):
+                        export(it + 1)
+                        faults.barrier("zero.post_export", it)
+                    with trace.span("zero.save", iteration=it):
+                        faults.barrier("zero.pre_save", it)
+                        ckpt.save(it + 1, jax.device_get(state))
+                        if faults.active():
+                            # barriers are DETERMINISTIC points: under an
+                            # active fault plan the async save commits
+                            # before post_save, so crash@pre_save/
+                            # post_save cleanly separate uncommitted from
+                            # committed (a real crash can land anywhere —
+                            # the chaos sweep covers that too)
+                            ckpt.wait()
+                        faults.barrier("zero.post_save", it)
+    finally:
+        if rig is not None:
+            buffer.close()          # unblocks paced/waiting actors
+            for ac in actors:
+                ac.stop()
+            metrics.log(
+                "actor_learner_done",
+                learner_idle_frac=round(learner.idle_frac, 4),
+                learner_steps=learner.steps,
+                games_played=sum(ac.games_played for ac in actors))
     ckpt.wait()
     if watchdog is not None:
         watchdog.stop()

@@ -57,7 +57,10 @@ def load_records(path: str, date: str, platform: str | None):
                    r.get("chase_impl"),
                    # serving sweep axes (bench_serve.py): each
                    # session count × drive mode is its own row
-                   r.get("sessions"), r.get("mode"))
+                   r.get("sessions"), r.get("mode"),
+                   # actor/learner scale axes (bench_zero_scale.py):
+                   # each actor count × mesh shape is its own row
+                   r.get("actors"), r.get("mesh_shape"))
             prev = latest.get(key)
             if prev is None or str(r.get("date")) >= str(prev.get("date")):
                 latest[key] = r
@@ -69,7 +72,7 @@ def load_records(path: str, date: str, platform: str | None):
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
                 "vs_baseline", "mfu", "host_gap_frac", "us_per_pos",
-                "sessions"}
+                "sessions", "actors", "learner_idle_frac"}
 
 
 def render_table(records) -> str:
@@ -86,10 +89,14 @@ def render_table(records) -> str:
     sessions column keys the serving sweep (``bench_serve.py``:
     moves/sec vs concurrent-session count — read the batched-mode
     rows top to bottom for the scaling curve; p50/p99/occupancy stay
-    in config)."""
+    in config). The actors and learner-idle columns key the
+    actor/learner scale sweep (``bench_zero_scale.py``: ingest
+    games/min and learner steps/s vs actor count — actors=0 is the
+    synchronous baseline, whose self-play fraction stays in config as
+    ``selfplay_frac``; ``mesh_shape`` also stays in config)."""
     lines = ["| metric | value | unit | MFU | host gap | µs/pos "
-             "| sessions | config |",
-             "|---|---|---|---|---|---|---|---|"]
+             "| sessions | actors | learner idle | config |",
+             "|---|---|---|---|---|---|---|---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -103,9 +110,14 @@ def render_table(records) -> str:
         upp = "—" if upp in (None, "") else f"{float(upp):g}"
         sess = r.get("sessions")
         sess = "—" if sess in (None, "") else str(sess)
+        act = r.get("actors")
+        act = "—" if act in (None, "") else str(act)
+        idle = r.get("learner_idle_frac")
+        idle = ("—" if idle in (None, "")
+                else f"{100.0 * float(idle):.1f}%")
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
                      f" | {r.get('unit', '?')} | {u} | {gap} | {upp}"
-                     f" | {sess} | {cfg} |")
+                     f" | {sess} | {act} | {idle} | {cfg} |")
     return "\n".join(lines)
 
 

@@ -226,6 +226,46 @@ def render_encode(stats: dict, snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_actor_learner(snap: dict) -> str:
+    """Actor/learner split health (docs/SCALE.md): ingest volume and
+    rate, buffer fill, the learner's step count and idle fraction,
+    and the sample-staleness quantiles — 'are the actors keeping the
+    learner fed, and how stale is what it eats' in one block."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    ingest = counters.get("replay_ingest_games_total")
+    steps = counters.get("learner_steps_total")
+    if ingest is None and steps is None:
+        return "(no actor/learner records)"
+    lines = []
+    rate = gauges.get("replay_ingest_per_min")
+    fill = gauges.get("replay_fill_games")
+    evicted = counters.get("replay_evicted_games_total")
+    lines.append(
+        f"ingest: {ingest or 0} games"
+        + (f" @ {rate:.1f}/min" if rate is not None else "")
+        + (f", buffer fill {fill:g}" if fill is not None else "")
+        + (f", {evicted} evicted" if evicted else ""))
+    idle = gauges.get("learner_idle_frac")
+    lines.append(
+        f"learner: {steps or 0} steps, idle "
+        + (f"{100.0 * idle:.1f}%" if idle is not None else "—"))
+    h = hists.get("replay_sample_staleness_seconds")
+    if h:
+        p50 = quantile_from_buckets(h, 0.5)
+        p99 = quantile_from_buckets(h, 0.99)
+        lines.append(f"staleness: p50≲{p50} p99≲{p99} "
+                     f"({h['count']} consumed)")
+    actors = {k: v for k, v in counters.items()
+              if k.startswith("actor_games_total")}
+    if actors:
+        lines.append("actors: " + "  ".join(
+            f"{k.split('actor=', 1)[-1].strip(chr(34) + '{}')}={v}"
+            for k, v in sorted(actors.items())))
+    return "\n".join(lines)
+
+
 def render_events(records) -> str:
     """Counts of the notable non-span events (compiles, stalls,
     degradations, retries) — the 'did anything unusual happen' row."""
@@ -254,6 +294,8 @@ def report(records, top: int | None = None) -> str:
              "## notable events", "", render_events(records), "",
              "## dispatch pipeline (occupancy / host gaps)", "",
              render_dispatch(reg or {}), "",
+             "## actor/learner (replay ingest / learner idle)", "",
+             render_actor_learner(reg or {}), "",
              "## encode path (per-position cost / compiles)", "",
              render_encode(stats, reg or {}), "",
              "## metric registry (last snapshot)", "",
@@ -288,9 +330,18 @@ FIXTURE = [
                      "encode_full_total": 32,
                      "encode_incr_verdicts_reused_total": 57,
                      "encode_incr_chases_run_total": 19,
-                     'encode_cache_resets_total{reason="new_game"}': 2},
+                     'encode_cache_resets_total{reason="new_game"}': 2,
+                     "replay_ingest_games_total": 64,
+                     "replay_evicted_games_total": 8,
+                     "learner_steps_total": 7,
+                     'actor_games_total{actor="a0"}': 16,
+                     'actor_games_total{actor="a1"}': 16},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
-                   'device_occupancy{runner="device_mcts"}': 0.983},
+                   'device_occupancy{runner="device_mcts"}': 0.983,
+                   "replay_fill_games": 6,
+                   "replay_ingest_per_min": 480.0,
+                   "learner_idle_frac": 0.12,
+                   "actor_params_version": 7},
         "histograms": {"gtp_genmove_seconds": {
             "count": 42, "sum": 33.6,
             "buckets": {"0.5": 17, "1": 40, "2.5": 42,
@@ -301,7 +352,13 @@ FIXTURE = [
             'encode_pos_us{board="19"}': {
                 "count": 128, "sum": 940800.0,
                 "buckets": {"5000": 60, "10000": 126, "25000": 128,
-                            "+Inf": 128}}}}},
+                            "+Inf": 128}},
+            "replay_sample_staleness_seconds": {
+                "count": 7, "sum": 3.1,
+                "buckets": {"0.5": 4, "1": 6, "2.5": 7, "+Inf": 7}},
+            "learner_wait_seconds": {
+                "count": 7, "sum": 0.9,
+                "buckets": {"0.25": 5, "0.5": 7, "+Inf": 7}}}}},
 ]
 
 
@@ -314,7 +371,13 @@ def selftest() -> int:
               "encode path", "≲25000",
               'jax_compiles_total{entry="encode.batch"}=1',
               "incremental encode: 96 delta / 32 full (75% delta)",
-              "reused 57/76 (75% hit)", "new_game=2")
+              "reused 57/76 (75% hit)", "new_game=2",
+              "actor/learner",
+              "ingest: 64 games @ 480.0/min, buffer fill 6, "
+              "8 evicted",
+              "learner: 7 steps, idle 12.0%",
+              "staleness: p50≲0.5 p99≲2.5 (7 consumed)",
+              "a0=16", "a1=16")
     missing = [n for n in needed if n not in out]
     if missing:
         print(f"obs_report selftest FAILED: missing {missing}",

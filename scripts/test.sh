@@ -84,6 +84,17 @@ cd "$(dirname "$0")/.."
 # in-process (self-lint) plus per-rule fixture coverage, so
 # `pytest tests/` alone still enforces it.
 #
+# Actor/learner split (docs/SCALE.md): tests/test_replay.py is
+# tier-1 — replay-buffer semantics (FIFO/eviction/pacing/recency
+# sampling/close), spill-restore with torn files, dtype-preserving
+# record round-trip, tolerant JSONL ingest, publisher versioning,
+# lockstep actor key-chain walk, actor error parking, learner idle
+# accounting, and the watchdog waiting_on=replay_fill stall tag
+# (~3 s total). The full lockstep-vs-sync bit-exactness A/Bs
+# (in-process AND through the run_training CLI) and the 2-process
+# gloo sharded-learner-step consistency test are @slow
+# (tests/test_zero.py, tests/test_multihost.py) and run with --all.
+#
 # Concurrency proofing (runtime half): tests/test_lockcheck.py
 # units the ROCALPHAGO_LOCKCHECK=1 instrumented locks (observed
 # lock-order graph, cycle raise, held-sets, blocking-while-held,

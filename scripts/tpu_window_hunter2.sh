@@ -118,7 +118,8 @@ STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
 preprocess chase_xla chase_pls encode_base encode_shared4 \
 encode_shared1 encode_shared2 encode_shared8 encode_split4 \
 encode_pallas encode_incr_seq encode_incr_batch encode_incr_selfplay \
-devmcts9 devmcts_gumbel serve_small serve_fleet selfplay16 \
+devmcts9 devmcts_gumbel serve_small serve_fleet zero_actor_learner \
+selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
 train_trace preprocess_trace tournament headline_sized headline"
 n_steps=$(echo $STEPS | wc -w)
@@ -181,6 +182,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             # host-bound, skip on chip time.
             serve_small) run serve_small python benchmarks/bench_serve.py --sessions 1,8 --reps 2 --skip-threaded ;;
             serve_fleet) run serve_fleet python benchmarks/bench_serve.py --sessions 64,256 --reps 2 --skip-threaded ;;
+            # zero_actor_learner: the PR-11 actor/learner split on
+            # chip (bench_zero_scale.py; docs/SCALE.md) — ingest
+            # games/min, learner steps/s and learner-idle fraction vs
+            # actor count, against the sync baseline's selfplay_frac.
+            # --no-force-host-devices keeps the real TPU mesh.
+            zero_actor_learner) run zero_actor_learner python benchmarks/bench_zero_scale.py --no-force-host-devices --actors 1,2,4 --steps 4 --reps 2 ;;
             bisect)      run bisect      python scripts/tpu_crash_bisect.py --log "$LOG/bisect.jsonl" ;;
             selfplay16)  run selfplay16  python benchmarks/bench_selfplay.py --batch-sweep 16 --reps 2 ;;
             selfplay64)  run selfplay64  python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;

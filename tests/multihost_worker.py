@@ -10,7 +10,13 @@ at all (SURVEY.md §2c); this is the rebuild's multi-host bring-up
 path actually executing, not the mocked dispatch test above it.
 
 Usage: python multihost_worker.py <process_id> <num_processes> <port>
-       <out_dir>
+       <out_dir> [mode]
+
+``mode`` selects the step (default ``dp``): ``dp`` is the original
+data-parallel SGD step; ``zero_learner`` runs ONE sharded zero
+learner step (``training/zero.py``'s ``learn`` half — the
+actor/learner split's consumer) from a deterministic host-side game
+record, and reports a params checksum both processes must agree on.
 
 Prints one JSON line with the step result; writes ``result.json``
 into <out_dir> ONLY on the coordinator (artifact-write discipline —
@@ -22,9 +28,74 @@ import os
 import sys
 
 
+def zero_learner_step(meshlib, mesh):
+    """One sharded learner step over the GLOBAL mesh.
+
+    The game record is built host-side, identical on every process —
+    exactly what the replay buffer hands a learner (host numpy from
+    an actor's ``device_get``). ``learn`` itself commits the arrays
+    to its declared shardings, so this exercises the real multi-host
+    ingest path: replicated params in, data-sharded batch, replicated
+    params out, addressable on every process."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from rocalphago_tpu.data.replay import ZeroGames
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.training.zero import (
+        init_zero_state,
+        make_zero_iteration,
+    )
+
+    board, batch, move_limit = 5, 2, 8
+    feats = ("board", "ones")
+    vfeats = feats + ("color",)
+    pol = CNNPolicy(feats, board=board, layers=1, filters_per_layer=4)
+    val = CNNValue(vfeats, board=board, layers=1, filters_per_layer=4)
+    tx_p, tx_v = optax.sgd(0.01), optax.sgd(0.01)
+    iteration = make_zero_iteration(
+        GoConfig(size=board), feats, vfeats, pol.module.apply,
+        val.module.apply, tx_p, tx_v, batch=batch,
+        move_limit=move_limit, n_sim=2, max_nodes=8, sim_chunk=2,
+        replay_chunk=4, mesh=mesh)
+    state = meshlib.replicate(mesh, init_zero_state(
+        pol.params, val.params, tx_p, tx_v, seed=0))
+
+    n_act = board * board + 1
+    rs = np.random.RandomState(7)
+    live = np.zeros((move_limit, batch), bool)
+    live[:6] = True
+    games = ZeroGames(
+        # pass is legal from any position, so the replayed actions
+        # never depend on engine legality
+        actions=np.full((move_limit, batch), n_act - 1, np.int32),
+        live=live,
+        visits=rs.randint(0, 5, (move_limit, batch, n_act))
+        .astype(np.int32),
+        winners=np.array([1, -1], np.int32),
+        finished=np.ones((batch,), bool))
+
+    state2, metrics = iteration.learn(state, games)
+    leaves = (jax.tree.leaves(state2.policy_params)
+              + jax.tree.leaves(state2.value_params))
+    # replicated outputs are fully addressable on every process
+    checksum = float(sum(float(jnp.sum(jnp.abs(x))) for x in leaves))
+    return {
+        "policy_loss": round(float(jax.device_get(
+            metrics["policy_loss"])), 6),
+        "value_loss": round(float(jax.device_get(
+            metrics["value_loss"])), 6),
+        "params_checksum": round(checksum, 5),
+    }
+
+
 def main() -> int:
     pid, nproc = int(sys.argv[1]), int(sys.argv[2])
     port, out_dir = sys.argv[3], sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
 
     import jax
     import jax.numpy as jnp
@@ -36,6 +107,19 @@ def main() -> int:
                              num_processes=nproc, process_id=pid)
     assert jax.process_count() == nproc, jax.process_count()
     mesh = meshlib.make_mesh()          # all GLOBAL devices
+
+    if mode == "zero_learner":
+        result = zero_learner_step(meshlib, mesh)
+        result.update({
+            "process": pid,
+            "coordinator": meshlib.is_coordinator(),
+            "n_global_devices": len(jax.devices()),
+        })
+        if meshlib.is_coordinator():
+            with open(os.path.join(out_dir, "result.json"), "w") as f:
+                json.dump(result, f)
+        print(json.dumps(result))
+        return 0
 
     # deterministic global batch; each process owns its slice
     gshape = (4 * nproc, 3)

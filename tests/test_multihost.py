@@ -100,20 +100,17 @@ def test_non_coordinator_split_matches_coordinator(corpus, tmp_path,
     t_worker.ckpt.close()
 
 
-@pytest.mark.skipif(
+_NO_GLOO = pytest.mark.skipif(
     not meshlib.cpu_collectives_available(),
     reason="installed jaxlib ships no gloo CPU collectives — a "
            "2-process CPU bring-up fails at the first cross-process "
            "op with 'Multiprocess computations aren't implemented on "
            "the CPU backend'")
-def test_two_process_distributed_dp_step(tmp_path):
-    """REAL 2-process ``jax.distributed`` bring-up (VERDICT r3 #8):
-    localhost coordinator, CPU backend, one local device per process.
-    Both processes must complete one data-parallel step, agree on the
-    replicated result, and only the coordinator may write artifacts.
-    ``distributed_init`` selects gloo TCP collectives on CPU (the
-    default CPU client has no collectives transport at all), so this
-    runs wherever the jaxlib ships gloo — capability-gated above."""
+
+
+def _run_two_workers(tmp_path, mode=None, timeout=180):
+    """Spawn coordinator + worker ``multihost_worker.py`` processes
+    over a free loopback port; return their JSON results by pid."""
     import socket
     import subprocess
     import sys as _sys
@@ -133,28 +130,64 @@ def test_two_process_distributed_dp_step(tmp_path):
 
     procs = [subprocess.Popen(
         [_sys.executable, worker, str(i), "2", str(port),
-         str(tmp_path)],
+         str(tmp_path)] + ([mode] if mode else []),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True) for i in range(2)]
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=180)
+            out, err = p.communicate(timeout=timeout)
             assert p.returncode == 0, (out, err)
             outs.append(json.loads(out.strip().splitlines()[-1]))
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return {o["process"]: o for o in outs}
 
-    by_pid = {o["process"]: o for o in outs}
+
+@_NO_GLOO
+def test_two_process_distributed_dp_step(tmp_path):
+    """REAL 2-process ``jax.distributed`` bring-up (VERDICT r3 #8):
+    localhost coordinator, CPU backend, one local device per process.
+    Both processes must complete one data-parallel step, agree on the
+    replicated result, and only the coordinator may write artifacts.
+    ``distributed_init`` selects gloo TCP collectives on CPU (the
+    default CPU client has no collectives transport at all), so this
+    runs wherever the jaxlib ships gloo — capability-gated above."""
+    by_pid = _run_two_workers(tmp_path)
     assert set(by_pid) == {0, 1}
     # the DP step saw the GLOBAL device set and agreed on the result
-    assert all(o["n_global_devices"] == 2 for o in outs)
+    assert all(o["n_global_devices"] == 2 for o in by_pid.values())
     assert by_pid[0]["loss"] == pytest.approx(by_pid[1]["loss"])
     assert by_pid[0]["w"] == by_pid[1]["w"]
     # coordinator-only artifact discipline held over real processes
     assert by_pid[0]["coordinator"] is True
     assert by_pid[1]["coordinator"] is False
     assert os.path.exists(tmp_path / "result.json")
+    assert os.listdir(tmp_path) == ["result.json"]
+
+
+@_NO_GLOO
+@pytest.mark.slow
+def test_two_process_sharded_learner_step(tmp_path):
+    """One SHARDED zero learner step over real 2-process gloo DCN
+    (the actor/learner split's consumer — docs/SCALE.md): both
+    processes ingest the identical host-side game record, ``learn``
+    commits it to its declared shardings (batch on ``data``, params
+    replicated), and the replicated post-update params must be
+    bit-consistent across hosts — the checksum and losses each
+    process reports from its OWN addressable shards agree."""
+    by_pid = _run_two_workers(tmp_path, mode="zero_learner",
+                              timeout=300)
+
+    assert set(by_pid) == {0, 1}
+    assert all(o["n_global_devices"] == 2 for o in by_pid.values())
+    # params consistent across hosts after the sharded update
+    assert by_pid[0]["params_checksum"] == by_pid[1]["params_checksum"]
+    assert by_pid[0]["policy_loss"] == by_pid[1]["policy_loss"]
+    assert by_pid[0]["value_loss"] == by_pid[1]["value_loss"]
+    # the artifact-write discipline holds in this mode too
+    assert by_pid[0]["coordinator"] is True
+    assert by_pid[1]["coordinator"] is False
     assert os.listdir(tmp_path) == ["result.json"]

@@ -1,0 +1,312 @@
+"""Replay-buffer service + actor/learner plumbing (docs/SCALE.md).
+
+Tier-1 units: buffer FIFO/pacing/eviction/sampling semantics, the
+crash-safe spill + tolerant restore, the torn-line JSONL ingest, the
+versioned params publisher, a fake-play actor driving the lockstep
+contract, the learner's idle accounting, and the watchdog's
+``waiting_on`` starvation tag. The bit-exact actor-learner vs
+synchronous A/B over the real search lives in tests/test_zero.py
+(@slow).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.data.replay import (
+    JsonlIngester,
+    ReplayBuffer,
+    ZeroGames,
+    append_jsonl_record,
+    games_to_record,
+    record_to_games,
+)
+
+
+def make_games(seed=0, t=3, b=2, a=26):
+    r = np.random.default_rng(seed)
+    return ZeroGames(
+        actions=r.integers(0, a, (t, b)).astype(np.int32),
+        live=r.integers(0, 2, (t, b)).astype(bool),
+        visits=r.integers(0, 5, (t, b, a)).astype(np.int32),
+        winners=r.integers(-1, 2, (b,)).astype(np.int32),
+        finished=r.integers(0, 2, (b,)).astype(bool),
+    )
+
+
+def games_equal(a, b):
+    return all(np.array_equal(x, y) and x.dtype == y.dtype
+               for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------- buffer
+
+
+def test_fifo_order_and_fill():
+    buf = ReplayBuffer(capacity=4)
+    for i in range(3):
+        assert buf.put(make_games(i), version=i)
+    assert buf.fill == 3
+    assert buf.ingested_games == 6      # 3 entries x batch 2
+    for i in range(3):
+        e = buf.next_batch(timeout=1.0)
+        assert e.version == i and e.seq == i
+        assert games_equal(e.games, make_games(i))
+    assert buf.next_batch(timeout=0.05) is None   # empty -> timeout
+
+
+def test_unpaced_put_evicts_oldest():
+    buf = ReplayBuffer(capacity=2)
+    for i in range(4):
+        assert buf.put(make_games(i), version=i, block=False)
+    assert buf.fill == 2
+    assert buf.next_batch(timeout=1.0).version == 2   # 0,1 evicted
+
+
+def test_paced_put_blocks_until_consumed():
+    buf = ReplayBuffer(capacity=1)
+    assert buf.put(make_games(0), version=0, block=True, timeout=1.0)
+    # full: a paced put must time out...
+    assert not buf.put(make_games(1), version=1, block=True,
+                       timeout=0.05)
+    # ...and succeed once a consumer makes room
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.1), buf.next_batch(timeout=1.0)))
+    t.start()
+    assert buf.put(make_games(1), version=1, block=True, timeout=5.0)
+    t.join()
+
+
+def test_sample_prefers_recent_and_keeps_entry():
+    buf = ReplayBuffer(capacity=8, sample_p=0.5, seed=1)
+    for i in range(8):
+        buf.put(make_games(i), version=i)
+    versions = [buf.sample(timeout=1.0).version for _ in range(200)]
+    assert buf.fill == 8                      # sampling never removes
+    newest = sum(v >= 6 for v in versions)
+    oldest = sum(v <= 1 for v in versions)
+    assert newest > oldest                    # geometric recency bias
+    assert sum(v == 7 for v in versions) > 200 * 0.3   # p=0.5 newest
+
+
+def test_close_unblocks_consumer_and_rejects_puts():
+    buf = ReplayBuffer(capacity=2)
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(buf.next_batch(timeout=5.0)))
+    t.start()
+    time.sleep(0.05)
+    buf.close()
+    t.join(timeout=5.0)
+    assert got == [None]
+    assert not buf.put(make_games(0))
+    assert buf.closed
+
+
+# ------------------------------------------------- spill + restore
+
+
+def test_spill_restore_skips_torn_files(tmp_path):
+    spill = str(tmp_path / "replay")
+    buf = ReplayBuffer(capacity=4, spill_dir=spill)
+    buf.put(make_games(0), version=3)
+    buf.put(make_games(1), version=4)
+    files = sorted(os.listdir(spill))
+    assert len(files) == 2
+    # a consumed entry's spill file is removed (won't double-restore)
+    buf.next_batch(timeout=1.0)
+    assert len(os.listdir(spill)) == 1
+    # torn/garbage files are skipped, valid ones restored with their
+    # version; pre-existing files are consumed so a second crash
+    # can't double-restore
+    (tmp_path / "replay" / "entry.99999999.json").write_text("{trunc")
+    buf2 = ReplayBuffer(capacity=4, spill_dir=spill)
+    assert buf2.restore() == 1
+    e = buf2.next_batch(timeout=1.0)
+    assert e.version == 4 and games_equal(e.games, make_games(1))
+
+
+def test_record_roundtrip_preserves_dtypes():
+    g = make_games(2)
+    rec = json.loads(json.dumps(games_to_record(g, version=7)))
+    g2, version = record_to_games(rec)
+    assert version == 7 and games_equal(g, g2)
+    # float visit targets (gumbel π') survive too
+    gf = g._replace(visits=g.visits.astype(np.float32) / 3.0)
+    g3, _ = record_to_games(
+        json.loads(json.dumps(games_to_record(gf))))
+    assert games_equal(gf, g3)
+
+
+def test_jsonl_ingester_tolerates_torn_tail(tmp_path):
+    shard = str(tmp_path / "actor0.jsonl")
+    append_jsonl_record(shard, make_games(0), version=1)
+    # a torn tail (writer mid-append): NOT consumed this poll
+    with open(shard, "a") as f:
+        f.write('{"version": 2, "actions": [[1')
+    buf = ReplayBuffer(capacity=8)
+    ing = JsonlIngester(buf, str(tmp_path))
+    assert ing.poll() == 1
+    assert ing.poll() == 0                    # no new complete lines
+    # the writer finishes the line -> next poll picks it up whole
+    with open(shard, "a") as f:
+        f.write("corrupted-not-json\n")
+    append_jsonl_record(shard, make_games(3), version=3)
+    assert ing.poll() == 1                    # bad line skipped
+    assert ing.skipped >= 1
+    assert buf.next_batch(timeout=1.0).version == 1
+    assert buf.next_batch(timeout=1.0).version == 3
+
+
+# ------------------------------------------- publisher + actor
+
+
+def test_params_publisher_versions_and_wait():
+    from rocalphago_tpu.training.actor import ParamsPublisher
+
+    pub = ParamsPublisher()
+    assert pub.get()[0] == -1
+    assert pub.wait_version(0, timeout=0.05) is None
+    pub.publish({"w": 1}, {"w": 2}, version=0)
+    v, pp, vp = pub.wait_version(0, timeout=1.0)
+    assert (v, pp, vp) == (0, {"w": 1}, {"w": 2})
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.05),
+                        pub.publish({"w": 3}, {"w": 4}, version=5)))
+    t.start()
+    v, pp, _ = pub.wait_version(3, timeout=5.0)
+    t.join()
+    assert v == 5 and pp == {"w": 3}
+
+
+def test_lockstep_actor_waits_for_versions_and_walks_chain():
+    """The bit-exactness contract, on a fake play: game k is played
+    by snapshot k, games land FIFO, and the key chain matches
+    ``next_keys`` walked from the same seed rng."""
+    import jax
+
+    from rocalphago_tpu.training.actor import (
+        ParamsPublisher,
+        SelfplayActor,
+    )
+    from rocalphago_tpu.training.zero import next_keys
+
+    played = []
+
+    def fake_play(pp, vp, key):
+        played.append((pp["v"], np.asarray(jax.random.key_data(key))))
+        return make_games(pp["v"])
+
+    from rocalphago_tpu.io.checkpoint import pack_rng
+
+    rng0 = pack_rng(jax.random.key(11))
+    pub = ParamsPublisher()
+    buf = ReplayBuffer(capacity=8)
+    actor = SelfplayActor(fake_play, pub, buf, rng0, lockstep=True,
+                          games=3, poll_s=0.05).start()
+    time.sleep(0.15)
+    assert not played                    # no version 0 published yet
+    for v in range(3):
+        pub.publish({"v": v}, {}, version=v)
+        e = buf.next_batch(timeout=10.0)
+        assert e.version == v
+        assert games_equal(e.games, make_games(v))
+    actor.stop()
+    assert actor.error is None and actor.games_played == 3
+    # the chain the actor walked == next_keys from the same seed
+    rng = rng0
+    for v in range(3):
+        rng, gk = next_keys(rng)
+        assert np.array_equal(played[v][1],
+                              np.asarray(jax.random.key_data(gk)))
+
+
+def test_actor_parks_on_nontransient_error():
+    from rocalphago_tpu.training.actor import (
+        ParamsPublisher,
+        SelfplayActor,
+    )
+
+    def bad_play(pp, vp, key):
+        raise ValueError("broken net")      # non-transient: no retry
+
+    import jax
+
+    from rocalphago_tpu.io.checkpoint import pack_rng
+
+    pub = ParamsPublisher()
+    pub.publish({}, {}, version=0)
+    buf = ReplayBuffer(capacity=2)
+    actor = SelfplayActor(bad_play, pub, buf,
+                          pack_rng(jax.random.key(0)),
+                          poll_s=0.05).start()
+    actor._thread.join(timeout=10.0)
+    assert isinstance(actor.error, ValueError)
+    assert actor.games_played == 0
+
+
+# ------------------------------------------------------- learner
+
+
+def test_learner_idle_accounting_and_metrics():
+    from rocalphago_tpu.training.learner import ZeroLearner
+
+    def fake_learn(state, games):
+        time.sleep(0.02)
+        return state + 1, {"loss": float(games.winners.sum())}
+
+    buf = ReplayBuffer(capacity=4)
+    learner = ZeroLearner(fake_learn, buf)
+    assert learner.step(0, timeout=0.05) is None      # starved
+    assert learner.idle_frac == 1.0
+    buf.put(make_games(0), version=9)
+    state, m, entry = learner.step(0, timeout=1.0)
+    assert state == 1 and entry.version == 9
+    assert m["replay_version"] == 9 and "replay_staleness_s" in m
+    assert m["loss"] == float(make_games(0).winners.sum())
+    assert 0.0 < learner.idle_frac < 1.0
+    assert learner.steps == 1
+
+
+# ------------------------------------------------------ watchdog
+
+
+def test_watchdog_stall_tags_waiting_phase():
+    """Satellite 6: a learner starving on an empty buffer is
+    distinguishable from a hang — the stall event carries
+    ``waiting_on=replay_fill``."""
+    from rocalphago_tpu.runtime.watchdog import Watchdog, waiting_on
+
+    events = []
+
+    class Log:
+        def log(self, event, **fields):
+            events.append((event, fields))
+
+    buf = ReplayBuffer(capacity=2)
+    wd = Watchdog(0.15, metrics=Log(), name="starve",
+                  exit=False).start()
+    t = threading.Thread(target=lambda: buf.next_batch(timeout=1.2))
+    t.start()
+    time.sleep(0.5)
+    wd.stop()
+    t.join(timeout=5.0)
+    stalls = [f for e, f in events if e == "stall"]
+    assert stalls, events
+    assert any(f.get("waiting_on") == "replay_fill" for f in stalls)
+    # nesting restores the outer phase; no-wait means no tag
+    with waiting_on("outer"):
+        with waiting_on("inner"):
+            pass
+        events2 = []
+        wd2 = Watchdog(0.05, metrics=Log(), exit=False)
+        wd2.metrics = type("L", (), {"log": lambda s, e, **f:
+                                     events2.append(f)})()
+        wd2._log(1.0)
+        assert events2[0]["waiting_on"] == "outer"
+    wd2._log(1.0)
+    assert events2[1]["waiting_on"] is None

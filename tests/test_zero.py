@@ -197,6 +197,112 @@ def test_zero_iteration_gumbel_targets(nets, sample_moves):
 
 
 @pytest.mark.slow
+def test_zero_actor_learner_lockstep_bit_exact(nets):
+    """The acceptance pin (docs/SCALE.md): one lockstep actor + FIFO
+    learner reproduce the synchronous iteration BIT-identically —
+    same keys (the actor walks ``next_keys`` locally), same games
+    (host round-trip through the buffer keeps raw dtypes), same
+    params/opt-state/rng after two steps."""
+    import optax as _optax
+
+    from rocalphago_tpu.data.replay import ReplayBuffer
+    from rocalphago_tpu.training.actor import (
+        ParamsPublisher,
+        SelfplayActor,
+    )
+    from rocalphago_tpu.training.learner import ZeroLearner
+
+    pol, val = nets
+    cfg = GoConfig(size=SIZE)
+    tx_p, tx_v = _optax.sgd(0.01), _optax.sgd(0.01)
+    iteration = make_zero_iteration(
+        cfg, FEATS, VFEATS, pol.module.apply, val.module.apply,
+        tx_p, tx_v, batch=2, move_limit=16, n_sim=4, max_nodes=16,
+        sim_chunk=2, replay_chunk=5)
+    state = init_zero_state(pol.params, val.params, tx_p, tx_v,
+                            seed=5)
+
+    s_sync = state
+    sync_metrics = []
+    for _ in range(2):
+        s_sync, m = iteration(s_sync)
+        sync_metrics.append(
+            {k: float(jax.device_get(v)) for k, v in m.items()})
+
+    buf = ReplayBuffer(capacity=4)
+    pub = ParamsPublisher()
+    actor = SelfplayActor(iteration.play, pub, buf, state.rng,
+                          lockstep=True, games=2, poll_s=0.05)
+    learner = ZeroLearner(iteration.learn, buf)
+    pub.publish(state.policy_params, state.value_params, version=0)
+    actor.start()
+    s_al = state
+    try:
+        for it in range(2):
+            s_al, m, entry = learner.step(s_al, timeout=120.0)
+            assert entry.version == it       # FIFO, in lockstep order
+            # the learner adds replay_version/replay_staleness_s on
+            # top of the iteration metrics — those aside, identical
+            assert {k: m[k] for k in sync_metrics[it]} \
+                == sync_metrics[it]
+            pub.publish(s_al.policy_params, s_al.value_params,
+                        version=it + 1)
+    finally:
+        buf.close()
+        actor.stop()
+    assert actor.error is None
+
+    def flat(tree):
+        f, _ = jax.flatten_util.ravel_pytree(jax.device_get(tree))
+        return np.asarray(f)
+
+    for attr in ("policy_params", "value_params", "opt_policy",
+                 "opt_value"):
+        np.testing.assert_array_equal(
+            flat(getattr(s_sync, attr)), flat(getattr(s_al, attr)),
+            err_msg=attr)
+    np.testing.assert_array_equal(np.asarray(s_sync.rng),
+                                  np.asarray(s_al.rng))
+    assert int(jax.device_get(s_al.iteration)) == 2
+
+
+@pytest.mark.slow
+def test_zero_actor_learner_cli_bit_exact(tmp_path, nets):
+    """`run_training --actor-learner` (1 actor) vs the synchronous
+    CLI: exported params bit-identical, iteration metrics equal."""
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+    from rocalphago_tpu.training.zero import run_training
+
+    pol, val = nets
+    pj, vj = str(tmp_path / "p.json"), str(tmp_path / "v.json")
+    pol.save_model(pj)
+    val.save_model(vj)
+    base = [pj, vj, "", "--game-batch", "2", "--iterations", "2",
+            "--move-limit", "12", "--sims", "4", "--sim-chunk", "2",
+            "--save-every", "2", "--seed", "5"]
+
+    def run(out, extra):
+        args = list(base)
+        args[2] = str(tmp_path / out)
+        return run_training(args + extra)
+
+    f_sync = run("sync", [])
+    f_al = run("al", ["--actor-learner"])
+    for k in ("policy_loss", "value_loss", "mean_moves",
+              "finished_rate"):
+        assert f_sync[k] == f_al[k], k
+    for name in ("policy", "value"):
+        pa = NeuralNetBase.load_model(
+            str(tmp_path / "sync" / f"{name}.json")).params
+        pb = NeuralNetBase.load_model(
+            str(tmp_path / "al" / f"{name}.json")).params
+        fa, _ = jax.flatten_util.ravel_pytree(pa)
+        fb, _ = jax.flatten_util.ravel_pytree(pb)
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb),
+                                      err_msg=name)
+
+
+@pytest.mark.slow
 def test_zero_iteration_sharded_matches_unsharded(nets):
     """Mesh wiring is placement + constraints only: one iteration on
     the virtual 8-device mesh must match the unsharded run
