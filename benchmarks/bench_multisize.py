@@ -1,0 +1,182 @@
+"""Multi-size serving: one FCN checkpoint on every board.
+
+Per ``--sizes`` entry, ``--sessions`` concurrent games drive the
+:class:`~rocalphago_tpu.multisize.MultiSizePool`'s member pool for
+that size through the fleet driver — one record per board size:
+aggregate ``moves/s``, p50/p99 per-genmove latency, evaluator batch
+occupancy. This is the headline table docs/MULTISIZE.md cites: the
+SAME param pytree serving 9×9, 13×13 and 19×19 side by side.
+
+The A/B (``--ab``): the multi-size pool shares ONE checkpoint across
+the ladder, so its incremental cost per extra size is compiled
+programs only; the counterfactual — one standalone :class:`~
+rocalphago_tpu.serve.sessions.ServePool` per size over per-size nets
+— pays a separate param pytree per size. Both arms report the
+``jax_compiles_total`` delta (obs compile tracking) and resident
+param bytes, so the table shows what sharing actually buys: params
+×1 vs ×N, compiles identical (the per-size programs are the
+irreducible cost either way — static shapes carry H×W).
+
+Usage::
+
+    python benchmarks/bench_multisize.py [--sizes 9,13,19]
+        [--sessions 8] [--sims 8] [--moves 2] [--reps 2] [--ab]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmarks._harness import report, std_parser  # noqa: E402
+
+
+def _percentile(sorted_vals, q):
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _param_bytes(*nets) -> int:
+    import jax
+
+    return sum(leaf.size * leaf.dtype.itemsize
+               for net in nets
+               for leaf in jax.tree.leaves(net.params))
+
+
+def _compiles_total() -> int:
+    from rocalphago_tpu.obs import registry
+
+    return sum(v for k, v in registry.snapshot()["counters"].items()
+               if k.startswith("jax_compiles_total"))
+
+
+def _drive(pool, size, sessions, moves, reps):
+    """moves/s + latency percentiles for ``sessions`` concurrent
+    games at ``size`` through one member pool's fleet driver."""
+    from rocalphago_tpu.engine import pygo
+
+    handles = [pool.open_session(size=size, resilient=False)
+               for _ in range(sessions)]
+    driver = pool.driver(handles)
+    driver.warm()
+    best = None
+    for _ in range(reps):
+        lats: list = []
+        games = [pygo.GameState(size=size) for _ in range(sessions)]
+        t_rep = time.monotonic()
+        for _ in range(moves):
+            t0 = time.monotonic()
+            mvs = driver.genmove_all(games)
+            lats.extend([time.monotonic() - t0] * sessions)
+            for game, mv in zip(games, mvs):
+                game.do_move(mv)
+        wall = time.monotonic() - t_rep
+        rate = sessions * moves / wall
+        if best is None or rate > best[0]:
+            best = (rate, sorted(lats))
+    occupancy = pool.pool_for(size).evaluator.stats()[
+        "batch_occupancy"]
+    for h in handles:
+        h.close()
+    rate, lats = best
+    return rate, lats, occupancy
+
+
+def main():
+    ap = std_parser("multi-size serving: one FCN checkpoint per-size "
+                    "throughput + shared-vs-separate pool A/B")
+    ap.add_argument("--sizes", default="9,13,19",
+                    help="comma list of board sizes the ladder serves")
+    ap.add_argument("--sessions", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--filters", type=int, default=96)
+    ap.add_argument("--sims", type=int, default=8)
+    ap.add_argument("--moves", type=int, default=2,
+                    help="genmoves per session per rep")
+    ap.add_argument("--ab", action="store_true",
+                    help="also measure the one-standalone-pool-per-"
+                         "size counterfactual (params ×N)")
+    a = ap.parse_args()
+
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.multisize import MultiSizePool
+    from rocalphago_tpu.serve.evaluator import default_batch_sizes
+    from rocalphago_tpu.serve.sessions import ServePool
+
+    sizes = tuple(int(s) for s in a.sizes.split(",") if s.strip())
+    batch_sizes = default_batch_sizes(cap=a.sessions)
+    pool_kw = dict(n_sim=a.sims, max_sessions=a.sessions,
+                   queue_rows=4 * max(batch_sizes),
+                   batch_sizes=batch_sizes)
+    common = dict(sessions=a.sessions, layers=a.layers,
+                  filters=a.filters, sims=a.sims, moves=a.moves)
+
+    # ---- one MultiSizePool, one checkpoint, every size -----------
+    pol = CNNPolicy(("board", "ones"), board=sizes[0],
+                    layers=a.layers, filters_per_layer=a.filters)
+    val = CNNValue(("board", "ones", "color"), board=sizes[0],
+                   layers=a.layers, filters_per_layer=a.filters)
+    c0 = _compiles_total()
+    mp = MultiSizePool(val, pol, sizes=sizes, **pool_kw)
+    for size in sizes:
+        rate, lats, occupancy = _drive(mp, size, a.sessions,
+                                       a.moves, a.reps)
+        report("multisize_moves_per_s", rate, "moves/s",
+               board=size, mode="one_pool",
+               p50_s=round(_percentile(lats, 0.50), 4),
+               p99_s=round(_percentile(lats, 0.99), 4),
+               occupancy=occupancy, **common)
+    report("multisize_param_mb", _param_bytes(pol, val) / 1e6, "MB",
+           mode="one_pool", boards=a.sizes,
+           compiles=_compiles_total() - c0, **common)
+    mp.close()
+
+    # ---- A/B: a standalone pool (and checkpoint) per size --------
+    if not a.ab:
+        return
+    c0 = _compiles_total()
+    nets, pools = [], []
+    for size in sizes:
+        p = CNNPolicy(("board", "ones"), board=size,
+                      layers=a.layers, filters_per_layer=a.filters)
+        v = CNNValue(("board", "ones", "color"), board=size,
+                     layers=a.layers, filters_per_layer=a.filters)
+        nets.extend((p, v))
+        pools.append(ServePool(v, p, label_board=True, **pool_kw))
+    for size, pool in zip(sizes, pools):
+        handles = [pool.open_session(resilient=False)
+                   for _ in range(a.sessions)]
+        driver = pool.driver(handles)
+        driver.warm()
+        from rocalphago_tpu.engine import pygo
+
+        best = None
+        for _ in range(a.reps):
+            games = [pygo.GameState(size=size)
+                     for _ in range(a.sessions)]
+            t0 = time.monotonic()
+            for _ in range(a.moves):
+                mvs = driver.genmove_all(games)
+                for game, mv in zip(games, mvs):
+                    game.do_move(mv)
+            rate = a.sessions * a.moves / (time.monotonic() - t0)
+            best = rate if best is None else max(best, rate)
+        report("multisize_moves_per_s", best, "moves/s",
+               board=size, mode="pool_per_size", **common)
+        for h in handles:
+            h.close()
+    report("multisize_param_mb", _param_bytes(*nets) / 1e6, "MB",
+           mode="pool_per_size", boards=a.sizes,
+           compiles=_compiles_total() - c0, **common)
+    for pool in pools:
+        pool.close()
+
+
+if __name__ == "__main__":
+    main()

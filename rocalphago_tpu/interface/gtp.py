@@ -136,11 +136,16 @@ class GTPEngine:
                  version: str = "0.1", metrics=None,
                  resilient: bool = True,
                  hang_timeout_s: float | None = None,
-                 serve_pool=None):
+                 serve_pool=None, serve_session=None):
         from rocalphago_tpu.interface.resilient import ResilientPlayer
 
         self.player = player
         self._metrics = metrics
+        self._resilient = resilient
+        self._hang_timeout_s = hang_timeout_s
+        # multi-size serving: the engine owns its pool session handle
+        # so cmd_boardsize can re-route it to another size's pool
+        self._serve_session = serve_session
         if not resilient:
             self._serve = None
         elif isinstance(player, ResilientPlayer):
@@ -229,13 +234,44 @@ class GTPEngine:
             raise ValueError("unacceptable size")
         # the nets are compiled for a fixed board; accepting another
         # size would only fail later inside genmove with an opaque
-        # shape error — reply per GTP instead
+        # shape error — reply per GTP instead. A multi-size serve
+        # pool instead RE-ROUTES the session to the target size's
+        # member pool (a dict lookup over shared weights, not an
+        # engine rebuild — rocalphago_tpu/multisize)
         net_board = self._player_board()
-        if net_board is not None and size != net_board:
+        if net_board is not None and size != net_board \
+                and not self._reroute_board(size):
             raise ValueError("unacceptable size")
         self.size = size
         self._new_game(reason="boardsize")
         return ""
+
+    def _reroute_board(self, size: int) -> bool:
+        """Swap this engine's serve session to ``size``'s member pool
+        (multi-size pools only). The engine's komi travels with it."""
+        from rocalphago_tpu.interface.resilient import ResilientPlayer
+
+        pool = self._serve_pool
+        if pool is None or not hasattr(pool, "pool_for"):
+            return False
+        try:
+            new = pool.open_session(size=size,
+                                    resilient=self._resilient)
+        except KeyError:
+            return False            # size not active on this pool
+        if self._serve_session is not None:
+            self._serve_session.close()
+        self._serve_session = new
+        new.set_komi(self.komi)
+        self.player = new.player
+        if isinstance(new.player, ResilientPlayer):
+            self._serve = new.player
+            if self._metrics is not None and new.player.metrics is None:
+                new.player.metrics = self._metrics
+            if self._hang_timeout_s is not None \
+                    and new.player.hang_timeout_s is None:
+                new.player.hang_timeout_s = self._hang_timeout_s
+        return True
 
     def cmd_clear_board(self, args):
         self._new_game()
@@ -244,6 +280,14 @@ class GTPEngine:
     def cmd_komi(self, args):
         self.komi = float(args[0])
         self.state.komi = self.komi
+        # serve-backed engine: re-thread the pool session's komi too,
+        # so terminal leaf values in the shared evaluator score under
+        # it (komi is request data there, not a recompile — see
+        # rocalphago_tpu/serve/sessions.py)
+        primary = self._primary_player()
+        if getattr(primary, "pool", None) is not None \
+                and hasattr(primary, "komi"):
+            primary.komi = self.komi
         return ""
 
     def cmd_fixed_handicap(self, args):
@@ -721,6 +765,13 @@ def main(argv=None):
                     help="per-genmove SLO for the serve pool in ms "
                          "(anytime answer on expiry; default "
                          "ROCALPHAGO_SERVE_SLO_MS / off)")
+    ap.add_argument("--serve-sizes", default=None,
+                    help="comma list of board sizes to serve from ONE "
+                         "multi-size pool (e.g. 9,13,19; implies "
+                         "--serve, needs FCN-head models — the GTP "
+                         "boardsize command then re-routes the "
+                         "session instead of erroring; "
+                         "docs/MULTISIZE.md)")
     a = ap.parse_args(argv)
     from rocalphago_tpu.runtime.compilecache import enable_compile_cache
 
@@ -735,30 +786,42 @@ def main(argv=None):
         # genmove spans + compile events join the serving metrics
         trace.configure(metrics)
     pool = None
-    if a.serve:
+    session = None
+    if a.serve or a.serve_sizes:
         from rocalphago_tpu.models.nn_util import NeuralNetBase
-        from rocalphago_tpu.serve.sessions import ServePool
 
         if not a.value:
             raise SystemExit("--serve needs a --value model")
         policy = NeuralNetBase.load_model(a.policy)
         value = NeuralNetBase.load_model(a.value)
-        pool = ServePool(
-            value, policy, n_sim=a.playouts, metrics=metrics,
-            hang_timeout_s=a.genmove_timeout,
-            slo_s=(a.serve_slo_ms / 1e3
-                   if a.serve_slo_ms is not None else None))
+        slo_s = (a.serve_slo_ms / 1e3
+                 if a.serve_slo_ms is not None else None)
+        if a.serve_sizes:
+            from rocalphago_tpu.multisize import MultiSizePool
+
+            sizes = tuple(int(s) for s in a.serve_sizes.split(",")
+                          if s.strip())
+            pool = MultiSizePool(
+                value, policy, sizes=sizes, n_sim=a.playouts,
+                metrics=metrics, hang_timeout_s=a.genmove_timeout,
+                slo_s=slo_s)
+        else:
+            from rocalphago_tpu.serve.sessions import ServePool
+
+            pool = ServePool(
+                value, policy, n_sim=a.playouts, metrics=metrics,
+                hang_timeout_s=a.genmove_timeout, slo_s=slo_s)
         pool.warm()
         # the session arrives ladder-wrapped; the engine adopts it
-        player = pool.open_session(
-            resilient=not a.no_resilient).player
+        session = pool.open_session(resilient=not a.no_resilient)
+        player = session.player
     else:
         player = make_player(a)
     try:
         run_gtp(player, metrics=metrics,
                 resilient=not a.no_resilient,
                 hang_timeout_s=a.genmove_timeout,
-                serve_pool=pool)
+                serve_pool=pool, serve_session=session)
     finally:
         if pool is not None:
             pool.close()

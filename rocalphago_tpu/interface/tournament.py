@@ -29,16 +29,24 @@ class GameCrash(Exception):
 
 
 def play_match(black, white, size: int = 19, komi: float = 7.5,
-               move_limit: int = 722):
+               move_limit: int = 722, handicap: int = 0):
     """One game; returns +1 (black win), -1 (white win), 0 (draw).
+
+    ``handicap`` places that many Black stones on the GTP fixed star-
+    point layout before play (White moves first, as the rules demand)
+    — the variant axis that measures strength GAPS too wide for even
+    games to resolve.
 
     A raising player (or one whose move the rules reject) aborts the
     game with :class:`GameCrash` naming the crashing side — the
     caller decides whether that forfeits (``run_tournament``) or
     propagates."""
+    from rocalphago_tpu.interface.gtp import fixed_handicap_points
     from rocalphago_tpu.search.players import reset_player
 
     state = pygo.GameState(size=size, komi=komi)
+    if handicap:
+        state.place_handicaps(fixed_handicap_points(size, handicap))
     players = {pygo.BLACK: black, pygo.WHITE: white}
     for player in players.values():
         reset_player(player)
@@ -54,7 +62,8 @@ def play_match(black, white, size: int = 19, komi: float = 7.5,
 
 def run_tournament(player_a, player_b, games: int, size: int = 19,
                    komi: float = 7.5, move_limit: int = 722,
-                   log=None, names=("A", "B")) -> dict:
+                   log=None, names=("A", "B"),
+                   handicap: int = 0) -> dict:
     """``games`` games, colors alternating; returns the tally.
 
     The tally is kept by player INDEX (0 / 1 / draw) and mapped to
@@ -65,7 +74,11 @@ def run_tournament(player_a, player_b, games: int, size: int = 19,
     (:class:`GameCrash`) is scored as a forfeit — the crashing side
     loses, the log entry records the forfeit and cause — and the
     tournament plays on; one bad game no longer aborts the whole
-    run. Forfeit counts come back in the tally (``forfeits``)."""
+    run. Forfeit counts come back in the tally (``forfeits``).
+
+    With ``handicap`` every game opens on the star-point stones; the
+    color alternation means each player takes Black (and the stones)
+    in half the games, so the tally stays symmetric."""
     if len(set(names)) != 2 or "draw" in names:
         raise ValueError(
             f"names must be two distinct labels, neither 'draw'; "
@@ -81,7 +94,7 @@ def run_tournament(player_a, player_b, games: int, size: int = 19,
         forfeit = None
         try:
             w = play_match(black, white, size=size, komi=komi,
-                           move_limit=move_limit)
+                           move_limit=move_limit, handicap=handicap)
         except GameCrash as e:
             w = -e.color              # the crashing side forfeits
             forfeit = {"side": ("black" if e.color == pygo.BLACK
@@ -120,9 +133,12 @@ def run_tournament(player_a, player_b, games: int, size: int = 19,
 def _build_player(spec: str, temperature: float, playouts: int,
                   device_rollout: bool = False, board: int | None = None):
     """``kind:policy.json[:value.json[:rollout.json]]`` → agent.
-    With ``board``, reject nets compiled for a different size up front
-    (the same guard GTP's boardsize applies) instead of crashing with
-    a shape error mid-game."""
+    With ``board``, nets saved at another size re-board through
+    ``at_board`` when their params are size-generic (FCN heads — the
+    cross-size transfer ladder plays a 9×9-trained checkpoint at
+    13×13 this way); size-locked nets are rejected up front (the same
+    guard GTP's boardsize applies) instead of crashing with a shape
+    error mid-game."""
     from rocalphago_tpu.search.players import build_player, player_board
 
     parts = spec.split(":")
@@ -131,7 +147,7 @@ def _build_player(spec: str, temperature: float, playouts: int,
                               parts[2] if len(parts) > 2 else None,
                               parts[3] if len(parts) > 3 else None,
                               temperature=temperature, playouts=playouts,
-                              device_rollout=device_rollout)
+                              device_rollout=device_rollout, board=board)
     except (ValueError, IndexError) as e:
         raise SystemExit(f"bad player spec {spec!r}: {e}")
     net_board = player_board(player)
@@ -153,6 +169,11 @@ def main(argv=None):
                     help="area-scoring komi (default: the board "
                          "size's standard — 7.5 at 13x13+, 7.0 below)")
     ap.add_argument("--move-limit", type=int, default=722)
+    ap.add_argument("--handicap", type=int, default=0,
+                    help="Black stones on the fixed star-point "
+                         "layout before every game (0 = even; colors "
+                         "still alternate, so each player takes the "
+                         "stones in half the games)")
     ap.add_argument("--temperature", type=float, default=0.67)
     ap.add_argument("--playouts", type=int, default=100)
     ap.add_argument("--device-rollout", action="store_true",
@@ -164,6 +185,13 @@ def main(argv=None):
         from rocalphago_tpu.engine.jaxgo import default_komi
 
         a.komi = default_komi(a.board)
+    if a.handicap:
+        from rocalphago_tpu.interface.gtp import fixed_handicap_points
+
+        try:
+            fixed_handicap_points(a.board, a.handicap)
+        except ValueError as e:
+            raise SystemExit(f"--handicap {a.handicap}: {e}")
     pa = _build_player(a.player_a, a.temperature, a.playouts,
                        device_rollout=a.device_rollout, board=a.board)
     pb = _build_player(a.player_b, a.temperature, a.playouts,
@@ -172,7 +200,7 @@ def main(argv=None):
     try:
         tally = run_tournament(pa, pb, a.games, size=a.board,
                                komi=a.komi, move_limit=a.move_limit,
-                               log=log)
+                               log=log, handicap=a.handicap)
     finally:
         if log:
             log.close()

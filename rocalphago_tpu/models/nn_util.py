@@ -72,21 +72,32 @@ class ConvTrunk(nn.Module):
 
 
 class PointHead(nn.Module):
-    """1×1 conv → flatten → per-position learned bias → float32 logits
-    ``[B, N]`` over board points (the reference's custom Keras ``Bias``
-    layer, as a plain parameter)."""
+    """1×1 conv → flatten → float32 logits ``[B, N]`` over board
+    points. ``N`` comes from the input's H×W at trace time, never from
+    a stored board size.
 
-    board: int = 19
+    ``head="bias"`` (legacy) adds the reference's per-position learned
+    bias (its custom Keras ``Bias`` layer, as a plain ``[N]``
+    parameter) — which locks the checkpoint to one board size.
+    ``head="fcn"`` (default) omits it, leaving only the conv's own
+    channel bias, so the params apply at any H×W. A FRESH net is
+    bit-identical either way: the position bias initializes to
+    zeros."""
+
+    head: str = "fcn"
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        n = x.shape[1] * x.shape[2]
         x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
                     name="conv")(x)
-        n = self.board * self.board
         logits = x.reshape((x.shape[0], n)).astype(jnp.float32)
-        bias = self.param("position_bias", nn.initializers.zeros, (n,))
-        return logits + bias
+        if self.head == "bias":
+            bias = self.param("position_bias",
+                              nn.initializers.zeros, (n,))
+            logits = logits + bias
+        return logits
 
 
 def neuralnet(cls):
@@ -258,6 +269,7 @@ class NeuralNetBase:
             raise ValueError(
                 f"unknown network class {spec.get('class')!r}; "
                 f"registered: {sorted(NEURALNETS)}")
+        spec = cls.migrate_spec(spec)
         net = cls(tuple(spec["feature_list"]), board=int(spec["board"]),
                   **spec.get("kwargs", {}))
         weights = spec.get("weights_file")
@@ -265,6 +277,48 @@ class NeuralNetBase:
             path = os.path.join(os.path.dirname(json_file) or ".", weights)
             net.load_weights(path)
         return net
+
+    @classmethod
+    def migrate_spec(cls, spec: dict) -> dict:
+        """Hook for same-format checkpoint migration: adjust an older
+        spec (in place is fine) before the network is rebuilt —
+        e.g. value/policy specs written before the ``head`` kwarg
+        existed load with the legacy size-locked head. Default:
+        identity."""
+        return spec
+
+    # ---------------------------------------------------- multi-size
+
+    def size_generic(self) -> bool:
+        """Whether this net's PARAM tree holds no size-locked shapes,
+        i.e. one pytree applies at any board size. Subclasses with an
+        FCN head override; the conservative default is False."""
+        return False
+
+    def at_board(self, board: int) -> "NeuralNetBase":
+        """A facade of this net at another board size SHARING this
+        net's params (by reference, no copy): same class, features and
+        architecture kwargs, fresh ``GoConfig``/``Preprocess``/jitted
+        apply at ``board``. The multi-size seam: a
+        :class:`~rocalphago_tpu.multisize.MultiSizePool` builds one
+        facade per active size over one FCN checkpoint, and the
+        curriculum hands params from one stage's facade to the next.
+
+        Params stay SHARED — assigning ``facade.params`` later
+        rebinds only that facade; callers that train through a facade
+        must copy the updated tree back themselves."""
+        if board == self.board:
+            return self
+        if not self.size_generic():
+            raise ValueError(
+                f"{type(self).__name__} at board {self.board} has "
+                "size-locked params (legacy dense/bias head) and "
+                f"cannot be re-sized to {board} — rebuild or retrain "
+                "with the FCN head (see docs/MULTISIZE.md)")
+        clone = type(self)(self.feature_list, board=board,
+                           init_weights=False, **self.spec_kwargs)
+        clone.params = self.params
+        return clone
 
     @staticmethod
     def create_network(**kwargs):

@@ -18,6 +18,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from rocalphago_tpu.features import DEFAULT_FEATURES
 from rocalphago_tpu.models.nn_util import (
     ConvTrunk,
     NeuralNetBase,
@@ -28,7 +29,12 @@ from rocalphago_tpu.models.nn_util import (
 
 
 class PolicyNet(nn.Module):
-    """Conv trunk → 1×1 conv → per-position bias → logits ``[B, N]``."""
+    """Conv trunk → point head → logits ``[B, N]``.
+
+    ``head="fcn"`` (default): pure 1×1-conv head — no parameter shape
+    depends on the board, so one checkpoint applies at any size.
+    ``head="bias"``: the legacy per-position learned bias (size-
+    locked); pre-multisize specs load as this."""
 
     board: int = 19
     input_planes: int = 48
@@ -36,6 +42,7 @@ class PolicyNet(nn.Module):
     filters_per_layer: int = 128
     filter_width_1: int = 5
     filter_width_K: int = 3
+    head: str = "fcn"
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -45,7 +52,7 @@ class PolicyNet(nn.Module):
                       filter_width_1=self.filter_width_1,
                       filter_width_K=self.filter_width_K,
                       dtype=self.dtype, name="trunk")(x)
-        return PointHead(board=self.board, dtype=self.dtype,
+        return PointHead(head=self.head, dtype=self.dtype,
                          name="head")(x)
 
 
@@ -56,13 +63,28 @@ class CNNPolicy(PointPolicyEval, NeuralNetBase):
     ensembling) comes from :class:`PointPolicyEval`, shared with the
     rollout net."""
 
+    def __init__(self, feature_list=DEFAULT_FEATURES, **kwargs):
+        kwargs.setdefault("head", "fcn")   # recorded in saved specs
+        super().__init__(feature_list, **kwargs)
+
     @staticmethod
     def create_network(board: int = 19, input_planes: int = 48,
                        layers: int = 12, filters_per_layer: int = 128,
                        filter_width_1: int = 5,
-                       filter_width_K: int = 3) -> PolicyNet:
+                       filter_width_K: int = 3,
+                       head: str = "fcn") -> PolicyNet:
         return PolicyNet(board=board, input_planes=input_planes,
                          layers=layers,
                          filters_per_layer=filters_per_layer,
                          filter_width_1=filter_width_1,
-                         filter_width_K=filter_width_K)
+                         filter_width_K=filter_width_K, head=head)
+
+    @classmethod
+    def migrate_spec(cls, spec: dict) -> dict:
+        """Policy specs written before the ``head`` kwarg carried the
+        per-position bias param — load them as the legacy head."""
+        spec.setdefault("kwargs", {}).setdefault("head", "bias")
+        return spec
+
+    def size_generic(self) -> bool:
+        return self.module.head == "fcn"

@@ -28,11 +28,13 @@ ROLLOUT_FEATURES = ("board", "ones", "turns_since", "liberties")
 
 
 class RolloutNet(nn.Module):
-    """One 3×3 conv → 1×1 conv → per-position bias → logits ``[B, N]``."""
+    """One 3×3 conv → 1×1-conv point head → logits ``[B, N]``
+    (``head="bias"`` restores the legacy per-position bias)."""
 
     board: int = 19
     input_planes: int = 20
     filters: int = 32
+    head: str = "fcn"
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -40,7 +42,7 @@ class RolloutNet(nn.Module):
         x = x.astype(self.dtype)
         x = nn.relu(nn.Conv(self.filters, (3, 3), padding="SAME",
                             dtype=self.dtype, name="conv1")(x))
-        return PointHead(board=self.board, dtype=self.dtype,
+        return PointHead(head=self.head, dtype=self.dtype,
                          name="head")(x)
 
 
@@ -50,10 +52,22 @@ class CNNRollout(PointPolicyEval, NeuralNetBase):
     the shared :class:`PointPolicyEval` mixin)."""
 
     def __init__(self, feature_list=ROLLOUT_FEATURES, **kwargs):
+        kwargs.setdefault("head", "fcn")   # recorded in saved specs
         super().__init__(feature_list, **kwargs)
 
     @staticmethod
     def create_network(board: int = 19, input_planes: int = 20,
-                       filters: int = 32) -> RolloutNet:
+                       filters: int = 32,
+                       head: str = "fcn") -> RolloutNet:
         return RolloutNet(board=board, input_planes=input_planes,
-                          filters=filters)
+                          filters=filters, head=head)
+
+    @classmethod
+    def migrate_spec(cls, spec: dict) -> dict:
+        """Pre-``head``-kwarg rollout specs carried the per-position
+        bias param — load them as the legacy head."""
+        spec.setdefault("kwargs", {}).setdefault("head", "bias")
+        return spec
+
+    def size_generic(self) -> bool:
+        return self.module.head == "fcn"

@@ -38,22 +38,33 @@ def main(argv=None):
                          f"value default adds the 'color' plane (49); "
                          f"rollout default: {', '.join(ROLLOUT_FEATURES)})")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--head", default=None,
+                    help="head variant: 'fcn' (size-generic params — "
+                         "the default; one checkpoint applies at any "
+                         "board, see docs/MULTISIZE.md) or the legacy "
+                         "size-locked head ('dense' for value, 'bias' "
+                         "for policy/rollout). The value default also "
+                         "honors ROCALPHAGO_VALUE_HEAD")
     a = ap.parse_args(argv)
 
     if a.kind == "policy":
         features = tuple(a.features) if a.features else DEFAULT_FEATURES
         net = CNNPolicy(features, board=a.board, layers=a.layers,
-                        filters_per_layer=a.filters or 128, seed=a.seed)
+                        filters_per_layer=a.filters or 128, seed=a.seed,
+                        **({"head": a.head} if a.head else {}))
     elif a.kind == "value":
         features = tuple(a.features) if a.features else VALUE_FEATURES
         net = CNNValue(features, board=a.board, layers=a.layers,
-                       filters_per_layer=a.filters or 128, seed=a.seed)
+                       filters_per_layer=a.filters or 128, seed=a.seed,
+                       **({"head": a.head} if a.head else {}))
     else:
         features = tuple(a.features) if a.features else ROLLOUT_FEATURES
         net = CNNRollout(features, board=a.board,
-                         filters=a.filters or 32, seed=a.seed)
+                         filters=a.filters or 32, seed=a.seed,
+                         **({"head": a.head} if a.head else {}))
     net.save_model(a.out)
     print(f"wrote {a.out} ({type(net).__name__}, board={a.board}, "
+          f"head={net.module.head}, "
           f"{net.preprocess.output_dim} planes)")
     return net
 

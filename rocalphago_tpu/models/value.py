@@ -4,9 +4,25 @@ Parity: ``AlphaGo/models/value.py::CNNValue`` (same conv trunk as the
 policy + 1×1 conv + ``Dense(256, relu)`` + ``Dense(1, tanh)``;
 ``eval_state``; SURVEY.md §2 "Value net"). NHWC bfloat16 trunk, float32
 head, scalar per position.
+
+Head variants (``head=`` kwarg, recorded in saved specs):
+
+* ``"fcn"`` (default) — fully convolutional: 1×1 conv → global
+  mean+max spatial pooling → small dense head. No parameter shape
+  depends on H×W, so ONE checkpoint applies at 9×9/13×13/19×19
+  unchanged (the transfer result of "Transfer of Fully Convolutional
+  Policy-Value Networks", PAPERS.md) — the contract
+  ``rocalphago_tpu/multisize`` serves and ``training/curriculum.py``
+  trains across.
+* ``"dense"`` — the legacy size-locked head (flatten H×W into
+  ``Dense(dense_units)``). ``ROCALPHAGO_VALUE_HEAD=dense`` restores it
+  as the default for new nets; specs saved before the head kwarg
+  existed load as this via :meth:`CNNValue.migrate_spec`.
 """
 
 from __future__ import annotations
+
+import os
 
 import flax.linen as nn
 import jax
@@ -16,9 +32,29 @@ import numpy as np
 from rocalphago_tpu.features import VALUE_FEATURES
 from rocalphago_tpu.models.nn_util import ConvTrunk, NeuralNetBase, neuralnet
 
+#: legacy escape hatch: set to ``dense`` to build new value nets with
+#: the size-locked flattened head (pre-multisize behavior)
+VALUE_HEAD_ENV = "ROCALPHAGO_VALUE_HEAD"
+
+
+def default_value_head() -> str:
+    """The head new value nets build with: ``fcn`` unless
+    ``ROCALPHAGO_VALUE_HEAD`` overrides."""
+    head = os.environ.get(VALUE_HEAD_ENV, "") or "fcn"
+    if head not in ("fcn", "dense"):
+        raise ValueError(
+            f"{VALUE_HEAD_ENV}={head!r}: expected 'fcn' or 'dense'")
+    return head
+
 
 class ValueNet(nn.Module):
-    """Conv trunk → 1×1 conv → Dense(256) → tanh scalar ``[B]``."""
+    """Conv trunk → value head → tanh scalar ``[B]``.
+
+    ``head="fcn"``: 1×1 conv (``head_filters`` channels) → global
+    mean+max pooling over the board axes → ``Dense(dense_units)`` →
+    ``Dense(1)``; every parameter shape is board-size-free.
+    ``head="dense"``: the legacy 1-channel 1×1 conv flattened over
+    H×W into ``Dense(dense_units)`` (size-locked)."""
 
     board: int = 19
     input_planes: int = 49
@@ -27,6 +63,8 @@ class ValueNet(nn.Module):
     filter_width_1: int = 5
     filter_width_K: int = 3
     dense_units: int = 256
+    head: str = "fcn"
+    head_filters: int = 32
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -36,9 +74,19 @@ class ValueNet(nn.Module):
                       filter_width_1=self.filter_width_1,
                       filter_width_K=self.filter_width_K,
                       dtype=self.dtype, name="trunk")(x)
-        x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
-                    name="head_conv")(x)
-        x = x.reshape((x.shape[0], -1))
+        if self.head == "dense":
+            x = nn.Conv(1, (1, 1), padding="SAME", dtype=self.dtype,
+                        name="head_conv")(x)
+            x = x.reshape((x.shape[0], -1))
+        else:
+            x = nn.relu(nn.Conv(self.head_filters, (1, 1),
+                                padding="SAME", dtype=self.dtype,
+                                name="head_conv")(x))
+            # mean+max over the board axes: mean carries territory
+            # balance, max carries "is there a winning region
+            # anywhere" — both invariant to H×W
+            x = jnp.concatenate(
+                [x.mean(axis=(1, 2)), x.max(axis=(1, 2))], axis=-1)
         x = nn.relu(nn.Dense(self.dense_units, dtype=self.dtype,
                              name="dense1")(x))
         v = nn.Dense(1, dtype=self.dtype, name="dense2")(x)
@@ -56,19 +104,36 @@ class CNNValue(NeuralNetBase):
     """
 
     def __init__(self, feature_list=VALUE_FEATURES, **kwargs):
+        # resolve the head NOW so every saved spec records it
+        # explicitly (specs without it predate the kwarg and load as
+        # the legacy dense head via migrate_spec)
+        kwargs.setdefault("head", default_value_head())
         super().__init__(feature_list, **kwargs)
 
     @staticmethod
     def create_network(board: int = 19, input_planes: int = 49,
                        layers: int = 12, filters_per_layer: int = 128,
                        filter_width_1: int = 5, filter_width_K: int = 3,
-                       dense_units: int = 256) -> ValueNet:
+                       dense_units: int = 256, head: str = "fcn",
+                       head_filters: int = 32) -> ValueNet:
         return ValueNet(board=board, input_planes=input_planes,
                         layers=layers,
                         filters_per_layer=filters_per_layer,
                         filter_width_1=filter_width_1,
                         filter_width_K=filter_width_K,
-                        dense_units=dense_units)
+                        dense_units=dense_units, head=head,
+                        head_filters=head_filters)
+
+    @classmethod
+    def migrate_spec(cls, spec: dict) -> dict:
+        """Checkpoint migration: value specs written before the
+        ``head`` kwarg existed were trained with the size-locked
+        flattened head — load them as such."""
+        spec.setdefault("kwargs", {}).setdefault("head", "dense")
+        return spec
+
+    def size_generic(self) -> bool:
+        return self.module.head == "fcn"
 
     def _symmetric_spec(self):
         """The scalar value needs no inverse mapping — plain mean."""

@@ -1,0 +1,19 @@
+"""Multi-size serving: one net, every board.
+
+The FCN policy/value heads make one param pytree apply at any board
+size; this package turns that into a serving property.
+:class:`~rocalphago_tpu.multisize.pool.MultiSizePool` shares the
+weights by reference across one compiled
+:class:`~rocalphago_tpu.serve.sessions.ServePool` per active size and
+routes sessions by requested size — ``boardsize`` on a multi-size GTP
+engine (``--serve-sizes``) re-routes the session instead of
+rebuilding the engine. Design + probe schema + measured transfer:
+docs/MULTISIZE.md. The training-side counterpart (progressive-size
+curriculum over the same checkpoint) is
+``rocalphago_tpu/training/curriculum.py``.
+"""
+
+from rocalphago_tpu.multisize.pool import (  # noqa: F401
+    DEFAULT_SIZES,
+    MultiSizePool,
+)

@@ -1,0 +1,183 @@
+"""One FCN checkpoint serving every board size: the multi-size pool.
+
+The fully-convolutional heads (``models/value.py`` ``head="fcn"``,
+``models/nn_util.py::PointHead``) make the param pytree board-size-
+free, so ONE set of weights applies at 9×9, 13×13 and 19×19 unchanged
+— but the device search is still one compiled program per board size
+(static shapes: slabs, planes, action spaces all carry H×W).
+:class:`MultiSizePool` owns that split: the weights are shared BY
+REFERENCE across a ladder of per-size :class:`~rocalphago_tpu.serve.
+sessions.ServePool`\\ s (each with its own compiled searcher +
+:class:`~rocalphago_tpu.serve.evaluator.BatchingEvaluator`), and
+sessions route by requested size. Opening a game at a new size is a
+dict lookup, not a model rebuild — the GTP ``boardsize`` command on a
+multi-size engine re-routes the session instead of erroring.
+
+Per-size facades come from :meth:`~rocalphago_tpu.models.nn_util.
+NeuralNetBase.at_board`, which shares the caller's params (no copy);
+size-locked legacy heads (``dense``/``bias``) are refused at
+construction with a pointer to docs/MULTISIZE.md.
+
+Observability: each member pool labels its admission metrics with its
+size (``serve_sessions_live{board=}``, ``serve_sheds_total{board=}``)
+and :meth:`MultiSizePool.stats` publishes one ``ServePool.stats()``
+row per active size under ``boards`` — the probe block a multi-size
+balancer keys on (schema: docs/MULTISIZE.md; the single-pool
+``serve`` schema in docs/SERVING.md is unchanged).
+"""
+
+from __future__ import annotations
+
+from rocalphago_tpu.analysis import lockcheck
+from rocalphago_tpu.serve.sessions import ServePool, ServeSession
+
+#: the ladder a multi-size deployment serves by default
+DEFAULT_SIZES = (9, 13, 19)
+
+
+class MultiSizePool:
+    """A ladder of per-size :class:`ServePool`\\ s over ONE shared
+    FCN param pytree.
+
+    Parameters
+    ----------
+    value_net, policy_net : size-generic nets (``size_generic()``
+        True — FCN heads); their params are shared by reference with
+        every per-size facade.
+    sizes : board sizes to serve (default ``(9, 13, 19)``); more can
+        join later via :meth:`add_size`.
+    default_size : the size :meth:`open_session` uses when none is
+        requested (default: the nets' native board if it is in
+        ``sizes``, else the largest size).
+    pool_kwargs : everything else (``n_sim``, ``batch_sizes``,
+        ``slo_s``, ``metrics`` …) is forwarded to every member
+        :class:`ServePool` unchanged.
+    """
+
+    def __init__(self, value_net, policy_net, sizes=DEFAULT_SIZES,
+                 default_size: int | None = None, **pool_kwargs):
+        for net in (policy_net, value_net):
+            if not net.size_generic():
+                raise ValueError(
+                    f"{type(net).__name__} has a size-locked head "
+                    f"({net.module.head!r}): a multi-size pool needs "
+                    "FCN heads (head='fcn'; docs/MULTISIZE.md)")
+        self.policy = policy_net
+        self.value = value_net
+        self._pool_kwargs = dict(pool_kwargs)
+        self._pool_kwargs["label_board"] = True
+        self.warmed = False
+        self._lock = lockcheck.make_lock("MultiSizePool._lock")
+        self._pools: dict = {}            # guarded-by: self._lock
+        sizes = tuple(sorted(set(int(s) for s in sizes)))
+        if not sizes:
+            raise ValueError("a multi-size pool needs at least one size")
+        for s in sizes:
+            self._build_pool(s)
+        if default_size is None:
+            default_size = (policy_net.board
+                            if policy_net.board in sizes else sizes[-1])
+        self.default_size = int(default_size)
+        self.pool_for(self.default_size)   # default must be active
+
+    # ------------------------------------------------------- routing
+
+    def _build_pool(self, size: int) -> ServePool:
+        # at_board facades share the caller's params BY REFERENCE —
+        # the whole ladder serves one checkpoint, and a weight swap
+        # on the source nets is one swap, not one per size
+        policy = (self.policy if size == self.policy.board
+                  else self.policy.at_board(size))
+        value = (self.value if size == self.value.board
+                 else self.value.at_board(size))
+        pool = ServePool(value, policy, **self._pool_kwargs)
+        with self._lock:
+            self._pools[size] = pool
+        return pool
+
+    @property
+    def sizes(self) -> tuple:
+        """Active sizes, ascending."""
+        with self._lock:
+            return tuple(sorted(self._pools))
+
+    def pool_for(self, size: int) -> ServePool:
+        """The member pool serving ``size`` (KeyError when the size
+        is not active — :meth:`add_size` activates one)."""
+        with self._lock:
+            pool = self._pools.get(int(size))
+        if pool is None:
+            raise KeyError(
+                f"board size {size} not active (serving "
+                f"{self.sizes}); MultiSizePool.add_size({size}) "
+                "activates it")
+        return pool
+
+    def add_size(self, size: int) -> ServePool:
+        """Activate a new size (idempotent): builds its pool — the
+        searcher/evaluator compile lazily on first traffic, or
+        eagerly via :meth:`warm`."""
+        size = int(size)
+        with self._lock:
+            pool = self._pools.get(size)
+        return pool if pool is not None else self._build_pool(size)
+
+    # ------------------------------------------------------ sessions
+
+    def open_session(self, size: int | None = None,
+                     **kwargs) -> ServeSession:
+        """Admit one game at ``size`` (default ``default_size``);
+        kwargs (``resilient``, ``komi`` …) go to
+        :meth:`ServePool.open_session`."""
+        return self.pool_for(
+            self.default_size if size is None else size
+        ).open_session(**kwargs)
+
+    def driver(self, sessions):
+        """Fleet drive over ``sessions`` — which must all live in the
+        SAME member pool (the lockstep drive stacks tree slabs on one
+        batch axis; mixed H×W cannot stack)."""
+        boards = {s.raw.board for s in sessions}
+        if len(boards) != 1:
+            raise ValueError(
+                f"fleet driver needs one board size, got {sorted(boards)}")
+        return self.pool_for(boards.pop()).driver(sessions)
+
+    # -------------------------------------------------------- warmup
+
+    def warm(self, sizes=None) -> None:
+        """Compile every (or the given) member pool ahead of traffic."""
+        for s in (self.sizes if sizes is None else sizes):
+            self.pool_for(s).warm()
+        self.warmed = True
+
+    # ----------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        with self._lock:
+            pools = list(self._pools.values())
+        for pool in pools:
+            pool.close()
+
+    def __enter__(self) -> "MultiSizePool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """The multi-size probe block (schema: docs/MULTISIZE.md):
+        one ``ServePool.stats()`` row per active size plus the
+        routing facts a balancer needs."""
+        with self._lock:
+            pools = dict(self._pools)
+        boards = {str(s): pools[s].stats() for s in sorted(pools)}
+        return {
+            "multisize": True,
+            "default_board": self.default_size,
+            "sessions_live": sum(
+                b["sessions"]["live"] for b in boards.values()),
+            "boards": boards,
+        }

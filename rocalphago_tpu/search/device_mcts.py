@@ -51,6 +51,7 @@ from jax import lax
 from rocalphago_tpu.engine.jaxgo import (
     GoConfig,
     GoState,
+    area_scores,
     group_data,
     new_states,
     step,
@@ -127,6 +128,18 @@ def _terminal_value(cfg: GoConfig, st: GoState) -> jax.Array:
     return (w * st.turn).astype(jnp.float32)
 
 
+def _terminal_value_komi(cfg: GoConfig, st: GoState,
+                         komi: jax.Array) -> jax.Array:
+    """:func:`_terminal_value` rescored under a per-game ``komi`` (f32
+    scalar) instead of the static ``cfg.komi``. ``area_scores`` bakes
+    ``cfg.komi`` into white's total, so the rescore just shifts the
+    margin by the komi delta — at ``komi == cfg.komi`` the shift is
+    exactly ``0.0`` and the result is identical to the pinned path."""
+    b, w = area_scores(cfg, st)
+    margin = (b - w) + (jnp.float32(cfg.komi) - komi)
+    return (jnp.sign(margin) * st.turn).astype(jnp.float32)
+
+
 def make_device_mcts(cfg: GoConfig, policy_features: tuple,
                      value_features: tuple,
                      policy_apply: Callable, value_apply: Callable,
@@ -164,8 +177,10 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(step, cfg))
     vterm = jax.vmap(functools.partial(_terminal_value, cfg))
+    vterm_komi = jax.vmap(functools.partial(_terminal_value_komi, cfg))
 
-    def _eval_from(params_p, params_v, states: GoState, gd, planes):
+    def _eval_from(params_p, params_v, states: GoState, gd, planes,
+                   komi=None):
         """The NN half of :func:`eval_batch`, on precomputed analysis
         + planes (shared with the delta-encode root path)."""
         sens = vsens(states, gd)                       # [B, N]
@@ -180,7 +195,9 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         priors = jnp.concatenate(
             [board_p, pass_p[:, None]], axis=-1).astype(jnp.float32)
         values = value_apply(params_v, planes).astype(jnp.float32)
-        values = jnp.where(states.done, vterm(states), values)
+        term = vterm(states) if komi is None \
+            else vterm_komi(states, komi)
+        values = jnp.where(states.done, term, values)
         return priors, values
 
     def eval_batch(params_p, params_v, states: GoState):
@@ -192,6 +209,19 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         gd = vgd(states)
         planes = venc(states, gd)                      # [B, s, s, Fv]
         return _eval_from(params_p, params_v, states, gd, planes)
+
+    def eval_batch_komi(params_p, params_v, states: GoState, komi):
+        """:func:`eval_batch` with a PER-ROW komi (f32 [B]): terminal
+        rows are rescored as if the game were played under
+        ``komi[i]`` instead of the static ``cfg.komi``. The serving
+        layer uses this to give each session its own komi without a
+        per-komi recompile — one program per batch size serves every
+        komi, and rows at the default komi score identically to the
+        pinned :func:`eval_batch` path."""
+        gd = vgd(states)
+        planes = venc(states, gd)                      # [B, s, s, Fv]
+        return _eval_from(params_p, params_v, states, gd, planes,
+                          komi=komi)
 
     def _assemble_tree(roots: GoState, root_priors) -> DeviceTree:
         batch = roots.board.shape[0]
@@ -623,6 +653,12 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     search.assemble_tree = jax.jit(_assemble_tree)
     search.eval_batch = jaxobs.track("device_mcts.eval_batch",
                                      jax.jit(eval_batch))
+    # per-session komi variant (rocalphago_tpu/serve): the evaluator
+    # switches to this program only when a custom-komi request is in
+    # the batch, so default-komi traffic stays on eval_batch bit-for-
+    # bit. Compiled lazily, once per batch size, for ALL komi values.
+    search.eval_batch_komi = jaxobs.track(
+        "device_mcts.eval_batch_komi", jax.jit(eval_batch_komi))
     search.advance_root = advance_root  # subtree reuse across moves
     search.max_nodes = max_nodes        # the slab size actually built
     search.last_ran = None              # sims the last chunked run ran

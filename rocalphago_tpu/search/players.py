@@ -112,13 +112,22 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
                  rollout_path: str | None = None, temperature: float = 0.67,
                  playouts: int = 100, leaf_batch: int = 8,
                  lmbda: float = 0.5, symmetric: bool = False,
-                 device_rollout: bool = False):
+                 device_rollout: bool = False, board: int | None = None):
     """One agent factory for every CLI (GTP, tournament): build a
     ``greedy`` / ``probabilistic`` / ``mcts`` player from saved model
-    specs."""
+    specs. With ``board``, nets saved at another size are re-boarded
+    through :meth:`~rocalphago_tpu.models.nn_util.NeuralNetBase.
+    at_board` — FCN checkpoints play any size (the cross-size transfer
+    ladder rides this); size-locked legacy heads raise ValueError."""
     from rocalphago_tpu.models.nn_util import NeuralNetBase
 
-    policy = NeuralNetBase.load_model(policy_path)
+    def load(path):
+        net = NeuralNetBase.load_model(path)
+        if board is not None and net.board != board:
+            net = net.at_board(board)
+        return net
+
+    policy = load(policy_path)
     if kind == "greedy":
         return GreedyPolicyPlayer(policy, symmetric=symmetric)
     if kind == "probabilistic":
@@ -129,9 +138,8 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
 
         if not value_path:
             raise ValueError("mcts player needs a value model")
-        value = NeuralNetBase.load_model(value_path)
-        rollout = NeuralNetBase.load_model(rollout_path) \
-            if rollout_path else None
+        value = load(value_path)
+        rollout = load(rollout_path) if rollout_path else None
         return MCTSPlayer(value, policy, rollout=rollout, lmbda=lmbda,
                           n_playout=playouts, leaf_batch=leaf_batch,
                           symmetric=symmetric,
@@ -141,7 +149,7 @@ def build_player(kind: str, policy_path: str, value_path: str | None = None,
 
         if not value_path:
             raise ValueError(f"{kind} player needs a value model")
-        value = NeuralNetBase.load_model(value_path)
+        value = load(value_path)
         return DeviceMCTSPlayer(value, policy, n_sim=playouts,
                                 gumbel=(kind == "gumbel-mcts"))
     raise ValueError(f"unknown player kind {kind!r}")

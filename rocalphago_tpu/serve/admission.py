@@ -61,7 +61,8 @@ class AdmissionController:
     """Thread-safe counters + bounds shared by pool and evaluator."""
 
     def __init__(self, max_sessions: int | None = None,
-                 queue_rows: int | None = None):
+                 queue_rows: int | None = None,
+                 board: int | None = None):
         self.max_sessions = (_env_int(MAX_SESSIONS_ENV, 256)
                              if max_sessions is None else max_sessions)
         self.queue_rows = (_env_int(QUEUE_ROWS_ENV, 1024)
@@ -70,11 +71,16 @@ class AdmissionController:
         self.live_sessions = 0            # guarded-by: self._lock
         self.session_rejects = 0          # guarded-by: self._lock
         self.queue_sheds = 0              # guarded-by: self._lock
-        self._live_g = obs_registry.gauge("serve_sessions_live")
+        # ``board`` labels the gauges/counters per pool in a multi-
+        # size process (serve_sessions_live{board=}); a plain pool
+        # stays on the unlabelled series it always emitted
+        labels = {} if board is None else {"board": str(board)}
+        self._live_g = obs_registry.gauge("serve_sessions_live",
+                                          **labels)
         self._shed_queue_c = obs_registry.counter(
-            "serve_sheds_total", kind="queue_full")
+            "serve_sheds_total", kind="queue_full", **labels)
         self._shed_sess_c = obs_registry.counter(
-            "serve_sheds_total", kind="session_reject")
+            "serve_sheds_total", kind="session_reject", **labels)
 
     # ------------------------------------------------------- sessions
 

@@ -76,14 +76,17 @@ def default_batch_sizes(cap: int | None = None) -> tuple:
 
 
 class _Pending:
-    """A submitted evaluation request: rows in, a future out."""
+    """A submitted evaluation request: rows in, a future out.
+    ``komi`` is None (the pool's pinned komi) or the request's custom
+    komi — a float applied to every row, or a per-row sequence."""
 
-    __slots__ = ("states", "rows", "t_submit", "_event", "_result",
-                 "_exc")
+    __slots__ = ("states", "rows", "komi", "t_submit", "_event",
+                 "_result", "_exc")
 
-    def __init__(self, states, rows: int):
+    def __init__(self, states, rows: int, komi=None):
         self.states = states
         self.rows = rows
+        self.komi = komi
         self.t_submit = time.monotonic()
         self._event = threading.Event()
         self._result = None
@@ -126,12 +129,24 @@ class BatchingEvaluator:
         AdmissionController` — provides the queue bound and the
         live-session fill target.
     start : tests pass False to drive/fill the queue by hand.
+    eval_komi_fn : optional ``(params_p, params_v, states[B],
+        komi f32 [B]) -> (priors, values)`` (``search.
+        eval_batch_komi``) — engaged ONLY for batches that contain a
+        custom-komi request; default-komi batches stay on ``eval_fn``
+        bit-for-bit. Rows without a custom komi ride the komi program
+        at ``default_komi``, which scores identically by
+        construction.
+    default_komi : the pool's pinned komi (``cfg.komi``) — the fill
+        value for non-custom rows in a mixed batch.
     """
 
     def __init__(self, eval_fn, params_p, params_v,
                  batch_sizes=None, max_wait_us: float | None = None,
-                 admission=None, start: bool = True):
+                 admission=None, start: bool = True,
+                 eval_komi_fn=None, default_komi: float = 0.0):
         self._eval_fn = eval_fn
+        self._eval_komi_fn = eval_komi_fn
+        self.default_komi = float(default_komi)
         self._params_p = params_p
         self._params_v = params_v
         cap = admission.max_sessions if admission is not None else None
@@ -149,6 +164,7 @@ class BatchingEvaluator:
         self._stop = False                # guarded-by: self._cond
         # dispatch accounting (stats() + the serve probes)
         self.batches = 0
+        self.komi_batches = 0
         self.failures = 0
         self.rows_total = 0
         self.padded_total = 0
@@ -167,18 +183,27 @@ class BatchingEvaluator:
 
     # ------------------------------------------------------- client
 
-    def submit(self, states, rows: int | None = None) -> _Pending:
+    def submit(self, states, rows: int | None = None,
+               komi=None) -> _Pending:
         """Enqueue a [rows]-batched GoState for evaluation. Raises
         :class:`~rocalphago_tpu.serve.admission.EvaluatorOverload`
         when the bounded queue is full (the shed path) — the caller's
-        resilience ladder owns what happens next."""
+        resilience ladder owns what happens next. ``komi`` (float, or
+        a per-row sequence) scores this request's terminal rows under
+        that komi instead of the pool's pinned one; it requires
+        ``eval_komi_fn`` and only changes which compiled program the
+        containing batch runs, not how it is coalesced."""
         if rows is None:
             rows = int(states.board.shape[0])
         if rows > self.max_batch:
             raise ValueError(
                 f"request of {rows} rows exceeds the largest "
                 f"compiled batch ({self.max_batch})")
-        req = _Pending(states, rows)
+        if komi is not None and self._eval_komi_fn is None:
+            raise ValueError(
+                "per-request komi needs an eval_komi_fn "
+                "(search.eval_batch_komi)")
+        req = _Pending(states, rows, komi)
         with self._cond:
             if self._stop:
                 raise RuntimeError("evaluator is closed")
@@ -190,15 +215,20 @@ class BatchingEvaluator:
         return req
 
     def evaluate(self, states, rows: int | None = None,
-                 timeout: float | None = None):
+                 timeout: float | None = None, komi=None):
         """Blocking submit: ``(priors, values)`` for ``states``."""
-        return self.submit(states, rows).result(timeout)
+        return self.submit(states, rows, komi=komi).result(timeout)
 
-    def eval_direct(self, states):
+    def eval_direct(self, states, komi=None):
         """Run the compiled eval program directly, bypassing the
         queue — warmup (compile each ladder size ahead of traffic)
-        and the degraded paths that must not add queue load."""
-        return self._eval_fn(self._params_p, self._params_v, states)
+        and the degraded paths that must not add queue load. ``komi``
+        (f32 [B] array) selects the komi-aware program."""
+        if komi is None:
+            return self._eval_fn(self._params_p, self._params_v,
+                                 states)
+        return self._eval_komi_fn(self._params_p, self._params_v,
+                                  states, komi)
 
     # ---------------------------------------------------- dispatcher
 
@@ -261,6 +291,18 @@ class BatchingEvaluator:
                 states = jax.tree.map(
                     lambda *xs: jnp.concatenate(xs, axis=0),
                     *[r.states for r in take])
+            komi = None
+            if any(r.komi is not None for r in take):
+                # a custom-komi request switches the WHOLE batch to
+                # the komi program; default-komi requests ride along
+                # at default_komi, which scores identically
+                self.komi_batches += 1
+                komi = jnp.concatenate([
+                    jnp.full((r.rows,), self.default_komi,
+                             jnp.float32) if r.komi is None
+                    else jnp.broadcast_to(
+                        jnp.asarray(r.komi, jnp.float32), (r.rows,))
+                    for r in take])
             if size > total:
                 # pad rows replicate row 0 (valid states, no NaN
                 # hazards) and are sliced off below — per-row
@@ -271,7 +313,10 @@ class BatchingEvaluator:
                         [x, jnp.broadcast_to(
                             x[:1], (pad,) + x.shape[1:])], axis=0),
                     states)
-            priors, values = self.eval_direct(states)
+                if komi is not None:
+                    komi = jnp.concatenate(
+                        [komi, jnp.broadcast_to(komi[:1], (pad,))])
+            priors, values = self.eval_direct(states, komi=komi)
         except Exception as e:  # noqa: BLE001 — fail the batch, not
             #                     the dispatcher (classified by the
             #                     sessions' resilience ladders)
@@ -328,6 +373,7 @@ class BatchingEvaluator:
             depth = self._pending_rows
         return {
             "batches": self.batches,
+            "komi_batches": self.komi_batches,
             "rows": self.rows_total,
             "failures": self.failures,
             "queue_depth": depth,

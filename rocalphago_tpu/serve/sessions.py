@@ -32,10 +32,15 @@ Deadline` checked between simulations with a one-simulation anytime
 floor — an overloaded pool serves shallower searches, never late
 errors.
 
-Komi is pool-pinned: terminal leaf values score with the pool
-config's komi (the evaluator is one compiled program per batch size,
-not per komi) — run one pool per ruleset and let the balancer route,
-the same way one pool serves one board size.
+Komi: the pool config's komi is the pinned DEFAULT — default-komi
+sessions run the exact compiled program they always did. A session
+may carry its own komi (``open_session(komi=...)``, re-threaded live
+by GTP ``komi`` via :meth:`ServeSession.set_komi`): komi rides the
+request as DATA, and the evaluator rescored such batches through
+``search.eval_batch_komi`` — one compiled program per batch size
+serving every komi value, so a new komi is a new argument, not a
+recompile. Rows at the default komi score identically on either
+program (the rescore shifts the terminal margin by exactly ``0.0``).
 """
 
 from __future__ import annotations
@@ -74,6 +79,8 @@ class SessionPlayer:
         self.policy = pool.policy
         self.board = pool.board
         self._cfg = pool.cfg
+        self.komi: float | None = None    # None = the pool's pinned
+        #   komi; a float rescales terminal leaf values per request
         self.sim_limit: int | None = None
         self.last_n_sim = None
         self.deadline_hits = 0
@@ -107,6 +114,15 @@ class SessionPlayer:
         return self._move_time if slo is None else \
             min(self._move_time, slo)
 
+    def _komi(self) -> float | None:
+        """The komi to ride this session's requests: None (the
+        pinned program) unless a custom komi differs from the pool
+        default — equal values stay on the default path bit-for-bit."""
+        k = self.komi
+        if k is None or float(k) == float(self._cfg.komi):
+            return None
+        return float(k)
+
     def get_move(self, state):
         import jax
         import numpy as np
@@ -128,8 +144,9 @@ class SessionPlayer:
         # exempt (warm() — no honest wall budget spans a compile)
         deadline = Deadline.after(self._budget_s())
         enforce = not deadline.unlimited and pool.warmed
+        komi = self._komi()
         # root priors through the shared evaluator, like every leaf
-        priors0, _ = pool.evaluator.evaluate(roots)
+        priors0, _ = pool.evaluator.evaluate(roots, komi=komi)
         tree = search.assemble_tree(roots, priors0)
         # steady state is ONE device call per simulation
         # (advance_sim: apply + next prepare fused); the deadline is
@@ -137,7 +154,8 @@ class SessionPlayer:
         ctx = search.prepare_sim(tree, self._free)
         ran = 0
         while True:
-            priors, values = pool.evaluator.evaluate(ctx.eval_states)
+            priors, values = pool.evaluator.evaluate(ctx.eval_states,
+                                                     komi=komi)
             ran += 1
             if ran >= eff or (enforce and deadline.expired()):
                 tree = search.apply_sim(tree, ctx, priors, values)
@@ -187,6 +205,19 @@ class FleetDriver:
         self.last_n_sim = None
         self.deadline_hits = 0
 
+    def _komi_rows(self, n: int):
+        """Per-row komi for a fleet convoy: None unless some driven
+        session carries a custom komi (then one float per session,
+        pool default where unset)."""
+        default = float(self.pool.cfg.komi)
+        if len(self.sessions) != n:
+            return None
+        ks = [getattr(getattr(s, "raw", s), "komi", None)
+              for s in self.sessions]
+        if all(k is None or float(k) == default for k in ks):
+            return None
+        return [default if k is None else float(k) for k in ks]
+
     def genmove_all(self, states) -> list:
         """One move for each of ``states`` (aligned with the driven
         sessions): list of ``(x, y)`` / None (pass)."""
@@ -207,14 +238,15 @@ class FleetDriver:
             *[_jaxgo.from_pygo(cfg, st) for st in states])
         deadline = Deadline.after(pool.slo_s)
         enforce = not deadline.unlimited and pool.warmed
-        priors0, _ = pool.evaluator.evaluate(roots, rows=n)
+        komi = self._komi_rows(n)
+        priors0, _ = pool.evaluator.evaluate(roots, rows=n, komi=komi)
         tree = search.assemble_tree(roots, priors0)
         free = jnp.full((n,), -1, jnp.int32)
         ctx = search.prepare_sim(tree, free)
         ran = 0
         while True:
             priors, values = pool.evaluator.evaluate(
-                ctx.eval_states, rows=n)
+                ctx.eval_states, rows=n, komi=komi)
             ran += 1
             if ran >= pool.n_sim or (enforce and deadline.expired()):
                 tree = search.apply_sim(tree, ctx, priors, values)
@@ -274,6 +306,18 @@ class ServeSession:
     def get_move(self, state):
         return self.player.get_move(state)
 
+    @property
+    def komi(self) -> float | None:
+        """This session's komi (None = the pool's pinned default)."""
+        return self.raw.komi
+
+    def set_komi(self, komi: float | None) -> None:
+        """Re-thread this session's komi (the GTP ``komi`` command
+        lands here): takes effect on the next genmove, no rebuild —
+        komi is data to the evaluator, not part of any compiled
+        shape. None restores the pool default."""
+        self.raw.komi = None if komi is None else float(komi)
+
     def close(self) -> None:
         if not self._closed:
             self._closed = True
@@ -305,7 +349,7 @@ class ServePool:
                  batch_sizes=None, max_wait_us: float | None = None,
                  slo_s: float | None = None,
                  hang_timeout_s: float | None = None, metrics=None,
-                 searcher=None):
+                 searcher=None, label_board: bool = False):
         from rocalphago_tpu.search.device_mcts import make_device_mcts
 
         self.policy = policy_net
@@ -325,11 +369,18 @@ class ServePool:
                 value_net.feature_list, policy_net.module.apply,
                 value_net.module.apply, n_sim=n_sim,
                 max_nodes=max_nodes, c_puct=c_puct)
-        self.admission = AdmissionController(max_sessions, queue_rows)
+        # label_board: a pool inside a MultiSizePool labels its
+        # admission metrics per size (serve_sessions_live{board=});
+        # a standalone pool keeps the unlabelled series
+        self.admission = AdmissionController(
+            max_sessions, queue_rows,
+            board=self.board if label_board else None)
         self.evaluator = BatchingEvaluator(
             self.search.eval_batch, policy_net.params, value_net.params,
             batch_sizes=batch_sizes, max_wait_us=max_wait_us,
-            admission=self.admission)
+            admission=self.admission,
+            eval_komi_fn=getattr(self.search, "eval_batch_komi", None),
+            default_komi=self.cfg.komi)
         self.warmed = False
         self._lock = lockcheck.make_lock("ServePool._lock")
         self._sessions: dict = {}         # guarded-by: self._lock
@@ -340,12 +391,16 @@ class ServePool:
     # ------------------------------------------------------- sessions
 
     def open_session(self, resilient: bool = True,
-                     reduced_sims: int | None = None) -> ServeSession:
+                     reduced_sims: int | None = None,
+                     komi: float | None = None) -> ServeSession:
         """Admit one game (:class:`~rocalphago_tpu.serve.admission.
         AdmissionError` at capacity). ``resilient=False`` returns the
-        raw player — benchmarks measuring the search alone."""
+        raw player — benchmarks measuring the search alone. ``komi``
+        gives THIS session its own komi (module docstring); None is
+        the pool's pinned default."""
         self.admission.admit_session()
         raw = SessionPlayer(self)
+        raw.komi = None if komi is None else float(komi)
         player = raw
         if resilient:
             from rocalphago_tpu.interface.resilient import (
@@ -443,12 +498,15 @@ class ServePool:
             },
             "evaluator": {
                 "batches": ev["batches"],
+                "komi_batches": ev["komi_batches"],
                 "rows": ev["rows"],
                 "failures": ev["failures"],
                 "batch_occupancy": ev["batch_occupancy"],
                 "batch_sizes": ev["batch_sizes"],
                 "max_wait_us": ev["max_wait_us"],
             },
+            "board": self.board,
+            "komi_default": float(self.cfg.komi),
             "slo_ms": (None if self.slo_s is None
                        else round(self.slo_s * 1e3, 3)),
             "n_sim": self.n_sim,

@@ -1,0 +1,219 @@
+"""Progressive-size zero curriculum: one FCN checkpoint, trained
+small-to-large.
+
+The FCN heads (``models/value.py`` ``head="fcn"``, ``models/nn_util.
+py::PointHead``) make the param pytree board-size-free, so the nets a
+9×9 zero run produces APPLY at 13×13 unchanged — this driver turns
+that into a training schedule: run the full zero loop
+(:func:`rocalphago_tpu.training.zero.run_training` — self-play,
+replay, gating, checkpoints, actor/learner, all of it) at each board
+size in turn, handing the finished params to the next stage through
+:meth:`~rocalphago_tpu.models.nn_util.NeuralNetBase.at_board`.
+Optimizer state does NOT carry across stages (each stage's loss
+landscape is a different board; a fresh optimizer per stage is the
+conservative choice) — only the params do.
+
+Layout: ``out_dir/stageNN_bSS/`` is a complete, self-contained
+``training.zero`` out_dir (resumable, gated, exportable); the
+curriculum's own stream is ``out_dir/metrics.jsonl`` (``span`` records
+for ``curriculum.stage`` plus ``curriculum_stage`` /
+``curriculum_transfer`` events) and ``out_dir/curriculum.json`` holds
+the final summary. Unrecognized CLI flags forward to EVERY stage's
+``run_training`` verbatim (``--sims``, ``--game-batch``,
+``--actor-learner``, ``--gate-*`` …).
+
+The payoff question — does the small-board curriculum actually
+transfer? — is answered in-run: ``--transfer-games N`` plays the
+final stage's policy against a FRESH net of the same architecture at
+the final board size, raw-policy stochastic sampling, and gates the
+claim on a Wilson 95% lower bound ≥ 0.5 over decided games (the same
+statistical honesty as :class:`~rocalphago_tpu.training.zero.
+ZeroGate.decide`). docs/MULTISIZE.md records measured results.
+
+Usage::
+
+    python -m rocalphago_tpu.training.curriculum \\
+        policy.json value.json out_dir --stages 9:30,13:20,19:10 \\
+        --sims 64 --game-batch 8 --transfer-games 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import re
+import sys
+import time
+
+from rocalphago_tpu.engine import jaxgo
+from rocalphago_tpu.obs import trace
+
+
+def parse_stages(spec: str) -> list:
+    """``"9:30,13:20,19:10"`` → ``[(9, 30), (13, 20), (19, 10)]``
+    (board size : zero iterations per stage)."""
+    stages = []
+    for part in spec.split(","):
+        m = re.fullmatch(r"\s*(\d+)\s*:\s*(\d+)\s*", part)
+        if not m:
+            raise ValueError(
+                f"bad stage {part!r} in --stages {spec!r} "
+                "(want SIZE:ITERATIONS, e.g. 9:30,13:20)")
+        board, iters = int(m.group(1)), int(m.group(2))
+        if board < 2 or iters < 1:
+            raise ValueError(
+                f"bad stage {part!r}: board >= 2, iterations >= 1")
+        stages.append((board, iters))
+    if not stages:
+        raise ValueError("--stages needs at least one SIZE:ITERATIONS")
+    return stages
+
+
+def stage_inputs(policy_json: str, value_json: str, board: int,
+                 out_dir: str) -> tuple:
+    """Re-board the previous stage's exported nets to ``board`` and
+    save them as this stage's input specs. ``at_board`` refuses
+    size-locked (dense/bias head) checkpoints with a pointer to
+    docs/MULTISIZE.md — a curriculum needs FCN heads end to end."""
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+
+    os.makedirs(out_dir, exist_ok=True)
+    out = []
+    for path, name in ((policy_json, "policy"), (value_json, "value")):
+        net = NeuralNetBase.load_model(path)
+        net = net.at_board(board)       # no-op at the native size
+        spec = os.path.join(out_dir, f"{name}.json")
+        net.save_model(spec,
+                       os.path.join(out_dir, f"{name}.flax.msgpack"))
+        out.append(spec)
+    return tuple(out)
+
+
+def transfer_match(policy_json: str, board: int, games: int,
+                   temperature: float, move_limit: int,
+                   seed: int) -> dict:
+    """Transferred-vs-fresh at the target size, Wilson-gated: the
+    curriculum's final policy (re-boarded to ``board`` if needed)
+    against a freshly-initialized net of the SAME architecture, via
+    :meth:`ZeroGate.match`'s raw-policy runner. ``transfer`` in the
+    returned dict is True only when the transferred net's decided-game
+    win rate carries a Wilson 95% lower bound ≥ 0.5 — the curriculum
+    must BEAT fresh init with confidence, not merely edge it."""
+    import jax
+
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+    from rocalphago_tpu.training.zero import ZeroGate
+
+    net = NeuralNetBase.load_model(policy_json).at_board(board)
+    fresh = type(net)(net.feature_list, board=board, seed=seed,
+                      **net.spec_kwargs)
+    cfg = dataclasses.replace(net.cfg,
+                              komi=jaxgo.default_komi(board))
+    gate = ZeroGate(cfg, net.feature_list, net.module.apply,
+                    pool_dir="", games=games, threshold=0.5,
+                    temperature=temperature, move_limit=move_limit,
+                    write=False)
+    result = gate.match(net.params, fresh.params,
+                        jax.random.key(seed ^ 0x7A45))
+    transfer, lb = gate.decide(result)
+    return {"board": board, "games": games,
+            "transfer": bool(transfer),
+            "wilson_lb": round(float(lb), 4), **result}
+
+
+def run_curriculum(argv=None) -> dict:
+    """CLI driver; returns the summary dict ``curriculum.json``
+    records. Stage training flags pass through: anything this parser
+    does not own forwards to every stage's ``run_training`` verbatim
+    (the per-stage ``--iterations`` and ``--seed`` are appended LAST,
+    so the curriculum's values win)."""
+    from rocalphago_tpu.io.metrics import MetricsLogger
+    from rocalphago_tpu.training.zero import run_training
+
+    ap = argparse.ArgumentParser(
+        description="Progressive-size zero curriculum over one FCN "
+                    "checkpoint (unknown flags forward to every "
+                    "stage's training.zero run)")
+    ap.add_argument("policy_json")
+    ap.add_argument("value_json")
+    ap.add_argument("out_dir")
+    ap.add_argument("--stages", required=True,
+                    help="comma list of SIZE:ITERATIONS, ascending "
+                         "by convention (e.g. 9:30,13:20,19:10)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base rng seed; stage i trains with seed+i "
+                         "so stages are decorrelated")
+    ap.add_argument("--transfer-games", type=int, default=0,
+                    help="after the last stage: play the curriculum "
+                         "policy vs a fresh-init net at the final "
+                         "board, N games raw-policy, Wilson-gated "
+                         "(0 = skip)")
+    ap.add_argument("--transfer-temperature", type=float, default=1.0)
+    ap.add_argument("--transfer-move-limit", type=int, default=240)
+    a, passthrough = ap.parse_known_args(argv)
+    stages = parse_stages(a.stages)
+
+    os.makedirs(a.out_dir, exist_ok=True)
+    metrics = MetricsLogger(os.path.join(a.out_dir, "metrics.jsonl"))
+    metrics.log("curriculum_start",
+                stages=[list(s) for s in stages],
+                cmd=" ".join(sys.argv))
+    trace.configure(metrics)
+
+    prev_policy, prev_value = a.policy_json, a.value_json
+    stage_rows = []
+    summary: dict = {}
+    try:
+        for i, (board, iters) in enumerate(stages):
+            stage_dir = os.path.join(a.out_dir,
+                                     f"stage{i:02d}_b{board}")
+            p_in, v_in = stage_inputs(
+                prev_policy, prev_value, board,
+                os.path.join(stage_dir, "init"))
+            t0 = time.time()
+            with trace.span("curriculum.stage", stage=i, board=board,
+                            iterations=iters):
+                final = run_training(
+                    [p_in, v_in, stage_dir, *passthrough,
+                     "--iterations", str(iters),
+                     "--seed", str(a.seed + i)])
+                # run_training pointed the global trace sink at ITS
+                # stage logger (and closed nothing — the logger stays
+                # open for the stage's own spans); reclaim the sink
+                # BEFORE the with-block exits so the stage span lands
+                # in the curriculum stream, not the stage's
+                trace.configure(metrics)
+            row = {"stage": i, "board": board, "iterations": iters,
+                   "duration_s": round(time.time() - t0, 3),
+                   "out_dir": stage_dir, **final}
+            metrics.log("curriculum_stage", **row)
+            stage_rows.append(row)
+            prev_policy = os.path.join(stage_dir, "policy.json")
+            prev_value = os.path.join(stage_dir, "value.json")
+
+        summary = {"stages": stage_rows,
+                   "final_policy": prev_policy,
+                   "final_value": prev_value}
+        if a.transfer_games > 0:
+            board = stages[-1][0]
+            with trace.span("curriculum.transfer", board=board,
+                            games=a.transfer_games):
+                tr = transfer_match(
+                    prev_policy, board, a.transfer_games,
+                    a.transfer_temperature, a.transfer_move_limit,
+                    a.seed + len(stages))
+            metrics.log("curriculum_transfer", **tr)
+            summary["transfer"] = tr
+        with open(os.path.join(a.out_dir, "curriculum.json"),
+                  "w") as f:
+            json.dump(summary, f, indent=2)
+    finally:
+        metrics.close()
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    run_curriculum()

@@ -72,7 +72,7 @@ def load_records(path: str, date: str, platform: str | None):
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
                 "vs_baseline", "mfu", "host_gap_frac", "us_per_pos",
-                "sessions", "actors", "learner_idle_frac"}
+                "sessions", "actors", "learner_idle_frac", "board"}
 
 
 def render_table(records) -> str:
@@ -93,15 +93,20 @@ def render_table(records) -> str:
     actor/learner scale sweep (``bench_zero_scale.py``: ingest
     games/min and learner steps/s vs actor count — actors=0 is the
     synchronous baseline, whose self-play fraction stays in config as
-    ``selfplay_frac``; ``mesh_shape`` also stays in config)."""
-    lines = ["| metric | value | unit | MFU | host gap | µs/pos "
-             "| sessions | actors | learner idle | config |",
-             "|---|---|---|---|---|---|---|---|---|---|"]
+    ``selfplay_frac``; ``mesh_shape`` also stays in config). The
+    board column keys multi-size sweeps (``bench_multisize.py``: one
+    FCN checkpoint served per board size — read same-metric rows
+    across boards for the size-scaling table)."""
+    lines = ["| metric | value | unit | board | MFU | host gap "
+             "| µs/pos | sessions | actors | learner idle | config |",
+             "|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
         extra = ("" if r.get("vs_baseline") in (None, "")
                  else f" (vs_baseline {r['vs_baseline']})")
+        board = r.get("board")
+        board = "—" if board in (None, "") else str(board)
         u = r.get("mfu")
         u = "—" if u in (None, "") else f"{100.0 * float(u):.1f}%"
         gap = r.get("host_gap_frac")
@@ -116,8 +121,8 @@ def render_table(records) -> str:
         idle = ("—" if idle in (None, "")
                 else f"{100.0 * float(idle):.1f}%")
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
-                     f" | {r.get('unit', '?')} | {u} | {gap} | {upp}"
-                     f" | {sess} | {act} | {idle} | {cfg} |")
+                     f" | {r.get('unit', '?')} | {board} | {u} | {gap}"
+                     f" | {upp} | {sess} | {act} | {idle} | {cfg} |")
     return "\n".join(lines)
 
 

@@ -266,6 +266,43 @@ def render_actor_learner(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_curriculum(records) -> str:
+    """Curriculum ladder (training/curriculum.py; docs/MULTISIZE.md):
+    one row per ``curriculum_stage`` event — board, iterations, wall
+    time, the stage's final losses and self-play rate — then the
+    ``curriculum_transfer`` verdict: did the small-board curriculum
+    beat fresh init at the target size with Wilson confidence."""
+    stages = [r for r in records
+              if r.get("event") == "curriculum_stage"]
+    transfers = [r for r in records
+                 if r.get("event") == "curriculum_transfer"]
+    if not stages and not transfers:
+        return "(no curriculum records)"
+
+    def num(r, key):
+        v = r.get(key)
+        return "—" if v is None else f"{float(v):.3f}"
+
+    lines = [f"{'stage':<6} {'board':>5} {'iters':>6} {'wall_s':>9} "
+             f"{'policy_loss':>12} {'value_loss':>11} {'games/min':>10}"]
+    for r in stages:
+        lines.append(
+            f"{r.get('stage', '?'):<6} {r.get('board', '?'):>5} "
+            f"{r.get('iterations', '?'):>6} {num(r, 'duration_s'):>9} "
+            f"{num(r, 'policy_loss'):>12} {num(r, 'value_loss'):>11} "
+            f"{num(r, 'games_per_min'):>10}")
+    for t in transfers:
+        verdict = ("TRANSFERS" if t.get("transfer")
+                   else "not proven")
+        lines.append(
+            f"transfer @ {t.get('board', '?')}: {verdict} "
+            f"(wilson_lb={t.get('wilson_lb')}, "
+            f"{t.get('wins_a', '?')}–{t.get('wins_b', '?')} of "
+            f"{t.get('games', '?')} games, "
+            f"win_rate {t.get('win_rate_a', '?')})")
+    return "\n".join(lines)
+
+
 def render_events(records) -> str:
     """Counts of the notable non-span events (compiles, stalls,
     degradations, retries) — the 'did anything unusual happen' row."""
@@ -296,6 +333,8 @@ def report(records, top: int | None = None) -> str:
              render_dispatch(reg or {}), "",
              "## actor/learner (replay ingest / learner idle)", "",
              render_actor_learner(reg or {}), "",
+             "## curriculum (per-stage ladder / transfer verdict)", "",
+             render_curriculum(records), "",
              "## encode path (per-position cost / compiles)", "",
              render_encode(stats, reg or {}), "",
              "## metric registry (last snapshot)", "",
@@ -320,6 +359,18 @@ FIXTURE = [
      "dur_s": 10.5, "iteration": 0},
     {"event": "compile", "entry": "device_mcts.run_sims",
      "dur_s": 3.2, "calls": 1, "recompile": False},
+    {"event": "span", "name": "curriculum.stage", "ok": True,
+     "path": "curriculum.stage", "parent": None, "depth": 0,
+     "dur_s": 12.0, "stage": 0, "board": 9, "iterations": 2},
+    {"event": "curriculum_stage", "stage": 0, "board": 9,
+     "iterations": 2, "duration_s": 12.0, "policy_loss": 2.71,
+     "value_loss": 0.98, "games_per_min": 40.0},
+    {"event": "curriculum_stage", "stage": 1, "board": 13,
+     "iterations": 1, "duration_s": 30.5, "policy_loss": 2.43,
+     "value_loss": 0.91, "games_per_min": 11.0},
+    {"event": "curriculum_transfer", "board": 13, "games": 32,
+     "transfer": True, "wilson_lb": 0.6241, "wins_a": 26,
+     "wins_b": 6, "draws": 0, "win_rate_a": 0.8125},
     {"event": "registry", "snapshot": {
         "counters": {'serve_rung_total{rung="search"}': 41,
                      'serve_rung_total{rung="policy"}': 1,
@@ -377,7 +428,10 @@ def selftest() -> int:
               "8 evicted",
               "learner: 7 steps, idle 12.0%",
               "staleness: p50≲0.5 p99≲2.5 (7 consumed)",
-              "a0=16", "a1=16")
+              "a0=16", "a1=16",
+              "curriculum (per-stage ladder / transfer verdict)",
+              "transfer @ 13: TRANSFERS (wilson_lb=0.6241, "
+              "26–6 of 32 games, win_rate 0.8125)")
     missing = [n for n in needed if n not in out]
     if missing:
         print(f"obs_report selftest FAILED: missing {missing}",

@@ -95,6 +95,19 @@ cd "$(dirname "$0")/.."
 # gloo sharded-learner-step consistency test are @slow
 # (tests/test_zero.py, tests/test_multihost.py) and run with --all.
 #
+# Multi-size (docs/MULTISIZE.md): tests/test_multisize.py is
+# tier-1 — FCN-vs-bias-head A/B at the native size (bit-equal), the
+# one-checkpoint-applies-at-every-size facade proof (params shared
+# by reference, saved weights bit-equal across at_board sizes),
+# value-symmetry invariance across 5/9/13, per-session komi
+# (eval_batch_komi bit-compat at default, terminal-sign flip,
+# ServePool komi plumbing), MultiSizePool routing/probe/refusal,
+# the GTP boardsize re-route carrying komi, and the curriculum
+# driver's stage handoff (fast, run_training monkeypatched —
+# per-stage seed/iterations argv, bit-equal checkpoint carry,
+# curriculum.stage spans in metrics.jsonl). The real 2-stage
+# curriculum run (two trainer invocations + transfer gate) is @slow.
+#
 # Concurrency proofing (runtime half): tests/test_lockcheck.py
 # units the ROCALPHAGO_LOCKCHECK=1 instrumented locks (observed
 # lock-order graph, cycle raise, held-sets, blocking-while-held,

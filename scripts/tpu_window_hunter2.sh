@@ -118,7 +118,8 @@ STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
 preprocess chase_xla chase_pls encode_base encode_shared4 \
 encode_shared1 encode_shared2 encode_shared8 encode_split4 \
 encode_pallas encode_incr_seq encode_incr_batch encode_incr_selfplay \
-devmcts9 devmcts_gumbel serve_small serve_fleet zero_actor_learner \
+devmcts9 devmcts_gumbel serve_small serve_fleet multisize_serve \
+zero_actor_learner \
 selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
 train_trace preprocess_trace tournament headline_sized headline"
@@ -182,6 +183,11 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             # host-bound, skip on chip time.
             serve_small) run serve_small python benchmarks/bench_serve.py --sessions 1,8 --reps 2 --skip-threaded ;;
             serve_fleet) run serve_fleet python benchmarks/bench_serve.py --sessions 64,256 --reps 2 --skip-threaded ;;
+            # multisize_serve: the PR-12 one-checkpoint ladder
+            # (bench_multisize.py; docs/MULTISIZE.md) — per-size
+            # moves/s through one MultiSizePool plus the
+            # pool-per-size A/B (params ×N, compiles delta).
+            multisize_serve) run multisize_serve python benchmarks/bench_multisize.py --sizes 9,13,19 --sessions 8 --reps 2 --ab ;;
             # zero_actor_learner: the PR-11 actor/learner split on
             # chip (bench_zero_scale.py; docs/SCALE.md) — ingest
             # games/min, learner steps/s and learner-idle fraction vs

@@ -11,13 +11,27 @@ gate; VERDICT r4 #2 asks the gated rerun to show this monotone).
 Each pool snapshot ``best.NNNNN.policy.msgpack`` gets a sibling spec
 JSON (same architecture as --spec) so ``interface.tournament`` can
 load it, then the matches run through the tournament CLI's machinery
-in-process.
+in-process. Every row now carries the incumbent's Wilson 95% lower
+bound over decided games, so "ahead" claims are statistically honest.
+
+CROSS-SIZE transfer ladder: with FCN checkpoints (size-generic
+params) ``--board`` may differ from the size the pool was trained at
+— the tournament re-boards the nets via ``at_board``. ``--vs-fresh
+SEED`` additionally plays the FINAL snapshot against a freshly-
+initialized net of the same architecture at ``--board``: the
+transferred-vs-fresh measurement the multi-size curriculum is gated
+on (``transfer`` is claimed only when the Wilson lower bound clears
+0.5; docs/MULTISIZE.md records results).
 
 Usage::
 
     python scripts/zero_ladder_matches.py results/zero_r5/run \
         --spec results/zero_r5/zp9.json --games 64 \
         --out results/zero_r5/ladder_final.json
+
+    # 9x9-trained pool measured at 13x13 against fresh init
+    python scripts/zero_ladder_matches.py results/zero_r5/run \
+        --spec results/zero_r5/zp9.json --board 13 --vs-fresh 7
 """
 
 from __future__ import annotations
@@ -72,26 +86,57 @@ def write_spec(spec_path: str, weights: str, out_dir: str) -> str:
     return out
 
 
+def fresh_spec(spec_path: str, board: int, seed: int,
+               out_dir: str) -> str:
+    """Spec + weights for a FRESHLY-initialized net of ``--spec``'s
+    architecture at ``board`` — the transfer baseline. Saved into the
+    temp spec dir like the snapshot specs."""
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+
+    net = NeuralNetBase.load_model(spec_path)
+    fresh = type(net)(net.feature_list, board=board, seed=seed,
+                      **net.spec_kwargs)
+    out = os.path.join(out_dir, "fresh.json")
+    fresh.save_model(out, os.path.join(out_dir, "fresh.flax.msgpack"))
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("run_dir")
     ap.add_argument("--spec", required=True,
                     help="policy spec JSON matching the pool's arch")
     ap.add_argument("--games", type=int, default=64)
-    ap.add_argument("--board", type=int, default=9)
+    ap.add_argument("--board", type=int, default=9,
+                    help="match board size; may differ from the "
+                         "pool's training size for FCN checkpoints "
+                         "(re-boarded via at_board)")
     ap.add_argument("--temperature", type=float, default=1.0)
     ap.add_argument("--move-limit", type=int, default=240)
+    ap.add_argument("--vs-fresh", type=int, default=None,
+                    metavar="SEED",
+                    help="also play the final snapshot against a "
+                         "fresh-init net (this seed) at --board — "
+                         "the Wilson-gated transferred-vs-fresh "
+                         "measurement")
     ap.add_argument("--out", default=None)
     a = ap.parse_args(argv)
 
     snaps = pool_snapshots(a.run_dir)
-    if len(snaps) < 2:
-        raise SystemExit(f"need >=2 pool snapshots, found {len(snaps)}")
+    need = 1 if a.vs_fresh is not None else 2
+    if len(snaps) < need:
+        raise SystemExit(
+            f"need >={need} pool snapshots, found {len(snaps)}")
     spec_dir = tempfile.mkdtemp(prefix="zero_ladder_specs.")
     specs = {it: write_spec(a.spec, w, spec_dir) for it, w in snaps}
     last_it = snaps[-1][0]
 
     from rocalphago_tpu.interface import tournament
+    from rocalphago_tpu.interface.elo import wilson_lower_bound
+
+    def lb_of(r):
+        decided = r["wins"]["A"] + r["wins"]["B"]
+        return round(wilson_lower_bound(r["wins"]["A"], decided), 4)
 
     rows = []
     for it, _ in snaps[:-1]:
@@ -103,15 +148,36 @@ def main(argv=None) -> int:
             "--move-limit", str(a.move_limit)])
         rows.append({"incumbent": last_it, "opponent": it,
                      "incumbent_win_rate": r["win_rate_a"],
+                     "wilson_lb": lb_of(r),
                      "wins": r["wins"]})
         print(json.dumps(rows[-1]), flush=True)
 
     result = {
         "run_dir": a.run_dir, "games_per_match": a.games,
+        "board": a.board,
         "final_snapshot": last_it,
         "matches": rows,
         "monotone": all(r["incumbent_win_rate"] >= 0.5 for r in rows),
     }
+    if a.vs_fresh is not None:
+        fresh = fresh_spec(a.spec, a.board, a.vs_fresh, spec_dir)
+        r = tournament.main([
+            f"probabilistic:{specs[last_it]}",
+            f"probabilistic:{fresh}",
+            "--games", str(a.games), "--board", str(a.board),
+            "--temperature", str(a.temperature),
+            "--move-limit", str(a.move_limit)])
+        lb = lb_of(r)
+        result["vs_fresh"] = {
+            "snapshot": last_it, "board": a.board,
+            "seed": a.vs_fresh,
+            "transferred_win_rate": r["win_rate_a"],
+            "wilson_lb": lb,
+            # the gate the curriculum claims transfer on: the
+            # transferred net must beat fresh init with confidence
+            "transfer": lb >= 0.5,
+            "wins": r["wins"]}
+        print(json.dumps(result["vs_fresh"]), flush=True)
     if a.out:
         with open(a.out, "w") as f:
             json.dump(result, f, indent=2)

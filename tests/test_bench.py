@@ -286,6 +286,10 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
                     "actors": 4, "mesh_shape": "8x1",
                     "learner_idle_frac": 0.0574,
                     "date": "2026-07-31T01:00:00"}),   # distinct actors
+        json.dumps({"metric": "multisize_moves_per_s", "value": 52.3,
+                    "unit": "moves/s", "platform": "tpu",
+                    "board": 13, "mode": "one_pool", "sessions": 4,
+                    "date": "2026-07-31T01:00:00"}),   # size ladder row
     ]) + "\n")
     recs = bench_report.load_records(str(log), "2026-07-31", "tpu")
     # pipeline_depth (and the encode gating/phase1/impl axes, the
@@ -293,33 +297,39 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
     # axes) are part of the config key: each A/B side is a distinct
     # row, not a newer duplicate of its sibling
     assert sorted((r["value"], r.get("batch")) for r in recs) \
-        == [(2.0, 64), (3.0, 64), (9.0, 256), (50.0, 16), (88.0, None),
-            (100.0, 16), (120.0, None), (340.0, None), (345.0, None)]
+        == [(2.0, 64), (3.0, 64), (9.0, 256), (50.0, 16),
+            (52.3, None), (88.0, None), (100.0, 16), (120.0, None),
+            (340.0, None), (345.0, None)]
     table = bench_report.render_table(recs)
-    # MFU / host-gap / µs-per-pos / sessions / actors / learner-idle
-    # columns: '—' when a record has none, the value when it does
-    assert ("| m | 2.0 | u | — | — | — | — | — | — | batch=64 |"
+    # board / MFU / host-gap / µs-per-pos / sessions / actors /
+    # learner-idle columns: '—' when a record has none, the value
+    # when it does
+    assert ("| m | 2.0 | u | — | — | — | — | — | — | — | batch=64 |"
             in table)
-    assert ("| m | 9.0 | u | 12.3% | — | — | — | — | — | batch=256 |"
-            in table)
-    assert ("| m | 3.0 | u | — | 4.21% | — | — | — | — | "
+    assert ("| m | 9.0 | u | — | 12.3% | — | — | — | — | — | "
+            "batch=256 |" in table)
+    assert ("| m | 3.0 | u | — | — | 4.21% | — | — | — | — | "
             "batch=64, pipeline_depth=1 |" in table)
-    assert ("| encode_ab | 100.0 | u | — | — | 123.4 | — | — | — | "
-            "batch=16, chase_impl=xla, gating=shared, phase1=4 |"
+    assert ("| encode_ab | 100.0 | u | — | — | — | 123.4 | — | — | — "
+            "| batch=16, chase_impl=xla, gating=shared, phase1=4 |"
             in table)
     # the serving sweep keys by session count: both rows survive and
     # the sessions column carries the count (moves/sec-vs-sessions)
-    assert ("| serve_moves_per_s | 88.0 | moves/s | — | — | — | 8 | "
-            "— | — | mode=batched |" in table)
-    assert ("| serve_moves_per_s | 120.0 | moves/s | — | — | — | 64 |"
-            " — | — | mode=batched |" in table)
+    assert ("| serve_moves_per_s | 88.0 | moves/s | — | — | — | — | 8 "
+            "| — | — | mode=batched |" in table)
+    assert ("| serve_moves_per_s | 120.0 | moves/s | — | — | — | — | "
+            "64 | — | — | mode=batched |" in table)
     # the actor/learner sweep keys by actor count: both rows survive,
     # the actors column carries the count and learner idle renders as
     # a percentage (bench_zero_scale.py's scaling table)
     assert ("| zero_ingest_games_per_min | 340.0 | games/min | — | — "
-            "| — | — | 2 | 7.1% | mesh_shape=8x1 |" in table)
+            "| — | — | — | 2 | 7.1% | mesh_shape=8x1 |" in table)
     assert ("| zero_ingest_games_per_min | 345.0 | games/min | — | — "
-            "| — | — | 4 | 5.7% | mesh_shape=8x1 |" in table)
+            "| — | — | — | 4 | 5.7% | mesh_shape=8x1 |" in table)
+    # the multi-size sweep keys by board: the board column carries it
+    # (bench_multisize.py's size-scaling table)
+    assert ("| multisize_moves_per_s | 52.3 | moves/s | 13 | — | — | "
+            "— | 4 | — | — | mode=one_pool |" in table)
 
     probe = tmp_path / "probe.log"
     probe.write_text(

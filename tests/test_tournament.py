@@ -57,6 +57,52 @@ def test_run_tournament_rejects_bad_names():
             run_tournament(a, b, games=1, size=SIZE, names=names)
 
 
+# --------------------------------------- handicap + cross-size axes
+
+
+def test_play_match_handicap_opening():
+    """Handicap stones land on the star points before play and White
+    moves first — the variant axis for lopsided matchups."""
+    policy = CNNPolicy(("board", "ones"), board=7, layers=2,
+                       filters_per_layer=4)
+    a = ProbabilisticPolicyPlayer(policy, temperature=1.0, seed=0,
+                                  move_limit=20)
+    b = ProbabilisticPolicyPlayer(policy, temperature=1.0, seed=1,
+                                  move_limit=20)
+    w = play_match(a, b, size=7, komi=7.0, move_limit=30, handicap=2)
+    assert w in (-1, 0, 1)
+    tally = run_tournament(a, b, games=2, size=7, komi=7.0,
+                           move_limit=30, handicap=2)
+    assert tally["games"] == 2
+
+
+def test_tournament_cross_size_reboards_fcn_nets(tmp_path):
+    """A checkpoint saved at one size plays at another via --board:
+    size-generic (FCN) nets re-board through at_board; size-locked
+    heads are refused up front."""
+    import os
+
+    from rocalphago_tpu.interface import tournament
+
+    policy = CNNPolicy(("board", "ones"), board=5, layers=2,
+                       filters_per_layer=4)
+    spec = os.path.join(tmp_path, "p5.json")
+    policy.save_model(spec)
+    r = tournament.main([
+        f"probabilistic:{spec}", f"probabilistic:{spec}",
+        "--games", "2", "--board", "7", "--temperature", "1.0",
+        "--move-limit", "20"])
+    assert r["games"] == 2
+    legacy = CNNPolicy(("board", "ones"), board=5, layers=2,
+                       filters_per_layer=4, head="bias")
+    locked = os.path.join(tmp_path, "locked.json")
+    legacy.save_model(locked)
+    with pytest.raises(SystemExit, match="size-locked"):
+        tournament.main([
+            f"probabilistic:{locked}", f"probabilistic:{spec}",
+            "--games", "1", "--board", "7"])
+
+
 # ------------------------------------------- per-game fault isolation
 
 
