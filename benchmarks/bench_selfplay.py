@@ -26,6 +26,54 @@ from benchmarks._harness import (  # noqa: E402
 )
 
 
+def run_cap_ab(args) -> None:
+    """Playout-cap randomization A/B (docs/PERFORMANCE.md "Self-play
+    economics"): full MCTS self-play games/min at each ``--cap-p``
+    value — the probability a ply draws the FULL ``--sims`` budget;
+    the rest run the cheap cap (sims/4). ``cap_p=1.0`` is the
+    all-full baseline every speedup is read against. Small nets on
+    purpose: the cap's win is search volume, which doesn't depend on
+    net width, and a fat net would just move the bottleneck."""
+    import jax
+
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.obs import registry as obs_registry
+    from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch = args.batch or (64 if on_tpu else 8)
+    board = args.board
+    if board == 19 and not on_tpu:
+        board = 9            # full-game 19×19 MCTS on CPU is minutes/rep
+    cfg = GoConfig(size=board)
+    feats = ("board", "ones")
+    pol = CNNPolicy(feats, board=board, layers=2, filters_per_layer=8)
+    val = CNNValue(feats + ("color",), board=board, layers=2,
+                   filters_per_layer=8)
+    cheap = max(1, args.sims // 4)
+    for p in [float(x) for x in str(args.cap_p).split(",")]:
+        run = make_mcts_selfplay(
+            cfg, pol.feature_list, val.feature_list, pol.module.apply,
+            val.module.apply, batch, args.move_limit, args.sims,
+            sim_chunk=min(8, args.sims), cap_p=p, cap_cheap=cheap)
+
+        def once():
+            final, _, _ = run(pol.params, val.params, jax.random.key(3))
+            return jax.device_get(final.board)
+
+        dt = timed(once, reps=args.reps, profile_dir=args.profile)
+        frac = obs_registry.REGISTRY.snapshot()["gauges"].get(
+            "selfplay_fullsearch_frac")
+        extra = {}
+        if frac is not None:
+            extra["fullsearch_frac"] = round(float(frac), 4)
+        report("selfplay_cap_games_per_min", batch * 60.0 / dt,
+               "games/min", batch=batch, board=board, cap_p=p,
+               cap_cheap=cheap, n_sim=args.sims,
+               move_limit=args.move_limit, **extra)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -45,7 +93,21 @@ def main() -> None:
                     "is ONE device program — keep plies × per-ply "
                     "cost under the ~40s TPU watchdog; default 5 on "
                     "TPU, 10 elsewhere)")
+    ap.add_argument("--cap-ab", action="store_true",
+                    help="run the playout-cap A/B instead of the ply-"
+                    "program bench: MCTS self-play games/min at each "
+                    "--cap-p value (docs/PERFORMANCE.md)")
+    ap.add_argument("--cap-p", default="1.0,0.25", metavar="P1,P2,...",
+                    help="full-search probabilities to sweep in the "
+                    "cap A/B (1.0 = every move full, the baseline)")
+    ap.add_argument("--sims", type=int, default=32,
+                    help="full search budget per move (cap A/B)")
+    ap.add_argument("--move-limit", type=int, default=24,
+                    help="plies per game (cap A/B)")
     args = ap.parse_args()
+    if args.cap_ab:
+        run_cap_ab(args)
+        return
     on_tpu = jax.devices()[0].platform == "tpu"
     if args.plies is None:
         args.plies = 5 if on_tpu else 10

@@ -69,8 +69,20 @@ def main() -> None:
     ap.add_argument("--replay-chunk", type=int, default=8)
     ap.add_argument("--no-force-host-devices", action="store_true",
                     help="keep the platform's real devices (TPU)")
+    ap.add_argument("--cap-p", type=float, default=0.0,
+                    help="playout-cap randomization: probability a "
+                    "ply gets the full --sims budget (0 = off; the "
+                    "'econ row' runs this at 0.25 — see "
+                    "docs/PERFORMANCE.md 'Self-play economics')")
+    ap.add_argument("--cap-cheap", type=int, default=None,
+                    help="cheap budget for capped plies "
+                    "(default sims/4)")
     ap.set_defaults(board=5, batch=8)
     args = ap.parse_args()
+    econ = {}
+    if args.cap_p:
+        econ = {"cap_p": args.cap_p,
+                "cap_cheap": args.cap_cheap or max(1, args.sims // 4)}
 
     feats = ("board", "ones")
     vfeats = feats + ("color",)
@@ -90,7 +102,7 @@ def main() -> None:
         cfg, feats, vfeats, pol.module.apply, val.module.apply,
         tx_p, tx_v, batch=args.batch, move_limit=args.move_limit,
         n_sim=args.sims, max_nodes=16, sim_chunk=args.sim_chunk,
-        replay_chunk=args.replay_chunk, mesh=mesh)
+        replay_chunk=args.replay_chunk, mesh=mesh, **econ)
     state0 = meshlib.replicate(mesh, init_zero_state(
         pol.params, val.params, tx_p, tx_v, seed=0))
 
@@ -119,7 +131,7 @@ def main() -> None:
            reps * args.batch * 60.0 / sync_dt, "games/min",
            batch=args.batch, board=args.board, actors=0,
            mesh_shape=mesh_shape,
-           selfplay_frac=round(selfplay_frac, 4))
+           selfplay_frac=round(selfplay_frac, 4), **econ)
 
     # ---------------- actor/learner sweep
     for n_actors in [int(x) for x in str(args.actors).split(",")]:
@@ -162,11 +174,11 @@ def main() -> None:
                ingested * 60.0 / dt, "games/min",
                batch=args.batch, board=args.board, actors=n_actors,
                mesh_shape=mesh_shape, learner_idle_frac=idle,
-               sync_selfplay_frac=round(selfplay_frac, 4))
+               sync_selfplay_frac=round(selfplay_frac, 4), **econ)
         report("zero_learner_steps_per_s", args.steps / dt,
                "steps/s", batch=args.batch, board=args.board,
                actors=n_actors, mesh_shape=mesh_shape,
-               learner_idle_frac=idle)
+               learner_idle_frac=idle, **econ)
 
 
 if __name__ == "__main__":

@@ -65,6 +65,20 @@ def default_sample_p() -> float:
     return float(os.environ.get(SAMPLE_P_ENV, "0.5"))
 
 
+#: Record-schema version written by :func:`games_to_record`. v1:
+#: the five core fields, no ``schema`` key. v2: adds the OPTIONAL
+#: self-play-economics fields (``full``/``ownership``/``score``,
+#: present only when recorded). Readers accept any version ≤ current
+#: (absent optionals synthesize as None); records from a FUTURE
+#: schema raise :class:`UnknownSchemaError` so ingest can count and
+#: skip them instead of mis-reading half-understood data.
+RECORD_SCHEMA = 2
+
+
+class UnknownSchemaError(ValueError):
+    """Record written by a newer schema than this reader knows."""
+
+
 class ZeroGames(NamedTuple):
     """One finished self-play batch — the unit the buffer stores.
 
@@ -75,9 +89,22 @@ class ZeroGames(NamedTuple):
     - ``actions``: ``[T, B]`` int32 move indices per ply
     - ``live``: ``[T, B]`` bool — ply happened before the game ended
     - ``visits``: ``[T, B, A]`` visit counts (int32) or improved-
-      policy targets (float32, gumbel mode)
+      policy targets (float32, gumbel mode; normalized pruned
+      targets with forced-playout pruning)
     - ``winners``: ``[B]`` int32 (+1 black / -1 white / 0 draw)
     - ``finished``: ``[B]`` bool — game ended by two passes
+
+    Self-play-economics fields (schema v2; ``None`` when the game was
+    generated with the flags off — v1 records load with all three
+    None):
+
+    - ``full``: ``[T, B]`` bool — ply ran a FULL search (playout-cap
+      randomization; only these plies carry policy targets)
+    - ``ownership``: ``[B, N]`` int8 terminal ownership labels
+      (black-positive; :func:`rocalphago_tpu.ops.labels
+      .terminal_labels`)
+    - ``score``: ``[B]`` float32 terminal score margins (black −
+      white, komi included)
     """
 
     actions: np.ndarray
@@ -85,6 +112,9 @@ class ZeroGames(NamedTuple):
     visits: np.ndarray
     winners: np.ndarray
     finished: np.ndarray
+    full: np.ndarray | None = None
+    ownership: np.ndarray | None = None
+    score: np.ndarray | None = None
 
 
 class ReplayEntry(NamedTuple):
@@ -100,9 +130,15 @@ class ReplayEntry(NamedTuple):
 
 def games_to_record(games: ZeroGames, version: int = 0,
                     seq: int = 0) -> dict:
-    """JSON-serializable record preserving shapes and dtypes."""
-    rec = {"version": int(version), "seq": int(seq)}
+    """JSON-serializable record preserving shapes and dtypes.
+    Optional (None) fields are simply absent from the record — a
+    flags-off game writes exactly the v1 field set plus the
+    ``schema`` tag."""
+    rec = {"version": int(version), "seq": int(seq),
+           "schema": RECORD_SCHEMA}
     for name, arr in zip(ZeroGames._fields, games):
+        if arr is None:
+            continue
         a = np.asarray(arr)
         rec[name] = a.tolist()
         rec[name + "_dtype"] = str(a.dtype)
@@ -112,9 +148,23 @@ def games_to_record(games: ZeroGames, version: int = 0,
 def record_to_games(rec: dict) -> tuple[ZeroGames, int]:
     """Inverse of :func:`games_to_record`; raises ``KeyError`` /
     ``TypeError`` / ``ValueError`` on malformed records (callers
-    treat those as torn input and skip)."""
-    arrs = [np.asarray(rec[name], dtype=np.dtype(rec[name + "_dtype"]))
-            for name in ZeroGames._fields]
+    treat those as torn input and skip). v1 records (no ``schema``
+    key) and v2 records missing optional fields synthesize those
+    fields as None; a FUTURE schema raises
+    :class:`UnknownSchemaError` (counted separately by
+    :class:`JsonlIngester` — unknown ≠ torn)."""
+    schema = int(rec.get("schema", 1))
+    if schema > RECORD_SCHEMA:
+        raise UnknownSchemaError(
+            f"record schema {schema} is newer than this reader's "
+            f"{RECORD_SCHEMA}")
+    arrs = []
+    for name in ZeroGames._fields:
+        if name in ZeroGames._field_defaults and name not in rec:
+            arrs.append(None)
+            continue
+        arrs.append(np.asarray(rec[name],
+                               dtype=np.dtype(rec[name + "_dtype"])))
     return ZeroGames(*arrs), int(rec.get("version", 0))
 
 
@@ -160,7 +210,8 @@ class ReplayBuffer:
         sample staleness by construction); ``block=False`` evicts the
         oldest entry when full.
         """
-        games = ZeroGames(*(np.asarray(x) for x in games))
+        games = ZeroGames(*(None if x is None else np.asarray(x)
+                            for x in games))
         n_games = int(games.winners.shape[0])
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
@@ -351,6 +402,7 @@ class JsonlIngester:
         self.buffer = buffer
         self.path = path
         self.skipped = 0
+        self.schema_skipped = 0
         self._offsets: dict[str, int] = {}
 
     def poll(self) -> int:
@@ -372,6 +424,13 @@ class JsonlIngester:
                     continue
                 try:
                     games, version = record_to_games(json.loads(line))
+                except UnknownSchemaError:
+                    # a NEWER writer shares the stream (rolling
+                    # upgrade): count separately — the operator's cue
+                    # to upgrade the reader, not a data-corruption
+                    # signal
+                    self.schema_skipped += 1
+                    continue
                 except (ValueError, KeyError, TypeError):
                     self.skipped += 1
                     continue

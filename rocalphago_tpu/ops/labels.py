@@ -1,4 +1,9 @@
-"""Pallas TPU kernel: batched connected-component labeling.
+"""Region labelling ops: the Pallas TPU labeling kernel, plus the
+terminal ownership/score labeller built on the same flood-fill
+(:func:`terminal_labels` — the auxiliary-target source for the
+KataGo-style ownership/score heads in ``models/value.py``).
+
+Pallas TPU kernel: batched connected-component labeling.
 
 The engine's hottest primitive is the whole-board flood fill behind
 ``jaxgo.compute_labels`` (group analysis for stepping, legality,
@@ -37,6 +42,54 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+
+def terminal_labels(cfg, state):
+    """Auxiliary training targets from one TERMINAL position:
+    ``(ownership int8 [N], score float32)``, black-positive.
+
+    Ownership is the area-scoring verdict per point: a stone's own
+    color, and for empty points the color of the single-color region
+    they sit in (+1 black, -1 white, 0 contested/neutral — dame and
+    seki-shared regions). Score is ``black − white`` with the komi
+    inside white, so ``sign(score) == jaxgo.winner`` by construction
+    — the parity the tests pin. Same flood-fill machinery as
+    :func:`jaxgo.area_scores` run on the empty graph; one game's
+    labels (vmap over a batch at the call site, e.g. the zero loop's
+    game-end labelling).
+    """
+    from rocalphago_tpu.engine.jaxgo import (BLACK, WHITE,
+                                             compute_labels,
+                                             neighbors_for)
+
+    n = cfg.num_points
+    nbrs = neighbors_for(cfg.size)
+    board = state.board
+    empty = board == 0
+
+    # label empty regions: treat empty as the "color" (area_scores'
+    # exact construction, kept in step with it by the parity test)
+    region = compute_labels(
+        cfg, jnp.where(empty, jnp.int8(9), jnp.int8(0)))
+    board_pad = jnp.concatenate(
+        [board, jnp.zeros((1,), board.dtype)])
+    nbr_color = board_pad[nbrs]
+    touches_b_pt = empty & (nbr_color == BLACK).any(axis=1)
+    touches_w_pt = empty & (nbr_color == WHITE).any(axis=1)
+    touches_b = jnp.zeros((n + 1,), jnp.bool_).at[region].max(
+        touches_b_pt)
+    touches_w = jnp.zeros((n + 1,), jnp.bool_).at[region].max(
+        touches_w_pt)
+
+    terr_b = empty & touches_b[region] & ~touches_w[region]
+    terr_w = empty & touches_w[region] & ~touches_b[region]
+    ownership = (board.astype(jnp.int8)
+                 + terr_b.astype(jnp.int8) - terr_w.astype(jnp.int8))
+    black = (board == BLACK).sum() + terr_b.sum()
+    white = (board == WHITE).sum() + terr_w.sum()
+    score = (black.astype(jnp.float32)
+             - white.astype(jnp.float32) - cfg.komi)
+    return ownership, score
 
 
 def _sweeps_for(num_points: int) -> int:

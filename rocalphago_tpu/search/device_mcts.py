@@ -41,11 +41,13 @@ params shards the whole search, bit-identically
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from rocalphago_tpu.engine.jaxgo import (
@@ -122,6 +124,17 @@ def _set_state(states: GoState, idx, st: GoState) -> GoState:
     return jax.tree.map(lambda buf, v: buf.at[idx].set(v), states, st)
 
 
+def _where_rows(active, new, old):
+    """Per-game pytree select: row ``b`` takes ``new`` where
+    ``active[b]`` else keeps ``old`` — the per-row budget mask of the
+    playout-cap programs (every field's leading axis is the game
+    batch)."""
+    return jax.tree.map(
+        lambda a, b: jnp.where(
+            active.reshape((-1,) + (1,) * (a.ndim - 1)), a, b),
+        new, old)
+
+
 def _terminal_value(cfg: GoConfig, st: GoState) -> jax.Array:
     """Outcome in {-1, 0, 1} from the player to move's perspective."""
     w = winner(cfg, st)
@@ -144,7 +157,7 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
                      value_features: tuple,
                      policy_apply: Callable, value_apply: Callable,
                      n_sim: int, max_nodes: int | None = None,
-                     c_puct: float = 5.0):
+                     c_puct: float = 5.0, forced_k: float = 0.0):
     """Build the jitted searcher.
 
     Returns ``search(params_p, params_v, root_states) ->
@@ -155,6 +168,15 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     ``policy_features + ("color",)`` (the canonical nested 48/49
     layout) so one encode serves both nets. ``max_nodes=None`` sizes
     the slab to ``2 * n_sim`` (root + every expanded leaf fit).
+
+    ``forced_k > 0`` enables FORCED PLAYOUTS at the root ("Accelerating
+    Self-Play Learning in Go", PAPERS.md): any prior-supported root
+    child with fewer than ``sqrt(forced_k · p(c) · N)`` visits (N =
+    total root visits so far) is selected ahead of PUCT — cheap
+    guaranteed exploration for self-play roots. The matching training
+    target prunes those forced visits back out
+    (``search.pruned_targets``); serving keeps the default ``0.0``
+    (bit-identical programs).
     """
     if max_nodes is None:
         max_nodes = 2 * n_sim
@@ -278,6 +300,19 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         score = jnp.where(prior_n > 0, q + u, -jnp.inf)
         return jnp.argmax(score).astype(jnp.int32)
 
+    def _select_action_root(prior_n, visits_n, value_n):
+        """Root selection under forced playouts: a prior-supported
+        child short of its visit floor ``sqrt(forced_k · p · N)`` is
+        taken first (largest deficit); PUCT otherwise. At N = 0 every
+        floor is 0, so the first simulation is plain PUCT."""
+        nv = visits_n.astype(jnp.float32)
+        floor = jnp.sqrt(jnp.float32(forced_k) * prior_n * nv.sum())
+        deficit = jnp.where(prior_n > 0, floor - nv, -jnp.inf)
+        a_puct = _select_action(prior_n, visits_n, value_n)
+        return jnp.where(jnp.max(deficit) > 0,
+                         jnp.argmax(deficit).astype(jnp.int32),
+                         a_puct)
+
     def _descend_one(prior, visits, value_sum, child, done_m,
                      root_action, root):
         """Single-game descend ([M, ...] arrays): walk existing child
@@ -296,10 +331,16 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         def body(carry):
             node, _, _ = carry
             at_term = done_m[node]
-            action = jnp.where(
-                at_term, -1,
-                _select_action(prior[node], visits[node],
-                               value_sum[node]))
+            sel = _select_action(prior[node], visits[node],
+                                 value_sum[node])
+            if forced_k:
+                # trace-time gate: serving/default searchers (0.0)
+                # compile exactly the pre-forced-playout program
+                sel = jnp.where(
+                    node == root,
+                    _select_action_root(prior[node], visits[node],
+                                        value_sum[node]), sel)
+            action = jnp.where(at_term, -1, sel)
             nxt = jnp.where(action >= 0, child[node, action], -1)
             stop = at_term | (nxt < 0)
             return (jnp.where(stop, node, nxt), action, stop)
@@ -500,13 +541,26 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         lambda params_p, params_v, tree, k: lax.fori_loop(
             0, k, lambda _, t: simulate(params_p, params_v, t), tree))
 
+    def _run_sims_budget_impl(params_p, params_v, tree, budget, j0,
+                              k: int):
+        """``k`` simulations with a PER-GAME sim budget (i32 [B]):
+        global sim index ``j0 + i`` runs only on rows still under
+        their budget — retired rows keep their slab bit-for-bit (the
+        playout-cap randomization mask; the chunk loop's early exit
+        at ``max(budget)`` is where the wall-clock saving is)."""
+        def body(i, t):
+            t2 = simulate(params_p, params_v, t)
+            return _where_rows((j0 + i) < budget, t2, t)
+
+        return lax.fori_loop(0, k, body, tree)
+
     copy_tree = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
 
     def run_sims_chunked(params_p, params_v, tree: DeviceTree,
                          chunk: int, n: int | None = None,
                          deadline=None, depth: int | None = None,
                          pipeline: ChunkPipeline | None = None,
-                         owned: bool = False):
+                         owned: bool = False, budget=None):
         """The one owner of the watchdog chunk schedule: ``n``
         (default ``n_sim``; a game clock may ask for fewer)
         simulations as ``chunk``-sized compiled programs, tree
@@ -544,6 +598,12 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         (the enforced path drains, so the numbers are real execution
         time)."""
         n = n_sim if n is None else n
+        if budget is not None:
+            # per-row budgets (i32 [B], playout-cap randomization):
+            # the caller usually passes n = host-known max(budget) so
+            # the loop early-exits; without it the mask alone keeps
+            # results right at full-loop cost
+            budget = budget.astype(jnp.int32)
         enforce = deadline is not None and not deadline.unlimited
         pipe = pipeline if pipeline is not None else ChunkPipeline(
             depth, runner="device_mcts")
@@ -560,8 +620,13 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             # the chunk program is read off the ``search`` attribute
             # (not the closure) so tests/instrumentation can wrap it
             t0 = time.monotonic()
-            tree = search.run_sims_donated(params_p, params_v, tree,
-                                           k=k)
+            if budget is None:
+                tree = search.run_sims_donated(params_p, params_v,
+                                               tree, k=k)
+            else:
+                tree = search.run_sims_budget_donated(
+                    params_p, params_v, tree, budget,
+                    jnp.int32(done), k=k)
             # the pipeline handle must be a FRESH array: the next
             # chunk donates the tree itself, which would delete
             # n_nodes out from under the retire's block
@@ -586,7 +651,8 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
                     tree: DeviceTree | None = None, deadline=None,
                     depth: int | None = None,
                     pipeline: ChunkPipeline | None = None,
-                    owned: bool = False):
+                    owned: bool = False, n: int | None = None,
+                    budget=None):
         """Full search as ``chunk``-simulation compiled programs with
         the tree device-resident in between — THE way to drive this
         on watchdog-limited backends (the ~40s TPU worker limit);
@@ -598,15 +664,47 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
         subtree) instead of ``init(roots)``; ``depth``/``pipeline``/
         ``owned`` thread through to :func:`run_sims_chunked` (the
         loop donates the tree slab — ``owned=True`` hands a passed
-        tree over)."""
+        tree over). ``n``/``budget`` are the playout-cap seam: ``n``
+        caps the sims this search runs (host-known, so the chunk loop
+        early-exits), ``budget`` adds per-row i32 [B] masking for a
+        mixed-budget batch."""
         if tree is None:
             tree = search.init(params_p, params_v, roots)
             owned = True             # init's output is loop-internal
         tree, ran = run_sims_chunked(params_p, params_v, tree, chunk,
-                                     deadline=deadline, depth=depth,
-                                     pipeline=pipeline, owned=owned)
+                                     n=n, deadline=deadline,
+                                     depth=depth, pipeline=pipeline,
+                                     owned=owned, budget=budget)
         search.last_ran = ran
         return search.root_stats(tree)
+
+    def _pruned_targets(tree: DeviceTree):
+        """Policy target with forced playouts PRUNED back out (the
+        KataGo policy-target-pruning rule, vectorized in-jit): per
+        root child except the most-visited, subtract its forced-visit
+        floor ``sqrt(forced_k · p · N)``, zero children left below one
+        real visit (forced-only exploration must not teach the
+        policy), keep the most-visited child whole, renormalize.
+        Returns ``(target f32 [B, A] summing to 1 per searched row,
+        pruned i32 [B] visits removed)``. With ``forced_k == 0`` the
+        floor is 0 and the target is exactly the normalized visit
+        distribution."""
+        visits, _ = _root_stats(tree)
+        idx = tree.root[:, None, None]
+        prior = jnp.take_along_axis(tree.prior, idx, axis=1)[:, 0, :]
+        nv = visits.astype(jnp.float32)
+        total = nv.sum(axis=-1, keepdims=True)
+        floor = jnp.sqrt(jnp.float32(forced_k) * prior * total)
+        on_best = (jnp.arange(nv.shape[-1])[None, :]
+                   == jnp.argmax(nv, axis=-1)[:, None])
+        kept = jnp.maximum(nv - floor, 0.0)
+        kept = jnp.where(kept < 1.0, 0.0, kept)
+        kept = jnp.where(on_best, nv, kept)
+        norm = kept.sum(axis=-1, keepdims=True)
+        target = jnp.where(norm > 0, kept / jnp.maximum(norm, 1.0),
+                           0.0)
+        pruned = (total - norm)[:, 0].astype(jnp.int32)
+        return target, pruned
 
     # serving-path telemetry (obs.registry): hoisted once per searcher
     # so the chunk loop pays a method call, not a registry lookup
@@ -637,6 +735,17 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     search.run_sims_donated = jaxobs.track(
         "device_mcts.run_sims", run_sims_donated)
     search.run_sims_donated.donates_buffers = True
+    # playout-cap sibling of run_sims_donated: per-row sim budgets
+    # masked in-program (same donation discipline; budget/j0 traced
+    # so one program serves every draw)
+    search.run_sims_budget_donated = jaxobs.track(
+        "device_mcts.run_sims_budget",
+        functools.partial(jax.jit, static_argnames=("k",),
+                          donate_argnums=(2,))(_run_sims_budget_impl))
+    search.run_sims_budget_donated.donates_buffers = True
+    # forced-playout training target (f32 distribution); the plain
+    # visit-count target when forced_k == 0
+    search.pruned_targets = jax.jit(_pruned_targets)
     search.run_sims_chunked = run_sims_chunked
     search.root_stats = jax.jit(_root_stats)
     search.run_chunked = run_chunked
@@ -835,22 +944,42 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         head = jnp.take_along_axis(cand[:, :k], order, axis=-1)
         return jnp.concatenate([head, cand[:, k:]], axis=-1)
 
+    def _forced_candidate(g, cand, slot):
+        """Root candidate forced by schedule slot ``slot`` (i32
+        scalar): candidates beyond the sensible set (possible when
+        fewer than m moves are sensible) carry ``-inf`` g — those
+        slots redirect to the top candidate instead of forcing an
+        unreachable edge."""
+        forced = jnp.take_along_axis(
+            cand, jnp.broadcast_to(slot, (cand.shape[0], 1)),
+            axis=-1)[:, 0]
+        g_f = jnp.take_along_axis(g, forced[:, None], axis=-1)[:, 0]
+        return jnp.where(g_f > neg / 2, forced, cand[:, 0])
+
     def _run_phase_impl(params_p, params_v, tree: DeviceTree, g, cand,
                         j0, count: int, k: int):
         """``count`` scheduled simulations (one compiled program):
-        sim ``j`` forces root candidate ``(j0 + j) % k``. Candidates
-        beyond the sensible set (possible when fewer than m moves are
-        sensible) carry ``-inf`` g — those slots redirect to the top
-        candidate instead of forcing an unreachable edge."""
+        sim ``j`` forces root candidate ``(j0 + j) % k`` (see
+        :func:`_forced_candidate` for the -inf-slot redirect)."""
         def body(i, t):
-            slot = (j0 + i) % k
-            forced = jnp.take_along_axis(
-                cand, jnp.broadcast_to(slot, (cand.shape[0], 1)),
-                axis=-1)[:, 0]
-            g_f = jnp.take_along_axis(g, forced[:, None],
-                                      axis=-1)[:, 0]
-            forced = jnp.where(g_f > neg / 2, forced, cand[:, 0])
+            forced = _forced_candidate(g, cand, (j0 + i) % k)
             return base.simulate(params_p, params_v, t, forced)
+
+        return lax.fori_loop(0, count, body, tree)
+
+    def _run_phase_budget_impl(params_p, params_v, tree: DeviceTree,
+                               g, cand, j0, ran0, budget, count: int,
+                               k: int):
+        """:func:`_run_phase_impl` under per-game sim budgets
+        (playout-cap randomization): the budget counts GLOBAL sims
+        across the whole halving plan (``ran0`` = sims already run),
+        and a row past its budget keeps its slab bit-for-bit — the
+        between-phase rerank then ranks whatever evidence that row
+        gathered, the same anytime rule a deadline expiry applies."""
+        def body(i, t):
+            forced = _forced_candidate(g, cand, (j0 + i) % k)
+            t2 = base.simulate(params_p, params_v, t, forced)
+            return _where_rows((ran0 + i) < budget, t2, t)
 
         return lax.fori_loop(0, count, body, tree)
 
@@ -872,7 +1001,7 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
                     chunk: int, deadline=None,
                     depth: int | None = None,
                     pipeline: ChunkPipeline | None = None,
-                    caches=None):
+                    caches=None, n: int | None = None, budget=None):
         """Phase-by-phase, ``chunk``-simulation compiled programs with
         the tree device-resident in between (the ~40s TPU worker
         watchdog); identical results to :func:`search` unless a
@@ -893,7 +1022,16 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         rerank is a device-side dependency of the next phase, so it
         needs no host sync; deadline expiry may leave up to ``depth``
         chunks in flight — they complete and count, the overshoot
-        bound (docs/RESILIENCE.md)."""
+        bound (docs/RESILIENCE.md).
+
+        ``n``/``budget`` are the playout-cap seam: ``n`` (host int)
+        truncates the halving plan at that many sims — the loop stops
+        dispatching, the surviving candidates are reranked on the
+        evidence so far and ``best``/π' are the anytime answer, the
+        SAME rule a deadline expiry applies; ``budget`` (i32 [B])
+        additionally masks per-row for a mixed-budget batch (rows
+        past their budget freeze; sims count globally across
+        phases)."""
         if caches is None:
             tree, g, cand, logits = init_j(params_p, params_v, roots,
                                            rng)
@@ -909,21 +1047,36 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
             depth, runner="gumbel")
         ran, out_of_time, chunk_i = 0, False, 0
         t_start = time.monotonic()
+        if budget is not None:
+            budget = budget.astype(jnp.int32)
         for k, v in schedule:
             total = k * v
             for j0 in range(0, total, chunk):
                 if ran and enforce and deadline.expired():
                     out_of_time = True
                     break
+                if n is not None and ran >= n:
+                    # playout cap reached: stop dispatching — the
+                    # rerank below is the anytime answer
+                    out_of_time = True
+                    break
                 faults.barrier("search.chunk", chunk_i)
                 chunk_i += 1
                 count = min(chunk, total - j0)
+                if n is not None:
+                    count = min(count, n - ran)
                 # read off the attribute (not the closure) so tests/
                 # instrumentation can wrap the compiled phase program
                 t0 = time.monotonic()
-                tree = search.run_phase_donated(
-                    params_p, params_v, tree, g, cand, jnp.int32(j0),
-                    count=count, k=k)
+                if budget is None:
+                    tree = search.run_phase_donated(
+                        params_p, params_v, tree, g, cand,
+                        jnp.int32(j0), count=count, k=k)
+                else:
+                    tree = search.run_phase_budget_donated(
+                        params_p, params_v, tree, g, cand,
+                        jnp.int32(j0), jnp.int32(ran), budget,
+                        count=count, k=k)
                 # fresh handle: the next chunk donates the tree (see
                 # the PUCT loop)
                 pipe.push(tree.n_nodes + 0)
@@ -978,6 +1131,13 @@ def make_gumbel_mcts(cfg: GoConfig, policy_features: tuple,
         functools.partial(jax.jit, static_argnames=("count", "k"),
                           donate_argnums=(2,))(_run_phase_impl))
     search.run_phase_donated.donates_buffers = True
+    # playout-cap sibling: per-row GLOBAL sim budgets masked into the
+    # phase program (budget/ran0 traced — one program per (count, k))
+    search.run_phase_budget_donated = jaxobs.track(
+        "device_mcts.run_phase_budget",
+        functools.partial(jax.jit, static_argnames=("count", "k"),
+                          donate_argnums=(2,))(_run_phase_budget_impl))
+    search.run_phase_budget_donated.donates_buffers = True
     search.root_stats = base.root_stats
     search.improved_policy = improved_j
     search.run_chunked = run_chunked
@@ -1335,7 +1495,11 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                        gumbel: bool = False, m_root: int = 16,
                        gumbel_sample: bool = False,
                        dirichlet_alpha: float = 0.0,
-                       noise_frac: float = 0.25, mesh=None):
+                       noise_frac: float = 0.25, mesh=None,
+                       cap_p: float | None = None,
+                       cap_cheap: int | None = None,
+                       cap_per_row: bool = False,
+                       forced_k: float = 0.0):
     """Search-driven self-play: every move of every game comes from a
     fresh on-device search over the batch — PUCT
     (:func:`make_device_mcts`, move sampled from root visit counts by
@@ -1375,11 +1539,54 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
     (:class:`DeviceMCTSPlayer`) never adds noise. Gumbel mode
     rejects the knob: the gumbel draw is already the root
     exploration mechanism.
+
+    **Self-play economics** (KataGo, "Accelerating Self-Play
+    Learning in Go"; all default OFF, each an independent flag):
+
+    - ``cap_p`` — playout-cap randomization. Each ply draws its sim
+      budget from the game rng chain: the full ``n_sim`` with
+      probability ``cap_p``, else the cheap ``cap_cheap``
+      (default ``n_sim // 4``). The draw is SHARED across the batch
+      by default — the games run lockstep, so one full-searched row
+      would make the whole batch pay full price; a correlated draw
+      converts the cheap plies into real wall-clock
+      (``E[sims/ply] = p·full + (1−p)·cheap``). ``cap_per_row=True``
+      draws iid per game instead and leans on the per-row budget
+      masking in the chunk programs (rows at their cap retire sim
+      steps as no-ops) — same E[sims] but chunk count follows the
+      batch MAX, so it only pays off once per-row early-exit
+      matters more than lockstep (e.g. under cross-game batching).
+      With ``record_visits=True`` the run appends a
+      ``full bool [T, B]`` mask — only full-searched plies should
+      emit policy targets (the trainer masks with it); cheap plies
+      still train the value/aux heads.
+    - ``forced_k`` — forced playouts + policy-target pruning at the
+      root (PUCT only): selection floors each root child at
+      ``sqrt(forced_k · prior · n_total)`` visits, and the recorded
+      target has the forced visits pruned back out
+      (:func:`search.pruned_targets`) so exploration doesn't leak
+      into the policy target. Targets become f32 (normalized).
+
+    Env defaults: ``ROCALPHAGO_CAP_P`` / ``ROCALPHAGO_CAP_CHEAP``
+    seed ``cap_p`` / ``cap_cheap`` when the caller passes ``None``.
     """
     if gumbel and dirichlet_alpha > 0:
         raise ValueError(
             "dirichlet_alpha is a PUCT-mode knob; gumbel self-play's "
             "root exploration is the gumbel draw itself")
+    if cap_p is None:
+        cap_p = float(os.environ.get("ROCALPHAGO_CAP_P", "") or 0.0)
+    if not 0.0 <= cap_p <= 1.0:
+        raise ValueError(f"cap_p must be in [0, 1], got {cap_p}")
+    if cap_cheap is None:
+        cap_cheap = int(os.environ.get("ROCALPHAGO_CAP_CHEAP", "")
+                        or max(1, n_sim // 4))
+    cheap = max(1, min(int(cap_cheap), n_sim))
+    econ = cap_p > 0 and cheap < n_sim
+    if gumbel and forced_k:
+        raise ValueError(
+            "forced_k is a PUCT-root knob; gumbel search visits "
+            "candidates by schedule, not PUCT selection")
     if gumbel:
         search = make_gumbel_mcts(cfg, policy_features,
                                   value_features, policy_apply,
@@ -1389,7 +1596,7 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         search = make_device_mcts(cfg, policy_features,
                                   value_features, policy_apply,
                                   value_apply, n_sim, max_nodes,
-                                  c_puct)
+                                  c_puct, forced_k=forced_k)
     n = cfg.num_points
     vstep = jax.vmap(functools.partial(step, cfg))
 
@@ -1446,9 +1653,45 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
         return search.run_chunked(params_p, params_v, states,
                                   sim_chunk, tree=tree, owned=True)
 
+    @jax.jit
+    def draw_budget(sub):
+        """One Bernoulli(cap_p) per ply: shared across the batch by
+        default (lockstep games — see the docstring), iid per row
+        with ``cap_per_row``."""
+        if cap_per_row:
+            full = jax.random.bernoulli(sub, cap_p, (batch,))
+        else:
+            full = jnp.broadcast_to(
+                jax.random.bernoulli(sub, cap_p), (batch,))
+        return full, jnp.where(full, n_sim, cheap).astype(jnp.int32)
+
+    def puct_search(params_p, params_v, states, noise_rng, n_ply,
+                    budget):
+        """The economics PUCT ply: same program sequence as
+        :func:`run_chunked` (init → [noise] → donated chunk loop →
+        root stats), but with the ply's sim count / per-row budget
+        threaded through and the pruned policy target read off the
+        final tree when ``forced_k`` is on."""
+        tree = search.init(params_p, params_v, states)
+        if dirichlet_alpha > 0:
+            tree = add_root_noise(tree, noise_rng)
+        tree, ran = search.run_sims_chunked(
+            params_p, params_v, tree, sim_chunk, n=n_ply,
+            budget=budget, owned=True)
+        visits, _ = search.root_stats(tree)
+        if forced_k:
+            target, pruned = search.pruned_targets(tree)
+        else:
+            target, pruned = visits, None
+        return visits, target, pruned, ran
+
     # per-ply wall time of search self-play (the done-fetch below
     # syncs each ply, so the numbers are real)
     _ply_h = obs_registry.histogram("selfplay_ply_seconds")
+    _sims_h = obs_registry.histogram("selfplay_sims_per_move",
+                                     edges=obs_registry.COUNT_EDGES)
+    _full_g = obs_registry.gauge("selfplay_fullsearch_frac")
+    _pruned_c = obs_registry.counter("policy_targets_pruned_total")
 
     def run(params_p, params_v, rng):
         states = new_states(cfg, batch)
@@ -1459,13 +1702,33 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
             from rocalphago_tpu.parallel import mesh as meshlib
 
             states = meshlib.shard_batch(mesh, states)
-        actions, lives, visit_seq = [], [], []
+        actions, lives, visit_seq, full_seq = [], [], [], []
+        pruned_acc, full_frac, n_plies = [], 0.0, 0
         for _ in range(max_moves):
             t_ply = time.monotonic()
+            if econ:
+                # the budget draw is a separate split so the OFF
+                # path's rng chain (and everything downstream of it)
+                # stays bit-identical
+                rng, sub_b = jax.random.split(rng)
+                full, budget = draw_budget(sub_b)
+                fh = np.asarray(jax.device_get(full))
+                n_ply = int(n_sim if fh.any() else cheap)
+                budget_arg = budget if cap_per_row else None
+                full_frac += float(fh.mean())
+                n_plies += 1
             if gumbel:
                 rng, sub = jax.random.split(rng)
-                visits, _, best, pi = search.run_chunked(
-                    params_p, params_v, states, sub, sim_chunk)
+                if econ:
+                    visits, _, best, pi = search.run_chunked(
+                        params_p, params_v, states, sub, sim_chunk,
+                        n=n_ply, budget=budget_arg)
+                    _sims_h.observe(search.last_ran
+                                    if search.last_ran is not None
+                                    else n_ply)
+                else:
+                    visits, _, best, pi = search.run_chunked(
+                        params_p, params_v, states, sub, sim_chunk)
                 if gumbel_sample:
                     # ``gumbel_sample`` move rule (VERDICT r4 #9
                     # experiment): sample the move from the improved
@@ -1480,6 +1743,22 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                 else:
                     states, action, live = step_best(states, best)
                 target = pi
+            elif econ or forced_k:
+                sub = None
+                if dirichlet_alpha > 0:
+                    rng, sub = jax.random.split(rng)
+                visits, target, pruned, ran = puct_search(
+                    params_p, params_v, states, sub,
+                    n_ply if econ else None,
+                    budget_arg if econ else None)
+                if econ:
+                    _sims_h.observe(ran)
+                if pruned is not None:
+                    pruned_acc.append(pruned.sum())
+                # the move is always sampled from the RAW visit
+                # counts — pruning reshapes only the recorded target
+                states, rng, action, live = pick_and_step(
+                    states, visits, rng)
             elif dirichlet_alpha > 0:
                 rng, sub = jax.random.split(rng)
                 visits, _ = puct_search_noisy(params_p, params_v,
@@ -1497,10 +1776,16 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
             lives.append(live)
             if record_visits:
                 visit_seq.append(target)
+                if econ:
+                    full_seq.append(full)
             done = bool(jax.device_get(states.done.all()))
             _ply_h.observe(time.monotonic() - t_ply)
             if done:
                 break
+        if econ and n_plies:
+            _full_g.set(full_frac / n_plies)
+        if pruned_acc:
+            _pruned_c.inc(int(jax.device_get(sum(pruned_acc))))
         n_act = cfg.num_points + 1
         out = (states,
                jnp.stack(actions) if actions
@@ -1508,9 +1793,13 @@ def make_mcts_selfplay(cfg: GoConfig, policy_features: tuple,
                jnp.stack(lives) if lives
                else jnp.zeros((0, batch), bool))
         if record_visits:
-            tdtype = jnp.float32 if gumbel else jnp.int32
+            tdtype = (jnp.float32 if (gumbel or forced_k)
+                      else jnp.int32)
             out += (jnp.stack(visit_seq) if visit_seq
                     else jnp.zeros((0, batch, n_act), tdtype),)
+            if econ:
+                out += (jnp.stack(full_seq) if full_seq
+                        else jnp.zeros((0, batch), bool),)
         return out
 
     return run

@@ -35,6 +35,7 @@ board mass is zero (forced passes, finished games) get weight 0.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, NamedTuple
 
 import jax
@@ -48,6 +49,7 @@ from rocalphago_tpu.features.planes import batched_encoder, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
 from rocalphago_tpu.obs import jaxobs, trace
+from rocalphago_tpu.ops.labels import terminal_labels
 from rocalphago_tpu.parallel import mesh as meshlib
 from rocalphago_tpu.runtime.pipeline import ChunkPipeline
 from rocalphago_tpu.search.device_mcts import make_mcts_selfplay
@@ -86,13 +88,58 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                         gumbel: bool = False, m_root: int = 16,
                         gumbel_sample: bool = False,
                         dirichlet_alpha: float = 0.0,
-                        noise_frac: float = 0.25, mesh=None):
+                        noise_frac: float = 0.25, mesh=None,
+                        cap_p: float | None = None,
+                        cap_cheap: int | None = None,
+                        cap_per_row: bool = False,
+                        forced_k: float = 0.0,
+                        aux_weight: float | None = None,
+                        value_apply_aux: Callable | None = None):
     """``(ZeroState) -> (ZeroState, metrics)`` — one full iteration:
     search self-play, replay-gradient accumulation for both nets, one
     optimizer step each. Host-driven (chunk-compiled throughout); the
     search phase and every replay segment stay under the TPU worker
-    watchdog."""
+    watchdog.
+
+    Self-play-economics knobs (KataGo; docs/PERFORMANCE.md "Self-play
+    economics"; all default OFF and the OFF path is pinned
+    bit-identical): ``cap_p``/``cap_cheap``/``cap_per_row`` and
+    ``forced_k`` pass through to :func:`make_mcts_selfplay` (env
+    defaults ``ROCALPHAGO_CAP_P``/``ROCALPHAGO_CAP_CHEAP`` resolve
+    HERE so the recorder and the loss masking agree on whether cap
+    randomization is live). With cap randomization on, only
+    full-searched plies carry policy-loss weight — cheap plies still
+    train the value (and aux) heads, which is the economics bet: a
+    cheap search is a fine move-picker and a fine value label, just
+    not a policy target.
+
+    ``aux_weight`` (> 0, env default ``ROCALPHAGO_AUX_WEIGHT``)
+    enables the auxiliary ownership/score regression against the
+    engine's terminal labels, weighted into the value-net loss;
+    requires ``value_apply_aux`` (an apply returning
+    ``(value, {"ownership", "score"})`` — build the net with
+    ``aux_heads=("ownership", "score")``, see ``models/value.py``).
+    Aux terms are masked exactly like the value loss (live plies of
+    FINISHED games: a move-capped game's terminal labels describe a
+    half-played board).
+    """
     n = cfg.num_points
+    if cap_p is None:
+        cap_p = float(os.environ.get("ROCALPHAGO_CAP_P", "") or 0.0)
+    if cap_cheap is None:
+        cap_cheap = int(os.environ.get("ROCALPHAGO_CAP_CHEAP", "")
+                        or max(1, n_sim // 4))
+    cheap = max(1, min(int(cap_cheap), n_sim))
+    econ = cap_p > 0 and cheap < n_sim
+    if aux_weight is None:
+        aux_weight = float(
+            os.environ.get("ROCALPHAGO_AUX_WEIGHT", "") or 0.0)
+    aux = aux_weight > 0
+    if aux and value_apply_aux is None:
+        raise ValueError(
+            "aux_weight > 0 needs value_apply_aux — an apply "
+            "returning (value, aux dict); build the value net with "
+            "aux_heads=('ownership', 'score')")
     selfplay = make_mcts_selfplay(
         cfg, policy_features, value_features, policy_apply,
         value_apply, batch, move_limit, n_sim, max_nodes,
@@ -100,7 +147,10 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         record_visits=True, gumbel=gumbel, m_root=m_root,
         gumbel_sample=gumbel_sample,
         dirichlet_alpha=dirichlet_alpha, noise_frac=noise_frac,
-        mesh=mesh)
+        mesh=mesh, cap_p=cap_p, cap_cheap=cheap,
+        cap_per_row=cap_per_row, forced_k=forced_k)
+    vlabels = jax.jit(jax.vmap(
+        functools.partial(terminal_labels, cfg))) if aux else None
 
     n_policy_planes = output_planes(policy_features)
     vgd = jax.vmap(lambda s: jaxgo.group_data(
@@ -110,9 +160,14 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
     vsens = jax.vmap(functools.partial(sensible_mask, cfg))
     vstep = jax.vmap(functools.partial(jaxgo.step, cfg))
 
-    def ply(policy_params, value_params, winners, finished, carry, xs):
+    def ply(policy_params, value_params, winners, finished,
+            aux_labels, carry, xs):
         states, grads_p, grads_v, stats = carry
-        actions_t, live_t, visits_t = xs
+        if econ:
+            actions_t, live_t, visits_t, full_t = xs
+        else:
+            actions_t, live_t, visits_t = xs
+            full_t = None
         if mesh is not None:
             # anchor the replayed game batch on the data axis (same
             # pattern as the RL iteration); the batch-summed losses
@@ -135,8 +190,15 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         pi = board_counts / jnp.maximum(mass, 1e-6)[:, None]
         w = live_t * (mass > 1e-3)                   # f32-able [B]
         wf = w.astype(jnp.float32)
+        if full_t is not None:
+            # playout-cap randomization: cheap-searched plies carry
+            # no policy target (their visit distribution is too
+            # shallow to teach), but still replay into the value/aux
+            # losses below
+            wf = wf * full_t
         # outcome from ply t's player-to-move perspective
         z = (winners * states.turn).astype(jnp.float32)
+        turn_f = states.turn.astype(jnp.float32)
 
         def loss_fn(pp, vp):
             # nested layout: the policy reads the prefix slice of the
@@ -146,7 +208,10 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             logp = jax.nn.log_softmax(
                 jnp.where(sens, logits, neg), axis=-1)
             ce = -(pi * logp).sum(axis=-1)
-            v = value_apply(vp, planes)
+            if aux_labels is None:
+                v = value_apply(vp, planes)
+            else:
+                v, aux_out = value_apply_aux(vp, planes)
             mse = (v - z) ** 2
             lp = (wf * ce).sum() / batch
             # value targets only from games that actually ENDED (two
@@ -162,16 +227,31 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             # the value head's SIGN matches the game's outcome
             decided = livef * (z != 0)
             correct = (decided * ((v > 0) == (z > 0))).sum()
-            return lp + lv, (lp, lv, correct, decided.sum(),
-                             livef.sum())
+            aux_stats = ()
+            total = lp + lv
+            if aux_labels is not None:
+                # terminal ownership/score, rotated to the player to
+                # move like z (the labels are black-positive) and
+                # masked exactly like the value loss — a half-played
+                # board's "terminal" labels teach nothing
+                own_l, score_l = aux_labels
+                own_t = own_l.astype(jnp.float32) * turn_f[:, None]
+                l_own = (livef * ((aux_out["ownership"] - own_t) ** 2
+                                  ).mean(axis=-1)).sum() / batch
+                sc_t = score_l * turn_f
+                l_sc = (livef * ((aux_out["score"] - sc_t) ** 2
+                                 )).sum() / batch
+                total = total + aux_weight * (l_own + l_sc)
+                aux_stats = (l_own, l_sc)
+            return total, (lp, lv, correct, decided.sum(),
+                           livef.sum()) + aux_stats
 
-        (gp, gv), (lp, lv, correct, cnt, live_n) = jax.grad(
+        (gp, gv), st = jax.grad(
             loss_fn, argnums=(0, 1), has_aux=True)(
                 policy_params, value_params)
         grads_p = jax.tree.map(jnp.add, grads_p, gp)
         grads_v = jax.tree.map(jnp.add, grads_v, gv)
-        stats = (stats[0] + lp, stats[1] + lv, stats[2] + correct,
-                 stats[3] + cnt, stats[4] + live_n)
+        stats = tuple(s + d for s, d in zip(stats, st))
         # share the ply's one group analysis with the rules step
         return (vstep(states, actions_t, gd), grads_p, grads_v, stats)
 
@@ -195,7 +275,7 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         _replay_jit = functools.partial(
             jax.jit, donate_argnums=(4,),
             in_shardings=(_rep, _rep, _dat, _dat, _carry_sh,
-                          _tmaj, _tmaj, _tmaj),
+                          _tmaj, _tmaj, _tmaj, _tmaj, _dat),
             out_shardings=_carry_sh)
         _update_jit = functools.partial(
             jax.jit,
@@ -206,19 +286,23 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
     @jaxobs.track("zero.replay_segment")
     @_replay_jit
     def replay_segment(policy_params, value_params, winners, finished,
-                       carry, actions, live, visits):
+                       carry, actions, live, visits, full, aux_labels):
         # segment length rides the xs shapes (one compile per distinct
         # segment length — the fixed chunk plus at most one remainder).
         # The carry (replay states + BOTH nets' grad accumulators) is
         # DONATED: it is loop-internal (built fresh per iteration, so
         # the iteration-level retry wrapper stays valid) and donating
         # it keeps pipelined dispatch from doubling the params-shaped
-        # accumulators.
+        # accumulators. ``full``/``aux_labels`` are None with the
+        # economics flags off — empty pytrees that leave the traced
+        # program (and the donation indices) exactly as before.
         def body(c, xs):
             return ply(policy_params, value_params, winners, finished,
-                       c, xs), None
+                       aux_labels, c, xs), None
 
-        carry, _ = lax.scan(body, carry, (actions, live, visits))
+        xs = ((actions, live, visits) if full is None
+              else (actions, live, visits, full))
+        carry, _ = lax.scan(body, carry, xs)
         return carry
 
     replay_segment.donates_buffers = True
@@ -249,6 +333,9 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             # the value net (its loss is masked to finished games)
             "finished_rate": finished.mean(),
         }
+        if aux:
+            metrics["aux_loss_ownership"] = stats[5]
+            metrics["aux_loss_score"] = stats[6]
         return ZeroState(
             optax.apply_updates(state.policy_params, up),
             optax.apply_updates(state.value_params, uv),
@@ -263,11 +350,21 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         The self-play span is honest host wall time (the chunk loop
         syncs per done-poll — see docs/OBSERVABILITY.md)."""
         with trace.span("zero.selfplay", plies=move_limit):
-            final, actions, live, visits = selfplay(
-                policy_params, value_params, game_key)
+            out = selfplay(policy_params, value_params, game_key)
+            if econ:
+                final, actions, live, visits, full = out
+            else:
+                (final, actions, live, visits), full = out, None
             winners = jax.vmap(
                 functools.partial(jaxgo.winner, cfg))(final)
-        return ZeroGames(actions, live, visits, winners, final.done)
+            ownership = score = None
+            if aux:
+                # terminal aux labels off the final position (the
+                # loss masks to finished games, so labels from
+                # move-capped boards are recorded but never weighted)
+                ownership, score = vlabels(final)
+        return ZeroGames(actions, live, visits, winners, final.done,
+                         full, ownership, score)
 
     def learn(state: ZeroState, games: ZeroGames):
         """The LEARNER half: replay-gradient accumulation + one
@@ -290,6 +387,21 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
         finished = jnp.asarray(games.finished).astype(jnp.float32)
         live_f = live.astype(jnp.float32)
         num_moves = live.sum(axis=0, dtype=jnp.int32)
+        full_f = None
+        if econ:
+            # a v1/flags-off record fed to an economics learner has
+            # no mask: every ply was a full search
+            full_f = (jnp.ones_like(live_f) if games.full is None
+                      else jnp.asarray(games.full).astype(jnp.float32))
+        aux_labels = None
+        if aux:
+            if games.ownership is None or games.score is None:
+                raise ValueError(
+                    "aux_weight > 0 but the game record carries no "
+                    "ownership/score labels — the actor must play "
+                    "with aux labelling on (schema v2)")
+            aux_labels = (jnp.asarray(games.ownership),
+                          jnp.asarray(games.score))
 
         states = jaxgo.new_states(cfg, batch)
         if mesh is not None:
@@ -304,12 +416,16 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
             actions, live_f, visits = (
                 jax.device_put(x, _tmaj)
                 for x in (actions, live_f, visits))
+            if full_f is not None:
+                full_f = jax.device_put(full_f, _tmaj)
+            if aux_labels is not None:
+                aux_labels = jax.device_put(aux_labels, _dat)
         grads_p = jax.tree.map(jnp.zeros_like, state.policy_params)
         grads_v = jax.tree.map(jnp.zeros_like, state.value_params)
-        # five DISTINCT zero arrays, not one repeated: the replay
+        # DISTINCT zero arrays, not one repeated: the replay
         # segment donates the carry, and XLA rejects donating the
-        # same buffer twice
-        stats = tuple(jnp.float32(0) for _ in range(5))
+        # same buffer twice (5 stats; +2 aux-loss slots when on)
+        stats = tuple(jnp.float32(0) for _ in range(7 if aux else 5))
         plies = actions.shape[0]
         carry = (states, grads_p, grads_v, stats)
         # pipelined dispatch (runtime.pipeline): the pipeline paces
@@ -323,7 +439,9 @@ def make_zero_iteration(cfg: jaxgo.GoConfig, policy_features: tuple,
                 carry = replay_segment(
                     state.policy_params, state.value_params, wf,
                     finished, carry, actions[sl], live_f[sl],
-                    visits[sl])
+                    visits[sl],
+                    None if full_f is None else full_f[sl],
+                    aux_labels)
                 # fresh handle (the next segment donates the carry,
                 # deleting its leaves out from under a retire)
                 pipe.push(carry[3][0] + 0.0)
@@ -606,6 +724,31 @@ def run_training(argv=None) -> dict:
                          "incompatible with --gumbel)")
     ap.add_argument("--noise-frac", type=float, default=0.25,
                     help="root-noise mix fraction ε")
+    ap.add_argument("--cap-p", type=float, default=None,
+                    help="playout-cap randomization: probability a "
+                         "ply gets the FULL --sims search (cheap cap "
+                         "otherwise; only full plies emit policy "
+                         "targets). Default $ROCALPHAGO_CAP_P or 0 "
+                         "= off")
+    ap.add_argument("--cap-cheap", type=int, default=None,
+                    help="cheap-search sim cap (default "
+                         "$ROCALPHAGO_CAP_CHEAP or --sims // 4)")
+    ap.add_argument("--cap-per-row", action="store_true",
+                    help="draw the cap per GAME instead of per ply-"
+                         "batch (iid rows; masked-slab budgets — see "
+                         "docs/PERFORMANCE.md before using: lockstep "
+                         "batches only save wall-clock with the "
+                         "shared draw)")
+    ap.add_argument("--forced-k", type=float, default=0.0,
+                    help="forced-playout coefficient k at the PUCT "
+                         "root (KataGo sqrt(k*P*n) visit floors; "
+                         "recorded policy targets have the forced "
+                         "visits pruned back out; 0 = off, "
+                         "incompatible with --gumbel)")
+    ap.add_argument("--aux-weight", type=float, default=None,
+                    help="weight of the auxiliary ownership/score "
+                         "losses (value net needs aux_heads; default "
+                         "$ROCALPHAGO_AUX_WEIGHT or 0 = off)")
     ap.add_argument("--num-devices", type=int, default=None,
                     help="mesh width (default: every device whose "
                          "count divides --game-batch)")
@@ -667,6 +810,12 @@ def run_training(argv=None) -> dict:
                          "--gumbel explores via the gumbel draw")
     if a.gumbel_sample_moves and not a.gumbel:
         raise SystemExit("--gumbel-sample-moves requires --gumbel")
+    if a.gumbel and a.forced_k:
+        raise SystemExit("--forced-k is a PUCT-root knob; gumbel "
+                         "search visits candidates by schedule")
+    aux_weight = (a.aux_weight if a.aux_weight is not None else
+                  float(os.environ.get("ROCALPHAGO_AUX_WEIGHT", "")
+                        or 0.0))
     if a.gumbel and a.temperature != 1.0 and not a.gumbel_sample_moves:
         print("zero: --temperature is ignored with --gumbel (the "
               "per-ply gumbel draw is the exploration; with "
@@ -705,6 +854,17 @@ def run_training(argv=None) -> dict:
     mesh = meshlib.make_mesh(n_dev)
     coord = meshlib.is_coordinator()
 
+    value_apply_aux = None
+    if aux_weight > 0:
+        if not getattr(value.module, "aux_heads", ()):
+            raise SystemExit(
+                "--aux-weight needs a value net built with "
+                "aux_heads=('ownership', 'score') — rebuild the "
+                "value spec (models/value.py) or graft heads onto "
+                "the checkpoint with models.value.with_aux_heads")
+        value_apply_aux = functools.partial(value.module.apply,
+                                            with_aux=True)
+
     tx_p = optax.sgd(a.learning_rate)
     tx_v = optax.sgd(a.learning_rate)
     iteration = make_zero_iteration(
@@ -716,7 +876,10 @@ def run_training(argv=None) -> dict:
         replay_chunk=a.replay_chunk, gumbel=a.gumbel,
         m_root=a.m_root, gumbel_sample=a.gumbel_sample_moves,
         dirichlet_alpha=a.dirichlet_alpha,
-        noise_frac=a.noise_frac, mesh=mesh)
+        noise_frac=a.noise_frac, mesh=mesh,
+        cap_p=a.cap_p, cap_cheap=a.cap_cheap,
+        cap_per_row=a.cap_per_row, forced_k=a.forced_k,
+        aux_weight=aux_weight, value_apply_aux=value_apply_aux)
     state = meshlib.replicate(mesh, init_zero_state(
         policy.params, value.params, tx_p, tx_v, seed=a.seed))
 
@@ -906,6 +1069,15 @@ def run_training(argv=None) -> dict:
                     last_done["state"] = jax.device_get(state)
                     last_done["step"] = it + 1
                 faults.barrier("zero.post_iteration", it)
+                if "aux_loss_ownership" in m:
+                    # per-head gauges mirror the metrics stream so
+                    # obs_report can trend the aux losses next to the
+                    # economics counters
+                    obs_registry.gauge(
+                        "aux_loss", head="ownership").set(
+                            m["aux_loss_ownership"])
+                    obs_registry.gauge("aux_loss", head="score").set(
+                        m["aux_loss_score"])
                 entry = {"iteration": it, **m,
                          "games_per_min": a.game_batch * 60.0
                          / max(time.time() - t0, 1e-9)}

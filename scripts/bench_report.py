@@ -60,7 +60,13 @@ def load_records(path: str, date: str, platform: str | None):
                    r.get("sessions"), r.get("mode"),
                    # actor/learner scale axes (bench_zero_scale.py):
                    # each actor count × mesh shape is its own row
-                   r.get("actors"), r.get("mesh_shape"))
+                   r.get("actors"), r.get("mesh_shape"),
+                   # self-play economics axis (bench_selfplay.py
+                   # --cap-ab / bench_zero_scale.py --cap-p): each
+                   # cap probability is its own row — the baseline
+                   # (cap_p=1.0 or absent) and capped sides of the
+                   # A/B must not collapse into one
+                   r.get("cap_p"))
             prev = latest.get(key)
             if prev is None or str(r.get("date")) >= str(prev.get("date")):
                 latest[key] = r
@@ -72,7 +78,8 @@ def load_records(path: str, date: str, platform: str | None):
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
                 "vs_baseline", "mfu", "host_gap_frac", "us_per_pos",
-                "sessions", "actors", "learner_idle_frac", "board"}
+                "sessions", "actors", "learner_idle_frac", "board",
+                "cap_p", "fullsearch_frac"}
 
 
 def render_table(records) -> str:
@@ -96,10 +103,15 @@ def render_table(records) -> str:
     ``selfplay_frac``; ``mesh_shape`` also stays in config). The
     board column keys multi-size sweeps (``bench_multisize.py``: one
     FCN checkpoint served per board size — read same-metric rows
-    across boards for the size-scaling table)."""
+    across boards for the size-scaling table). The cap-p and
+    full-frac columns key the self-play economics A/B
+    (``bench_selfplay.py --cap-ab``: games/min vs the probability a
+    ply gets the full search budget; ``fullsearch_frac`` is the frac
+    the run actually drew — read the cap_p=1 row as the baseline)."""
     lines = ["| metric | value | unit | board | MFU | host gap "
-             "| µs/pos | sessions | actors | learner idle | config |",
-             "|---|---|---|---|---|---|---|---|---|---|---|"]
+             "| µs/pos | sessions | actors | learner idle "
+             "| cap p | full frac | config |",
+             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -120,9 +132,14 @@ def render_table(records) -> str:
         idle = r.get("learner_idle_frac")
         idle = ("—" if idle in (None, "")
                 else f"{100.0 * float(idle):.1f}%")
+        capp = r.get("cap_p")
+        capp = "—" if capp in (None, "") else f"{float(capp):g}"
+        ff = r.get("fullsearch_frac")
+        ff = "—" if ff in (None, "") else f"{100.0 * float(ff):.1f}%"
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
                      f" | {r.get('unit', '?')} | {board} | {u} | {gap}"
-                     f" | {upp} | {sess} | {act} | {idle} | {cfg} |")
+                     f" | {upp} | {sess} | {act} | {idle} | {capp}"
+                     f" | {ff} | {cfg} |")
     return "\n".join(lines)
 
 

@@ -266,6 +266,66 @@ def render_actor_learner(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def _aux_trend(records) -> dict:
+    """``head -> (first, last)`` aux-loss gauge values across the
+    run's registry snapshots (gauges only keep the latest value, so
+    the trend comes from walking every snapshot, not just the last)."""
+    import re
+
+    out: dict = {}
+    for r in records:
+        if r.get("event") != "registry" or "snapshot" not in r:
+            continue
+        for key, v in r["snapshot"].get("gauges", {}).items():
+            if not key.startswith("aux_loss"):
+                continue
+            m = re.search(r'head="([^"]*)"', key)
+            head = m.group(1) if m else key
+            first, _ = out.get(head, (v, v))
+            out[head] = (first, v)
+    return out
+
+
+def render_selfplay_econ(records, snap: dict) -> str:
+    """Self-play economics (playout-cap randomization + policy-target
+    pruning + aux heads; docs/PERFORMANCE.md): the cheap/full search
+    split, realized sims/move against the all-full budget the cap
+    avoided, how many recorded policy targets had forced playouts
+    pruned out, and the aux-loss trend across registry snapshots —
+    'is the cap paying for itself and are the aux heads learning'."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    h = snap.get("histograms", {}).get("selfplay_sims_per_move")
+    frac = gauges.get("selfplay_fullsearch_frac")
+    pruned = counters.get("policy_targets_pruned_total")
+    aux = _aux_trend(records)
+    if h is None and frac is None and pruned is None and not aux:
+        return "(no self-play economics records)"
+    lines = []
+    if frac is not None:
+        lines.append(f"searches: {100.0 * frac:.1f}% full / "
+                     f"{100.0 * (1.0 - frac):.1f}% cheap")
+    if h and h.get("count"):
+        mean = h["sum"] / h["count"]
+        full_est = quantile_from_buckets(h, 1.0)
+        saved = ""
+        # the full budget isn't in the snapshot; the occupied bucket
+        # holding the max observed bounds it from above — good enough
+        # for an "is the cap paying" estimate, hence the ≈/≲ hedges
+        if full_est and full_est != float("inf") and full_est > mean:
+            saved = (f", ≈{100.0 * (1.0 - mean / full_est):.0f}% "
+                     f"sims saved vs all-full (≲{full_est:g})")
+        lines.append(f"sims: mean {mean:.1f}/move over "
+                     f"{h['count']} moves{saved}")
+    if pruned is not None:
+        lines.append(f"policy targets pruned: {pruned}")
+    for head, (first, last) in sorted(aux.items()):
+        trend = (f"{first:g} → {last:g}" if first != last
+                 else f"{last:g}")
+        lines.append(f"aux_loss[{head}]: {trend}")
+    return "\n".join(lines)
+
+
 def render_curriculum(records) -> str:
     """Curriculum ladder (training/curriculum.py; docs/MULTISIZE.md):
     one row per ``curriculum_stage`` event — board, iterations, wall
@@ -333,6 +393,8 @@ def report(records, top: int | None = None) -> str:
              render_dispatch(reg or {}), "",
              "## actor/learner (replay ingest / learner idle)", "",
              render_actor_learner(reg or {}), "",
+             "## self-play economics (cap split / sims saved / aux)",
+             "", render_selfplay_econ(records, reg or {}), "",
              "## curriculum (per-stage ladder / transfer verdict)", "",
              render_curriculum(records), "",
              "## encode path (per-position cost / compiles)", "",
@@ -371,6 +433,12 @@ FIXTURE = [
     {"event": "curriculum_transfer", "board": 13, "games": 32,
      "transfer": True, "wilson_lb": 0.6241, "wins_a": 26,
      "wins_b": 6, "draws": 0, "win_rate_a": 0.8125},
+    # an EARLY snapshot (iteration 0): only its aux_loss gauges matter
+    # — the econ section walks every snapshot to render the trend;
+    # every other section reads the last snapshot only
+    {"event": "registry", "snapshot": {
+        "gauges": {'aux_loss{head="ownership"}': 0.92,
+                   'aux_loss{head="score"}': 61.0}}},
     {"event": "registry", "snapshot": {
         "counters": {'serve_rung_total{rung="search"}': 41,
                      'serve_rung_total{rung="policy"}': 1,
@@ -386,13 +454,17 @@ FIXTURE = [
                      "replay_evicted_games_total": 8,
                      "learner_steps_total": 7,
                      'actor_games_total{actor="a0"}': 16,
-                     'actor_games_total{actor="a1"}': 16},
+                     'actor_games_total{actor="a1"}': 16,
+                     "policy_targets_pruned_total": 37},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
                    'device_occupancy{runner="device_mcts"}': 0.983,
                    "replay_fill_games": 6,
                    "replay_ingest_per_min": 480.0,
                    "learner_idle_frac": 0.12,
-                   "actor_params_version": 7},
+                   "actor_params_version": 7,
+                   "selfplay_fullsearch_frac": 0.25,
+                   'aux_loss{head="ownership"}': 0.41,
+                   'aux_loss{head="score"}': 18.5},
         "histograms": {"gtp_genmove_seconds": {
             "count": 42, "sum": 33.6,
             "buckets": {"0.5": 17, "1": 40, "2.5": 42,
@@ -409,7 +481,10 @@ FIXTURE = [
                 "buckets": {"0.5": 4, "1": 6, "2.5": 7, "+Inf": 7}},
             "learner_wait_seconds": {
                 "count": 7, "sum": 0.9,
-                "buckets": {"0.25": 5, "0.5": 7, "+Inf": 7}}}}},
+                "buckets": {"0.25": 5, "0.5": 7, "+Inf": 7}},
+            "selfplay_sims_per_move": {
+                "count": 64, "sum": 896.0,
+                "buckets": {"10": 48, "50": 64, "+Inf": 64}}}}},
 ]
 
 
@@ -429,6 +504,13 @@ def selftest() -> int:
               "learner: 7 steps, idle 12.0%",
               "staleness: p50≲0.5 p99≲2.5 (7 consumed)",
               "a0=16", "a1=16",
+              "self-play economics (cap split / sims saved / aux)",
+              "searches: 25.0% full / 75.0% cheap",
+              "sims: mean 14.0/move over 64 moves, "
+              "≈72% sims saved vs all-full (≲50)",
+              "policy targets pruned: 37",
+              "aux_loss[ownership]: 0.92 → 0.41",
+              "aux_loss[score]: 61 → 18.5",
               "curriculum (per-stage ladder / transfer verdict)",
               "transfer @ 13: TRANSFERS (wilson_lb=0.6241, "
               "26–6 of 32 games, win_rate 0.8125)")

@@ -51,6 +51,17 @@ cd "$(dirname "$0")/.."
 # batched-encoder match are @slow. See docs/PERFORMANCE.md
 # "Incremental encode".
 #
+# Self-play economics (tests/test_econ.py, tier-1): budget-masked
+# slab identity (budget == n_sim bit-matches the plain run; mixed
+# budgets stop each row exactly at its cap), forced-playout target
+# pruning units, the flags-OFF bit-identity pins for selfplay and a
+# tiny zero iteration, terminal ownership/score label parity against
+# the engine's area scoring, and the aux-head graft keeping the
+# value output bit-identical. The everything-ON zero end-to-end
+# (cap + forced-k + aux learn) is @slow. Replay schema-v2 round-trip
+# /spill/skip semantics live in tests/test_replay.py (tier-1). See
+# docs/PERFORMANCE.md "Self-play economics".
+#
 # Pipelined dispatch: tests/test_pipeline.py is tier-1 —
 # bit-identical pipelined-vs-sync sweeps for PUCT/gumbel search,
 # chunked self-play (lagged done-poll) and a zero iteration, the

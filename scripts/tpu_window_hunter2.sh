@@ -119,7 +119,7 @@ preprocess chase_xla chase_pls encode_base encode_shared4 \
 encode_shared1 encode_shared2 encode_shared8 encode_split4 \
 encode_pallas encode_incr_seq encode_incr_batch encode_incr_selfplay \
 devmcts9 devmcts_gumbel serve_small serve_fleet multisize_serve \
-zero_actor_learner \
+zero_actor_learner zero_econ \
 selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
 train_trace preprocess_trace tournament headline_sized headline"
@@ -194,6 +194,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             # actor count, against the sync baseline's selfplay_frac.
             # --no-force-host-devices keeps the real TPU mesh.
             zero_actor_learner) run zero_actor_learner python benchmarks/bench_zero_scale.py --no-force-host-devices --actors 1,2,4 --steps 4 --reps 2 ;;
+            # zero_econ: the PR-13 self-play economics A/B on chip
+            # (bench_selfplay.py --cap-ab; docs/PERFORMANCE.md
+            # "Self-play economics") — MCTS self-play games/min at
+            # cap_p 1.0 (all-full baseline) vs 0.25 with the cheap
+            # cap at sims/4; bench_report keys the rows by cap_p.
+            zero_econ) run zero_econ python benchmarks/bench_selfplay.py --cap-ab --board 9 --batch 64 --sims 64 --move-limit 40 --reps 2 ;;
             bisect)      run bisect      python scripts/tpu_crash_bisect.py --log "$LOG/bisect.jsonl" ;;
             selfplay16)  run selfplay16  python benchmarks/bench_selfplay.py --batch-sweep 16 --reps 2 ;;
             selfplay64)  run selfplay64  python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;

@@ -18,8 +18,10 @@ import numpy as np
 import pytest
 
 from rocalphago_tpu.data.replay import (
+    RECORD_SCHEMA,
     JsonlIngester,
     ReplayBuffer,
+    UnknownSchemaError,
     ZeroGames,
     append_jsonl_record,
     games_to_record,
@@ -38,9 +40,23 @@ def make_games(seed=0, t=3, b=2, a=26):
     )
 
 
+def make_ext_games(seed=0, t=3, b=2, a=26, n=25):
+    """Games carrying the schema-2 self-play-economics fields."""
+    r = np.random.default_rng(seed + 100)
+    return make_games(seed, t, b, a)._replace(
+        full=r.integers(0, 2, (t, b)).astype(bool),
+        ownership=r.integers(-1, 2, (b, n)).astype(np.int8),
+        score=r.normal(size=(b,)).astype(np.float32),
+    )
+
+
 def games_equal(a, b):
-    return all(np.array_equal(x, y) and x.dtype == y.dtype
-               for x, y in zip(a, b))
+    def eq(x, y):
+        if x is None or y is None:
+            return x is None and y is None
+        return np.array_equal(x, y) and x.dtype == y.dtype
+
+    return all(eq(x, y) for x, y in zip(a, b))
 
 
 # ---------------------------------------------------------- buffer
@@ -140,6 +156,53 @@ def test_record_roundtrip_preserves_dtypes():
     g3, _ = record_to_games(
         json.loads(json.dumps(games_to_record(gf))))
     assert games_equal(gf, g3)
+
+
+def test_schema_v1_record_synthesizes_optional_fields():
+    """A line written before the schema field existed (v1) loads with
+    every schema-2 optional field as None — rolling-upgrade reads."""
+    rec = games_to_record(make_games(1), version=2)
+    assert rec["schema"] == RECORD_SCHEMA
+    rec.pop("schema")                     # a v1 writer's line
+    g, version = record_to_games(rec)
+    assert version == 2
+    assert g.full is None and g.ownership is None and g.score is None
+    assert games_equal(g, make_games(1))
+
+
+def test_extended_fields_roundtrip_and_spill(tmp_path):
+    """full/ownership/score survive the JSON round trip AND the
+    crash-spill restore with dtypes intact."""
+    g = make_ext_games(4)
+    g2, _ = record_to_games(json.loads(json.dumps(games_to_record(g))))
+    assert games_equal(g, g2)
+    spill = str(tmp_path / "replay")
+    buf = ReplayBuffer(capacity=2, spill_dir=spill)
+    assert buf.put(g, version=5)
+    buf2 = ReplayBuffer(capacity=2, spill_dir=spill)
+    assert buf2.restore() == 1
+    e = buf2.next_batch(timeout=1.0)
+    assert e.version == 5 and games_equal(e.games, g)
+
+
+def test_unknown_schema_raises_and_ingester_counts(tmp_path):
+    """A FUTURE schema is refused loudly (never silently mis-read),
+    and the ingester counts it separately from garbage lines so a
+    rolling upgrade is diagnosable from the stats alone."""
+    rec = games_to_record(make_games(0))
+    rec["schema"] = RECORD_SCHEMA + 1
+    with pytest.raises(UnknownSchemaError):
+        record_to_games(rec)
+    shard = str(tmp_path / "a.jsonl")
+    append_jsonl_record(shard, make_games(0), version=1)
+    with open(shard, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    buf = ReplayBuffer(capacity=4)
+    ing = JsonlIngester(buf, str(tmp_path))
+    assert ing.poll() == 1                # the valid line only
+    assert ing.schema_skipped == 1
+    assert ing.skipped == 0               # NOT counted as garbage
+    assert buf.next_batch(timeout=1.0).version == 1
 
 
 def test_jsonl_ingester_tolerates_torn_tail(tmp_path):
