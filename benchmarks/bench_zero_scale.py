@@ -69,6 +69,12 @@ def main() -> None:
     ap.add_argument("--replay-chunk", type=int, default=8)
     ap.add_argument("--no-force-host-devices", action="store_true",
                     help="keep the platform's real devices (TPU)")
+    ap.add_argument("--kill-actor-at", type=int, default=None,
+                    help="recovery A/B: run the sweep under the fleet "
+                    "supervisor and inject a kill into actor 0 at "
+                    "this learner step; the report row gains kill_at "
+                    "+ mttr_s (death detection to first post-restart "
+                    "game — docs/RESILIENCE.md 'Fleet supervision')")
     ap.add_argument("--cap-p", type=float, default=0.0,
                     help="playout-cap randomization: probability a "
                     "ply gets the full --sims budget (0 = off; the "
@@ -138,22 +144,51 @@ def main() -> None:
         buf = ReplayBuffer(capacity=max(2 * n_actors, 4))
         pub = ParamsPublisher()
         gang = DispatchGang()
-        actors = []
-        for i in range(n_actors):
-            rng = pack_rng(jax.random.fold_in(
-                unpack_rng(state0.rng), i + 1))
-            actors.append(SelfplayActor(
-                iteration.play, pub, buf, rng, name=f"a{i}",
-                lockstep=False, pace=False, poll_s=0.1, gang=gang))
+
+        def make_actor(i, attempt=0, beat=None):
+            key = jax.random.fold_in(unpack_rng(state0.rng), i + 1)
+            if attempt:
+                key = jax.random.fold_in(key, attempt)
+            return SelfplayActor(
+                iteration.play, pub, buf, pack_rng(key),
+                name=f"a{i}", lockstep=False, pace=False,
+                poll_s=0.1, gang=gang, on_progress=beat)
+
+        sup = None
+        handles = actors = []
+        if args.kill_actor_at is not None:
+            # the recovery A/B rides the supervised rig: the injected
+            # kill, the restart and the MTTR stamp are the production
+            # machinery, not bench scaffolding
+            from rocalphago_tpu.runtime.supervisor import (
+                RestartPolicy,
+                Supervisor,
+            )
+
+            sup = Supervisor(policy=RestartPolicy(base_delay=0.05,
+                                                  max_delay=0.5),
+                             poll_s=0.05)
+            handles = [
+                sup.add((lambda i: lambda attempt, beat:
+                         make_actor(i, attempt, beat))(i),
+                        name=f"a{i}")
+                for i in range(n_actors)]
+        else:
+            actors = [make_actor(i) for i in range(n_actors)]
         learner = ZeroLearner(iteration.learn, buf, sample=True,
                               gang=gang)
         pub.publish(state0.policy_params, state0.value_params,
                     version=0)
-        for ac in actors:
-            ac.start()
+        if sup is not None:
+            sup.start()
+        else:
+            for ac in actors:
+                ac.start()
         state = state0
         t0 = time.monotonic()
         for step in range(args.steps):
+            if sup is not None and step == args.kill_actor_at:
+                handles[0].worker.inject_fault()
             out = learner.step(state, timeout=300.0)
             if out is None:
                 err = next((ac.error for ac in actors if ac.error),
@@ -167,14 +202,26 @@ def main() -> None:
         dt = time.monotonic() - t0
         ingested = buf.ingested_games
         buf.close()
-        for ac in actors:
-            ac.stop()
+        if sup is not None:
+            sup.stop()
+        else:
+            for ac in actors:
+                ac.stop()
         idle = round(learner.idle_frac, 4)
+        recovery = {}
+        if sup is not None:
+            mttr = handles[0].last_mttr_s
+            recovery = {"kill_at": args.kill_actor_at,
+                        "mttr_s": (round(mttr, 3)
+                                   if mttr is not None else None),
+                        "restarts": sum(h.restarts
+                                        for h in sup.handles())}
         report("zero_ingest_games_per_min",
                ingested * 60.0 / dt, "games/min",
                batch=args.batch, board=args.board, actors=n_actors,
                mesh_shape=mesh_shape, learner_idle_frac=idle,
-               sync_selfplay_frac=round(selfplay_frac, 4), **econ)
+               sync_selfplay_frac=round(selfplay_frac, 4),
+               **recovery, **econ)
         report("zero_learner_steps_per_s", args.steps / dt,
                "steps/s", batch=args.batch, board=args.board,
                actors=n_actors, mesh_shape=mesh_shape,

@@ -371,6 +371,24 @@ class ReplayBuffer:
                 n += 1
         return n
 
+    def discard_spill(self) -> int:
+        """Delete every spilled entry WITHOUT restoring it; returns
+        the count. The lockstep resume path: the lockstep actor
+        replays its games bit-identically from the checkpointed rng
+        chain, so restoring leftovers would double-insert them —
+        free-run resumes call :meth:`restore` instead."""
+        if not self.spill_dir:
+            return 0
+        paths = glob.glob(os.path.join(self.spill_dir, "entry.*.json"))
+        n = 0
+        for path in paths:
+            try:
+                os.unlink(path)
+                n += 1
+            except OSError:
+                pass
+        return n
+
     def _spill_path(self, seq: int) -> str:
         return os.path.join(self.spill_dir, f"entry.{seq:08d}.json")
 
@@ -395,7 +413,10 @@ class JsonlIngester:
     on the instance, and only newline-terminated lines are consumed —
     a torn tail (writer mid-append or crashed) is left for the next
     :meth:`poll`. Records that fail to parse or decode are counted
-    and skipped, never fatal.
+    and skipped, never fatal. A shard that SHRINKS under our offset
+    (an actor restarted by its supervisor truncates and rewrites, or
+    logrotate swapped the file) is re-read from byte 0 — counted in
+    ``shard_rotated`` — instead of silently tailing past EOF forever.
     """
 
     def __init__(self, buffer: ReplayBuffer, path: str):
@@ -403,6 +424,7 @@ class JsonlIngester:
         self.path = path
         self.skipped = 0
         self.schema_skipped = 0
+        self.shard_rotated = 0
         self._offsets: dict[str, int] = {}
 
     def poll(self) -> int:
@@ -410,9 +432,16 @@ class JsonlIngester:
         added = 0
         for shard in sorted(glob.glob(
                 os.path.join(self.path, "*.jsonl"))):
+            offset = self._offsets.get(shard, 0)
             try:
                 with open(shard, "rb") as f:
-                    f.seek(self._offsets.get(shard, 0))
+                    if os.fstat(f.fileno()).st_size < offset:
+                        # rotation/truncation: our offset points past
+                        # EOF — restart from the top of the new file
+                        self.shard_rotated += 1
+                        offset = 0
+                        self._offsets[shard] = 0
+                    f.seek(offset)
                     data = f.read()
             except OSError:
                 continue
@@ -436,7 +465,7 @@ class JsonlIngester:
                     continue
                 if self.buffer.put(games, version=version):
                     added += 1
-            self._offsets[shard] = self._offsets.get(shard, 0) + end + 1
+            self._offsets[shard] = offset + end + 1
         return added
 
 

@@ -10,8 +10,9 @@ loads and a ``None`` check, so production loops pay nothing.
 Plan grammar (full reference in docs/RESILIENCE.md)::
 
     plan   := spec ("," spec)*
-    spec   := kind "@" ["iter" N "."] barrier [":" hit] ["=" arg]
-    kind   := "crash" | "io_error" | "error" | "sleep"
+    spec   := kind "@" ["iter" N "."] barrier [":" hit]
+              [":p=" P] [":seed=" S] ["=" arg]
+    kind   := "crash" | "io_error" | "error" | "sleep" | "kill"
 
 * ``crash`` — flush stdio and ``os._exit(FAULT_EXIT_CODE)`` (a hard
   kill: no atexit hooks, no finally blocks — the honest model of
@@ -19,25 +20,44 @@ Plan grammar (full reference in docs/RESILIENCE.md)::
 * ``io_error`` — raise :class:`InjectedFault` (an ``OSError``
   subclass, classified transient by :mod:`.retries`);
 * ``error`` — raise ``RuntimeError`` (classified non-transient);
-* ``sleep`` — block ``arg`` seconds (trips :mod:`.watchdog`).
+* ``sleep`` — block ``arg`` seconds (trips :mod:`.watchdog`);
+* ``kill`` — raise :class:`InjectedKill` (a ``RuntimeError``
+  subclass: NON-transient, so the retry layer re-raises immediately
+  and the worker thread genuinely dies — the signal the
+  :mod:`.supervisor` resurrect path is exercised by).
 
 ``iterN.`` restricts the spec to barrier hits whose ``iteration``
 argument equals N. ``:hit`` fires on the k-th matching hit (default
-the first). Each spec fires at most once. Barrier names are
-dot-qualified (``zero.post_save``); a spec's barrier matches on the
-full name or any dot-suffix, so ``crash@post_save`` hits
+the first). A deterministic spec fires at most once. Barrier names
+are dot-qualified (``zero.post_save``); a spec's barrier matches on
+the full name or any dot-suffix, so ``crash@post_save`` hits
 ``zero.post_save`` and ``sl.post_save`` alike while
-``crash@zero.post_save`` hits only the zero trainer.
+``crash@zero.post_save`` hits only the zero trainer. The barrier
+name ``random`` is a wildcard matching EVERY barrier.
+
+RANDOMIZED schedules (the chaos-soak grammar): ``:p=P`` makes the
+spec probabilistic — from its ``hit``-th matching hit onward it
+fires with probability P per hit, repeatedly (it never retires).
+The draw is DETERMINISTIC: hashed from ``seed`` (``:seed=S``,
+default 0), the barrier name, and the per-spec hit count, so a
+given plan produces the identical kill schedule on every run —
+chaos soaks are reproducible by seed. For convenience the comma
+form ``kill@random:p=0.05,seed=7`` is accepted too: a plan
+fragment with no ``@`` that looks like ``p=``/``seed=`` re-attaches
+to the preceding spec.
 
 Examples::
 
     ROCALPHAGO_FAULT_PLAN=crash@iter3.post_save
     ROCALPHAGO_FAULT_PLAN=io_error@promote:2,sleep@pre_iteration=0.5
+    ROCALPHAGO_FAULT_PLAN=kill@random:p=0.05,seed=7
+    ROCALPHAGO_FAULT_PLAN=kill@actor.game:p=0.2,kill@learner.step:3
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
 import re
 import sys
@@ -45,11 +65,18 @@ import time
 
 FAULT_PLAN_ENV = "ROCALPHAGO_FAULT_PLAN"
 FAULT_EXIT_CODE = 173          # distinct from shell/signal codes
-_KINDS = ("crash", "io_error", "error", "sleep")
+_KINDS = ("crash", "io_error", "error", "sleep", "kill")
 
 
 class InjectedFault(OSError):
     """The raisable injected fault (an OSError: transient class)."""
+
+
+class InjectedKill(RuntimeError):
+    """The injected worker kill (non-transient by the
+    :mod:`.retries` classifier, so it rides THROUGH the retry layer
+    and takes the worker thread down — the supervisor's problem, not
+    the retrier's)."""
 
 
 @dataclasses.dataclass
@@ -60,30 +87,54 @@ class _Spec:
     hit: int
     arg: float | None
     text: str                  # original spec, for log lines
+    p: float | None = None     # probabilistic: fire-chance per hit
+    seed: int = 0
     count: int = 0
     fired: bool = False
 
     def matches(self, name: str, iteration) -> bool:
         if self.iteration is not None and iteration != self.iteration:
             return False
-        return (name == self.barrier
+        return (self.barrier == "random"
+                or name == self.barrier
                 or name.endswith("." + self.barrier))
+
+    def draw(self, name: str) -> bool:
+        """Deterministic per-hit Bernoulli draw for ``p`` specs:
+        hashed from (seed, barrier name, hit count) so the same plan
+        replays the same kill schedule."""
+        digest = hashlib.sha256(
+            f"{self.seed}:{name}:{self.count}".encode()).digest()
+        frac = int.from_bytes(digest[:8], "big") / float(1 << 64)
+        return frac < (self.p or 0.0)
 
 
 _SPEC_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<barrier>[A-Za-z0-9_.]+)"
-    r"(?::(?P<hit>\d+))?(?:=(?P<arg>[0-9.]+))?$")
+    r"(?::(?P<hit>\d+))?(?::p=(?P<p>[0-9.]+))?"
+    r"(?::seed=(?P<seed>\d+))?(?:=(?P<arg>[0-9.]+))?$")
+
+# a plan fragment with no "@" that re-attaches to the previous spec
+# (the comma form of the probabilistic params: kill@random:p=,seed=)
+_PARAM_RE = re.compile(r"^(p|seed)=[0-9.]+$")
 
 # None = not yet loaded from the env; [] = loaded, empty
 _plan: list[_Spec] | None = None
 
 
 def parse_plan(text: str) -> list[_Spec]:
-    specs = []
-    for raw in text.split(","):
-        raw = raw.strip()
-        if not raw:
+    # re-attach comma-separated p=/seed= fragments to their spec
+    raws: list[str] = []
+    for frag in text.split(","):
+        frag = frag.strip()
+        if not frag:
             continue
+        if raws and "@" not in frag and _PARAM_RE.match(frag):
+            raws[-1] += ":" + frag
+        else:
+            raws.append(frag)
+    specs = []
+    for raw in raws:
         m = _SPEC_RE.match(raw)
         if m is None:
             raise ValueError(
@@ -105,10 +156,21 @@ def parse_plan(text: str) -> list[_Spec]:
         if kind == "sleep" and m.group("arg") is None:
             raise ValueError(
                 f"sleep spec {raw!r} needs a duration: sleep@name=0.5")
+        p = float(m.group("p")) if m.group("p") else None
+        if p is not None and not 0.0 <= p <= 1.0:
+            raise ValueError(
+                f"fault spec {raw!r}: p must be in [0, 1], got {p}")
+        if barrier_part == "random" and p is None:
+            raise ValueError(
+                f"fault spec {raw!r}: the 'random' wildcard barrier "
+                "needs a probability (e.g. kill@random:p=0.05) — "
+                "without one it would fire on the very first barrier "
+                "of the run")
         specs.append(_Spec(
             kind=kind, barrier=barrier_part, iteration=iteration,
             hit=int(m.group("hit") or 1),
             arg=float(m.group("arg")) if m.group("arg") else None,
+            p=p, seed=int(m.group("seed") or 0),
             text=raw))
     return specs
 
@@ -132,7 +194,11 @@ def active() -> bool:
 
 
 def _fire(spec: _Spec, name: str) -> None:
-    spec.fired = True
+    # probabilistic specs never retire: each later hit draws again
+    spec.fired = spec.p is None
+    if spec.kind == "kill":
+        raise InjectedKill(
+            f"injected kill at {name} (spec {spec.text})")
     if spec.kind == "crash":
         print(f"faults: injected crash at {name} "
               f"(spec {spec.text})", file=sys.stderr)
@@ -158,5 +224,8 @@ def barrier(name: str, iteration: int | None = None) -> None:
         if spec.fired or not spec.matches(name, iteration):
             continue
         spec.count += 1
-        if spec.count >= spec.hit:
-            _fire(spec, name)
+        if spec.count < spec.hit:
+            continue
+        if spec.p is not None and not spec.draw(name):
+            continue
+        _fire(spec, name)

@@ -1,0 +1,467 @@
+"""Supervised worker fleet: restarts, crash-loop parking, drain.
+
+The actor/learner rig is a FLEET — self-play actor threads, a learner
+driving the device, the serving dispatcher — and on preemptible pods
+individual members die routinely. This module is the supervision
+layer that makes those deaths cost seconds instead of the run:
+
+* **Restart policy** (:class:`RestartPolicy`): a dead worker is
+  classified with the same transient/fatal line :mod:`.retries`
+  draws, restarted after a deterministic-jitter backoff
+  (:func:`.retries.backoff_delay` — an interrupted-and-resumed run
+  replays the same schedule), and PARKED — permanently, with a
+  ``worker_parked`` alarm — once it dies ``max_deaths`` times within
+  ``window_s`` (a crash loop: restarting faster only burns the run's
+  wall clock in front of the real traceback).
+* **Heartbeat liveness**: workers report progress through their
+  handle's ``beat``; the monitor tags stale-but-alive workers in the
+  process watchdog's ``waiting_on`` registry (``actor:3``-style), so
+  a :class:`.watchdog.Watchdog` stall event names WHICH fleet member
+  wedged, not just where in code. The first beat after a restart
+  closes the MTTR clock (``worker_recovered`` event, kill-detection
+  to first post-restart progress).
+* **Graceful drain**: :meth:`Supervisor.install_sigterm` routes the
+  preemption notice (SIGTERM is how TPU preemption arrives) to
+  :meth:`Supervisor.request_drain` — restarts stop, a ``drain``
+  event is logged, and the training loop observes
+  :attr:`Supervisor.draining` to exit at the next iteration boundary
+  with a committed checkpoint (the byte-identical resume proof in
+  ``tests/test_fleet_chaos.py``).
+
+Two shapes are provided: :class:`Supervisor` manages REPLACEABLE
+workers built fresh per incarnation by a factory (the self-play
+actors — a new :class:`~rocalphago_tpu.training.actor.SelfplayActor`
+with a fresh rng branch per restart; lockstep actors are registered
+``restartable=False`` and park on first death so the lockstep
+bit-identity pin survives), while :class:`SupervisedThread` wraps a
+single long-lived loop body and re-enters it after an unexpected
+exception (the serving dispatcher, whose state lives on the
+evaluator object, not the thread).
+
+Lifecycle events (``worker_restart`` / ``worker_parked`` /
+``worker_recovered`` / ``drain``) go to the run's ``metrics.jsonl``
+via the supplied logger; counts also land in the process registry
+(``supervisor_restarts_total{worker=,reason=}``,
+``supervisor_parked_total{worker=}``, ``supervisor_mttr_seconds``)
+for the ``obs_report.py`` fleet-health section. See
+docs/RESILIENCE.md "Fleet supervision".
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from rocalphago_tpu.analysis import lockcheck
+from rocalphago_tpu.obs import registry
+from rocalphago_tpu.runtime import retries
+from rocalphago_tpu.runtime import watchdog as watchdog_mod
+
+MAX_DEATHS_ENV = "ROCALPHAGO_SUPERVISOR_MAX_DEATHS"
+WINDOW_ENV = "ROCALPHAGO_SUPERVISOR_WINDOW_S"
+BACKOFF_ENV = "ROCALPHAGO_SUPERVISOR_BACKOFF_S"
+POLL_ENV = "ROCALPHAGO_SUPERVISOR_POLL_S"
+HEARTBEAT_ENV = "ROCALPHAGO_SUPERVISOR_HEARTBEAT_S"
+
+
+def default_max_deaths() -> int:
+    """Crash-loop threshold: park a worker after this many deaths
+    within the window (env ``ROCALPHAGO_SUPERVISOR_MAX_DEATHS``,
+    default 3)."""
+    return int(os.environ.get(MAX_DEATHS_ENV, "3"))
+
+
+def default_window_s() -> float:
+    """Crash-loop window in seconds — deaths older than this age out
+    of the loop detector (env ``ROCALPHAGO_SUPERVISOR_WINDOW_S``,
+    default 60)."""
+    return float(os.environ.get(WINDOW_ENV, "60"))
+
+
+def default_backoff_s() -> float:
+    """Base restart backoff in seconds; actual delays follow
+    ``retries.backoff_delay``'s deterministic-jitter exponential
+    envelope (env ``ROCALPHAGO_SUPERVISOR_BACKOFF_S``,
+    default 0.25)."""
+    return float(os.environ.get(BACKOFF_ENV, "0.25"))
+
+
+def default_poll_s() -> float:
+    """Monitor poll interval in seconds (env
+    ``ROCALPHAGO_SUPERVISOR_POLL_S``, default 0.2)."""
+    return float(os.environ.get(POLL_ENV, "0.2"))
+
+
+def default_heartbeat_s() -> float:
+    """Stale-worker threshold in seconds: an alive worker whose last
+    beat is older than this gets named in the watchdog's
+    ``waiting_on`` registry (env ``ROCALPHAGO_SUPERVISOR_HEARTBEAT_S``,
+    default 30)."""
+    return float(os.environ.get(HEARTBEAT_ENV, "30"))
+
+
+class RestartPolicy:
+    """When and how fast to resurrect a dead worker.
+
+    ``classify`` reuses :func:`.retries.is_transient` verbatim — the
+    reason label on lifecycle events is ``transient`` (infrastructure
+    flake, incl. the chaos harness's :class:`~.faults.InjectedFault`
+    and :class:`~.faults.InjectedKill`) or ``error`` (everything
+    else). Both are restarted — a supervised worker is pure by
+    construction (its state is rebuilt by the factory), so the
+    donated-buffer hazard that limits in-place retries does not
+    apply — but a crash LOOP of either flavour parks.
+    """
+
+    def __init__(self, max_deaths: int | None = None,
+                 window_s: float | None = None,
+                 base_delay: float | None = None,
+                 max_delay: float = 30.0, seed: int = 0):
+        self.max_deaths = (default_max_deaths()
+                           if max_deaths is None else max_deaths)
+        self.window_s = (default_window_s()
+                         if window_s is None else window_s)
+        self.base_delay = (default_backoff_s()
+                           if base_delay is None else base_delay)
+        self.max_delay = max_delay
+        self.seed = seed
+
+    def classify(self, error: BaseException) -> str:
+        return "transient" if retries.is_transient(error) else "error"
+
+    def crash_looping(self, deaths: list[float], now: float) -> bool:
+        recent = [t for t in deaths if now - t <= self.window_s]
+        return len(recent) >= self.max_deaths
+
+    def delay(self, attempt: int, key: str) -> float:
+        return retries.backoff_delay(attempt, self.base_delay,
+                                     self.max_delay, self.seed, key)
+
+
+class Handle:
+    """One supervised slot: the current worker incarnation plus its
+    restart history. Created via :meth:`Supervisor.add`; all fields
+    except the beat pair are written only by the monitor thread
+    (single-writer — see docs/CONCURRENCY.md's benign list)."""
+
+    def __init__(self, factory, name: str, restartable: bool, sup):
+        self.factory = factory
+        self.name = name
+        self.restartable = restartable
+        self.worker = None          # current incarnation
+        self.restarts = 0
+        self.parked = False
+        self.finished = False       # clean exit (games bound, stop)
+        self.error: BaseException | None = None
+        self.last_mttr_s: float | None = None
+        self._sup = sup
+        self._deaths: list[float] = []
+        # lock-free heartbeat pair: _last_beat has one writer (the
+        # worker, via beat) and one reader (the monitor); _recover_t0
+        # is set by the monitor only while the worker is dead and
+        # cleared by the first post-restart beat — phase-separated
+        self._last_beat = time.monotonic()
+        self._recover_t0: float | None = None
+
+    def beat(self) -> None:
+        """Report liveness/progress; workers call this once per unit
+        of work (a finished game). The first beat after a restart
+        stamps the MTTR."""
+        self._last_beat = time.monotonic()
+        t0 = self._recover_t0
+        if t0 is not None:
+            self._recover_t0 = None
+            mttr = time.monotonic() - t0
+            self.last_mttr_s = mttr
+            registry.histogram("supervisor_mttr_seconds").observe(mttr)
+            self._sup._emit("worker_recovered", worker=self.name,
+                            restarts=self.restarts,
+                            mttr_s=round(mttr, 3))
+
+    def alive(self) -> bool:
+        w = self.worker
+        return w is not None and w.alive()
+
+
+class Supervisor:
+    """Monitor thread resurrecting factory-built workers on death.
+
+    Worker protocol (duck-typed; :class:`~..training.actor.
+    SelfplayActor` satisfies it): ``start()``, ``stop(timeout)``,
+    ``alive() -> bool``, and an ``error`` attribute that is None
+    after a clean exit. ``factory(attempt, beat)`` builds incarnation
+    ``attempt`` (0 = first start); ``beat`` is the handle's heartbeat
+    callable for the worker's progress callback.
+
+    A worker whose thread exits with ``error`` set has DIED; the
+    monitor classifies, backs off, and restarts it — unless the
+    handle is ``restartable=False`` (lockstep actors: a restarted
+    lockstep actor would replay games the learner already consumed,
+    so the bit-identity contract forbids resurrection and the handle
+    parks immediately with reason ``restart_refused``) or the death
+    history trips the crash-loop detector.
+    """
+
+    def __init__(self, *, metrics=None, policy: RestartPolicy | None = None,
+                 poll_s: float | None = None,
+                 heartbeat_s: float | None = None):
+        self._metrics = metrics
+        self.policy = policy or RestartPolicy()
+        self._poll_s = default_poll_s() if poll_s is None else poll_s
+        self._heartbeat_s = (default_heartbeat_s()
+                             if heartbeat_s is None else heartbeat_s)
+        self._lock = lockcheck.make_lock("Supervisor._lock")
+        self._handles: list[Handle] = []   # guarded-by: self._lock
+        self._draining = False             # guarded-by: self._lock
+        self.drain_reason: str | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._monitor, name="supervisor", daemon=True)
+        self._stale_tag: str | None = None      # monitor-thread-only
+        self._stale_cm = None                   # monitor-thread-only
+        self._old_sigterm = None
+
+    # ------------------------------------------------------ lifecycle
+
+    def add(self, factory, *, name: str,
+            restartable: bool = True) -> Handle:
+        """Register a worker slot; the worker itself is built and
+        started by :meth:`start` (or by a later restart)."""
+        h = Handle(factory, name, restartable, self)
+        with self._lock:
+            self._handles.append(h)
+        return h
+
+    def start(self) -> "Supervisor":
+        with self._lock:
+            handles = list(self._handles)
+        # factory + start are caller code: run outside the lock
+        for h in handles:
+            if h.worker is None:
+                h.worker = h.factory(0, h.beat)
+                h.worker.start()
+                h._last_beat = time.monotonic()
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop restarting, join the monitor, stop every worker."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        with self._lock:
+            handles = list(self._handles)
+        for h in handles:
+            if h.worker is not None:
+                h.worker.stop(timeout=timeout)
+        self.restore_sigterm()
+
+    def handles(self) -> list[Handle]:
+        with self._lock:
+            return list(self._handles)
+
+    def parked(self) -> list[Handle]:
+        return [h for h in self.handles() if h.parked]
+
+    # ---------------------------------------------------------- drain
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def request_drain(self, reason: str = "signal") -> None:
+        """Graceful-drain request: restarts stop; the training loop
+        polls :attr:`draining` and exits at its next iteration
+        boundary with a committed checkpoint. Idempotent."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self.drain_reason = reason
+        self._emit("drain", phase="requested", reason=reason)
+
+    def install_sigterm(self) -> bool:
+        """Route SIGTERM (the preemption notice) to
+        :meth:`request_drain`. Signal handlers can only be installed
+        from the main thread — returns False (no-op) elsewhere, so
+        in-process test harnesses that run training off-main keep
+        working."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        self._old_sigterm = signal.signal(
+            signal.SIGTERM,
+            lambda signum, frame: self.request_drain(reason="sigterm"))
+        return True
+
+    def restore_sigterm(self) -> None:
+        if (self._old_sigterm is not None
+                and threading.current_thread()
+                is threading.main_thread()):
+            signal.signal(signal.SIGTERM, self._old_sigterm)
+            self._old_sigterm = None
+
+    # -------------------------------------------------------- monitor
+
+    def _emit(self, event: str, **fields) -> None:
+        if self._metrics is not None:
+            self._metrics.log(event, **fields)
+
+    def _park(self, h: Handle, reason: str) -> None:
+        h.parked = True
+        registry.counter("supervisor_parked_total",
+                         worker=h.name).inc()
+        self._emit("worker_parked", worker=h.name, reason=reason,
+                   deaths=len(h._deaths),
+                   error=(f"{type(h.error).__name__}: {h.error}"
+                          if h.error is not None else None))
+
+    def _restart(self, h: Handle, now: float) -> None:
+        err = h.error
+        reason = self.policy.classify(err)
+        if not h.restartable:
+            self._park(h, reason="restart_refused")
+            return
+        if self.policy.crash_looping(h._deaths, now):
+            self._park(h, reason="crash_loop")
+            return
+        h.restarts += 1
+        delay = self.policy.delay(h.restarts, key=h.name)
+        registry.counter("supervisor_restarts_total",
+                         worker=h.name, reason=reason).inc()
+        self._emit("worker_restart", worker=h.name, reason=reason,
+                   restarts=h.restarts, delay_s=round(delay, 3),
+                   error=f"{type(err).__name__}: {err}")
+        # MTTR clock starts at death DETECTION (includes the backoff)
+        h._recover_t0 = now
+        if self._stop.wait(delay):
+            return
+        w = h.factory(h.restarts, h.beat)
+        w.start()
+        h.worker = w
+        h._last_beat = time.monotonic()
+
+    def _retag_stale(self, handles: list[Handle], now: float) -> None:
+        stale = sorted(
+            h.name for h in handles
+            if not h.parked and not h.finished and h.alive()
+            and now - h._last_beat > self._heartbeat_s)
+        tag = ",".join(stale) if stale else None
+        if tag == self._stale_tag:
+            return
+        if self._stale_cm is not None:
+            self._stale_cm.__exit__(None, None, None)
+            self._stale_cm = None
+        if tag is not None:
+            self._stale_cm = watchdog_mod.waiting_on(tag)
+            self._stale_cm.__enter__()
+        self._stale_tag = tag
+
+    def _monitor(self) -> None:
+        try:
+            while not self._stop.wait(self._poll_s):
+                with self._lock:
+                    handles = list(self._handles)
+                    draining = self._draining
+                now = time.monotonic()
+                for h in handles:
+                    if h.parked or h.finished or h.worker is None:
+                        continue
+                    if h.alive():
+                        continue
+                    err = getattr(h.worker, "error", None)
+                    if err is None or draining:
+                        # games bound reached / stop requested / the
+                        # fleet is draining: a death is final either
+                        # way, but only a clean one counts as done
+                        h.finished = err is None
+                        continue
+                    h.error = err
+                    h._deaths.append(now)
+                    self._restart(h, now)
+                self._retag_stale(handles, now)
+        finally:
+            if self._stale_cm is not None:
+                self._stale_cm.__exit__(None, None, None)
+                self._stale_cm = None
+                self._stale_tag = None
+
+
+class SupervisedThread:
+    """Daemon thread that re-enters its target after an unexpected
+    exception — the resurrect-on-death wrapper for loop bodies whose
+    state lives OUTSIDE the thread (the serving dispatcher: queue,
+    counters and stop flag are all on the evaluator object, so the
+    loop can simply be entered again).
+
+    A normal return of ``target`` ends the thread (that is the stop
+    path). An exception is classified and counted; the thread backs
+    off (same deterministic schedule as :class:`Supervisor`) and
+    re-enters the target, until the crash-loop detector parks it —
+    then ``on_park`` (optional) runs so the owner can fail pending
+    work instead of hanging its clients, and the thread exits with
+    ``error`` set and ``parked`` True.
+    """
+
+    def __init__(self, target, name: str, *,
+                 policy: RestartPolicy | None = None, metrics=None,
+                 on_park=None):
+        self._target = target
+        self.name = name
+        self.policy = policy or RestartPolicy()
+        self._metrics = metrics
+        self._on_park = on_park
+        self.restarts = 0
+        self.parked = False
+        self.error: BaseException | None = None
+        self._deaths: list[float] = []
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True)
+
+    def start(self) -> "SupervisedThread":
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _emit(self, event: str, **fields) -> None:
+        if self._metrics is not None:
+            self._metrics.log(event, **fields)
+
+    def _run(self) -> None:
+        while True:
+            try:
+                self._target()
+                return                       # clean stop
+            except Exception as e:  # noqa: BLE001 — classified below
+                now = time.monotonic()
+                self._deaths.append(now)
+                self.error = e
+                reason = self.policy.classify(e)
+                if self.policy.crash_looping(self._deaths, now):
+                    self.parked = True
+                    registry.counter("supervisor_parked_total",
+                                     worker=self.name).inc()
+                    self._emit("worker_parked", worker=self.name,
+                               reason="crash_loop",
+                               deaths=len(self._deaths),
+                               error=f"{type(e).__name__}: {e}")
+                    if self._on_park is not None:
+                        self._on_park()
+                    return
+                self.restarts += 1
+                delay = self.policy.delay(self.restarts, key=self.name)
+                registry.counter("supervisor_restarts_total",
+                                 worker=self.name, reason=reason).inc()
+                self._emit("worker_restart", worker=self.name,
+                           reason=reason, restarts=self.restarts,
+                           delay_s=round(delay, 3),
+                           error=f"{type(e).__name__}: {e}")
+                time.sleep(delay)

@@ -30,7 +30,15 @@ Dispatch policy (docs/SERVING.md):
 A failed batch (injected fault at the ``serve.eval`` barrier, or a
 real device error) fails ONLY the requests in that batch — their
 futures carry the exception, the dispatcher loop survives, and every
-other session keeps being served (the soak test's core claim).
+other session keeps being served (the soak test's core claim). The
+dispatcher THREAD itself is a supervised unit
+(:class:`~rocalphago_tpu.runtime.supervisor.SupervisedThread`): an
+exception that escapes the per-batch handler — the ``serve.dispatch``
+barrier at the top of the loop is the chaos harness's kill point —
+re-enters the loop after a classified backoff (queue, counters and
+stop flag all live on the evaluator, so nothing is lost), and a
+crash LOOP parks the dispatcher and fails pending requests instead
+of hanging its sessions.
 
 Batch sizes default to ``1,8,32,128,256`` (clipped to the admission
 session cap); ``ROCALPHAGO_SERVE_BATCH_SIZES`` overrides with a
@@ -47,7 +55,7 @@ from collections import deque
 
 from rocalphago_tpu.analysis import lockcheck
 from rocalphago_tpu.obs import registry as obs_registry
-from rocalphago_tpu.runtime import faults
+from rocalphago_tpu.runtime import faults, supervisor
 
 MAX_WAIT_ENV = "ROCALPHAGO_SERVE_MAX_WAIT_US"
 BATCH_SIZES_ENV = "ROCALPHAGO_SERVE_BATCH_SIZES"
@@ -143,7 +151,8 @@ class BatchingEvaluator:
     def __init__(self, eval_fn, params_p, params_v,
                  batch_sizes=None, max_wait_us: float | None = None,
                  admission=None, start: bool = True,
-                 eval_komi_fn=None, default_komi: float = 0.0):
+                 eval_komi_fn=None, default_komi: float = 0.0,
+                 metrics=None, restart_policy=None):
         self._eval_fn = eval_fn
         self._eval_komi_fn = eval_komi_fn
         self.default_komi = float(default_komi)
@@ -176,8 +185,12 @@ class BatchingEvaluator:
         self._fail_c = obs_registry.counter(
             "serve_eval_failures_total")
         self._depth_g = obs_registry.gauge("serve_queue_depth")
-        self._thread = threading.Thread(
-            target=self._loop, name="serve-evaluator", daemon=True)
+        # resurrect-on-death: the loop's state is all on self, so
+        # re-entering it after an escaped exception loses nothing; a
+        # crash loop parks and fails the queue (no hanging clients)
+        self._thread = supervisor.SupervisedThread(
+            self._loop, name="serve:dispatcher", metrics=metrics,
+            policy=restart_policy, on_park=self._fail_pending)
         if start:
             self._thread.start()
 
@@ -246,6 +259,11 @@ class BatchingEvaluator:
 
     def _loop(self) -> None:
         while True:
+            # the dispatcher-kill point: OUTSIDE the per-batch try
+            # and before any request is popped, so an injected kill
+            # takes the THREAD down with the queue intact — the
+            # supervised restart serves the same requests
+            faults.barrier("serve.dispatch", iteration=self.batches)
             with self._cond:
                 while not self._queue and not self._stop:
                     self._cond.wait(0.1)
@@ -336,6 +354,19 @@ class BatchingEvaluator:
             req._finish((priors[offset:offset + req.rows],
                          values[offset:offset + req.rows]))
             offset += req.rows
+
+    def _fail_pending(self) -> None:
+        """Parked-dispatcher cleanup: fail everything queued so no
+        session blocks forever on a dead dispatcher."""
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._pending_rows = 0
+        err = self._thread.error
+        for req in leftovers:
+            req._fail(RuntimeError(
+                f"evaluator dispatcher parked"
+                f"{f' ({type(err).__name__}: {err})' if err else ''}"))
 
     # ------------------------------------------------------ lifecycle
 

@@ -23,7 +23,14 @@ Two pacing modes:
 Preemption tolerance: each game is wrapped in the PR-1 retry
 machinery (``runtime.retries``) — safe because ``play`` donates
 nothing the caller can see — and a non-transient failure parks the
-actor with ``error`` set instead of killing the process.
+actor with ``error`` set instead of killing the process. Under
+``runtime.supervisor`` the park becomes a death report: the
+supervisor resurrects free-run actors from the factory (fresh rng
+branch, in-flight game discarded) and REFUSES lockstep restarts
+(docs/RESILIENCE.md "Fleet supervision"). Each game boundary
+declares the ``actor.game`` fault barrier, and waits (params,
+paced put) are tagged ``actor:<name>`` in the watchdog's
+``waiting_on`` registry so stalls name the blocked fleet member.
 
 Metrics: ``actor_games_total{actor=}`` counter,
 ``actor_params_version`` gauge; each game runs under an
@@ -40,7 +47,7 @@ import jax
 
 from rocalphago_tpu.analysis import lockcheck
 from rocalphago_tpu.obs import registry, trace
-from rocalphago_tpu.runtime import retries
+from rocalphago_tpu.runtime import faults, retries, watchdog
 from rocalphago_tpu.training.zero import next_keys
 
 POLL_ENV = "ROCALPHAGO_ACTOR_POLL_S"
@@ -149,19 +156,22 @@ class SelfplayActor:
                  rng, *, name: str = "actor0", lockstep: bool = False,
                  start_index: int = 0, games: int | None = None,
                  pace: bool = True, poll_s: float | None = None,
-                 gang: DispatchGang | None = None, metrics=None):
+                 gang: DispatchGang | None = None, metrics=None,
+                 on_progress=None):
         self._play_fn = play_fn
         self._gang = gang
         self._publisher = publisher
         self._buffer = buffer
         self._rng = rng
         self.name = name
-        self._lockstep = lockstep
+        self.lockstep = lockstep
         self._start_index = start_index
         self._games = games
         self._pace = pace
         self._poll_s = default_poll_s() if poll_s is None else poll_s
         self._metrics = metrics
+        self._on_progress = on_progress   # supervisor heartbeat
+        self._inject: BaseException | None = None
         self.games_played = 0
         self.error: BaseException | None = None
         self._stop = threading.Event()
@@ -179,6 +189,18 @@ class SelfplayActor:
         if self._thread.is_alive():
             self._thread.join(timeout)
 
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def inject_fault(self, exc: BaseException | None = None) -> None:
+        """Arm a one-shot fault raised at this actor's next game
+        boundary (default :class:`~..runtime.faults.InjectedKill`) —
+        the deterministic per-actor kill the recovery bench
+        (``bench_zero_scale.py --kill-actor-at``) uses; randomized
+        schedules go through ``ROCALPHAGO_FAULT_PLAN`` instead."""
+        self._inject = exc if exc is not None else faults.InjectedKill(
+            f"injected kill of {self.name} (inject_fault)")
+
     # ------------------------------------------------------ producer
 
     def _run(self) -> None:
@@ -191,8 +213,9 @@ class SelfplayActor:
             # lockstep: game k is played by the version-k snapshot
             # (exactly the pair the synchronous loop would use);
             # free-run: whatever is freshest
-            need = index if self._lockstep else 0
-            got = self._publisher.wait_version(need, self._poll_s)
+            need = index if self.lockstep else 0
+            with watchdog.waiting_on(f"actor:{self.name}"):
+                got = self._publisher.wait_version(need, self._poll_s)
             if got is None:
                 continue
             version, pp, vp = got
@@ -211,6 +234,10 @@ class SelfplayActor:
                 return jax.device_get(games)
 
             try:
+                faults.barrier("actor.game", iteration=index)
+                if self._inject is not None:
+                    exc, self._inject = self._inject, None
+                    raise exc
                 with trace.span("actor.play", actor=self.name,
                                 game=index):
                     host = (self._gang.run(_play_synced)
@@ -223,13 +250,17 @@ class SelfplayActor:
                         error=f"{type(e).__name__}: {e}")
                 break
             while not self._stop.is_set():
-                if self._buffer.put(host, version=version,
-                                    block=self._pace,
-                                    timeout=self._poll_s):
+                with watchdog.waiting_on(f"actor:{self.name}"):
+                    accepted = self._buffer.put(
+                        host, version=version, block=self._pace,
+                        timeout=self._poll_s)
+                if accepted:
                     registry.counter("actor_games_total",
                                      actor=self.name).inc()
                     self.games_played += 1
                     index += 1
+                    if self._on_progress is not None:
+                        self._on_progress()
                     break
                 if self._buffer.closed:
                     self._stop.set()   # drain finished — park

@@ -29,7 +29,7 @@ import time
 import jax
 
 from rocalphago_tpu.obs import registry, trace
-from rocalphago_tpu.runtime import retries
+from rocalphago_tpu.runtime import faults, retries
 
 
 class ZeroLearner:
@@ -74,6 +74,12 @@ class ZeroLearner:
             self._wait_s += t1 - t0
             registry.gauge("learner_idle_frac").set(self.idle_frac)
             return None
+        # mid-step kill point: the batch is already TAKEN, so a kill
+        # here models the worst case the failover path must ride out
+        # (a consumed-but-unlearned entry; see docs/RESILIENCE.md
+        # "Fleet supervision" on why lockstep refuses the ride)
+        faults.barrier("learner.step", iteration=self.steps)
+
         def _learn_synced():
             new_state, m = retries.retry_call(
                 self._learn_fn, state, entry.games,

@@ -990,8 +990,10 @@ def run_training(argv=None) -> dict:
     # bit-identical to the synchronous path — the A/B the acceptance
     # test pins.
     rig = None
+    sup = None
     if a.actor_learner:
         from rocalphago_tpu.data.replay import ReplayBuffer
+        from rocalphago_tpu.runtime import supervisor as superv
         from rocalphago_tpu.training.actor import (
             DispatchGang,
             ParamsPublisher,
@@ -1004,51 +1006,122 @@ def run_training(argv=None) -> dict:
             capacity=a.replay_capacity,
             spill_dir=(os.path.join(a.out_dir, "replay")
                        if coord else None))
+        # spill left by a drained/killed predecessor: the lockstep
+        # actor replays its games bit-identically from the
+        # checkpointed rng chain, so restoring leftovers would
+        # double-insert them — discard; free-run has no replay to
+        # lean on, so it restores what survived
+        if coord:
+            n_spill = (buffer.discard_spill() if lockstep
+                       else buffer.restore())
+            if n_spill:
+                metrics.log("replay_spill_discarded" if lockstep
+                            else "replay_restored", entries=n_spill)
         publisher = ParamsPublisher()
         # one gang shared by every device-section owner: concurrent
         # play/learn SPMD programs over the same mesh can deadlock at
         # their collective rendezvous (training.actor.DispatchGang)
         gang = DispatchGang()
-        actors = []
+        sup = superv.Supervisor(metrics=metrics)
+        base_rng = state.rng
+
+        def _actor_factory(i):
+            def make(attempt, beat):
+                # free-run restarts branch a FRESH key per attempt —
+                # the in-flight game is discarded, never replayed;
+                # lockstep never reaches attempt > 0 (the handle is
+                # restartable=False)
+                if lockstep:
+                    rng = base_rng
+                else:
+                    key = jax.random.fold_in(unpack_rng(base_rng),
+                                             i + 1)
+                    if attempt:
+                        key = jax.random.fold_in(key, attempt)
+                    rng = pack_rng(key)
+                return SelfplayActor(
+                    iteration.play, publisher, buffer, rng,
+                    name=f"a{i}", lockstep=lockstep,
+                    start_index=start,
+                    games=((a.iterations - start) if lockstep
+                           else None),
+                    pace=not a.replay_sample, gang=gang,
+                    metrics=metrics, on_progress=beat)
+            return make
+
         for i in range(a.actors):
-            rng = state.rng if lockstep else pack_rng(
-                jax.random.fold_in(unpack_rng(state.rng), i + 1))
-            actors.append(SelfplayActor(
-                iteration.play, publisher, buffer, rng,
-                name=f"a{i}", lockstep=lockstep, start_index=start,
-                games=(a.iterations - start) if lockstep else None,
-                pace=not a.replay_sample, gang=gang, metrics=metrics))
+            sup.add(_actor_factory(i), name=f"actor:{i}",
+                    restartable=not lockstep)
         learner = ZeroLearner(iteration.learn, buffer, gang=gang,
                               sample=a.replay_sample, metrics=metrics)
         publisher.publish(
             best_p if best_p is not None else state.policy_params,
             best_v if best_v is not None else state.value_params,
             version=start)
-        for ac in actors:
-            ac.start()
-        rig = (buffer, publisher, actors, learner)
+        # SIGTERM (the preemption notice) → graceful drain: exit at
+        # the next iteration boundary with a committed checkpoint
+        sup.install_sigterm()
+        sup.start()
+        rig = (buffer, publisher, sup, learner)
         metrics.log("actor_learner", actors=a.actors,
                     lockstep=lockstep, capacity=buffer.capacity,
-                    sample=a.replay_sample)
+                    sample=a.replay_sample, supervised=True)
 
-    def _learner_iteration():
-        # finite waits so a dead actor surfaces as an error instead
+    def _learner_iteration(state, it):
+        # finite waits so a dead fleet surfaces as an error instead
         # of an indefinite hang (the watchdog would fire anyway, but
-        # with less to say)
+        # with less to say). A learner death FAILS OVER (free-run
+        # only): restore the last committed checkpoint and re-step
+        # until iteration it+1 is consumed again — the consumed-but-
+        # unlearned entry is simply re-learned from older state.
+        # Lockstep refuses the ride: its FIFO entries are gone once
+        # taken, so a failover could not replay them bit-identically.
+        fell_back = False
         while True:
-            out = learner.step(state, timeout=5.0)
-            if out is not None:
-                return out
-            err = next((ac.error for ac in actors if ac.error), None)
-            if err is not None:
-                raise RuntimeError(
-                    "self-play actor failed; learner starved") \
-                    from err
-            if buffer.closed:
-                raise RuntimeError("replay buffer closed mid-run")
+            try:
+                out = learner.step(state, timeout=5.0)
+            except Exception as e:
+                if lockstep:
+                    raise
+                restored2, _ = ckpt.restore(jax.device_get(state))
+                if restored2 is not None:
+                    state = meshlib.replicate(mesh,
+                                              ZeroState(*restored2))
+                step_now = int(state.iteration)
+                metrics.log("learner_failover",
+                            error=f"{type(e).__name__}: {e}",
+                            restored_step=step_now, target=it + 1)
+                obs_registry.counter(
+                    "supervisor_restarts_total", worker="learner",
+                    reason=("transient" if retries.is_transient(e)
+                            else "error")).inc()
+                fell_back = True
+                continue
+            if out is None:
+                parked = sup.parked()
+                if parked:
+                    raise RuntimeError(
+                        f"self-play worker {parked[0].name} parked; "
+                        "learner starved") from parked[0].error
+                if buffer.closed:
+                    raise RuntimeError("replay buffer closed mid-run")
+                continue
+            state, m, _ = out
+            if not fell_back or int(state.iteration) >= it + 1:
+                return state, m
 
+    drained = False
     try:
         for it in range(start, a.iterations):
+            if sup is not None and sup.draining:
+                # preemption drain: stop at the iteration boundary —
+                # everything up to `it` is complete and (below) gets
+                # committed, so a resumed run replays from exactly
+                # here, byte-identical to never having been drained
+                metrics.log("drain", phase="loop_exit", iteration=it,
+                            reason=sup.drain_reason)
+                drained = True
+                break
             with trace.span("zero.iteration", iteration=it):
                 faults.barrier("zero.pre_iteration", it)
                 t0 = time.time()
@@ -1063,7 +1136,7 @@ def run_training(argv=None) -> dict:
                 else:
                     # actors produced the games; learn + fetch only
                     # (the fetch inside learner.step is the sync)
-                    state, m, _ = _learner_iteration()
+                    state, m = _learner_iteration(state, it)
                 if watchdog is not None:
                     watchdog.beat()
                     last_done["state"] = jax.device_get(state)
@@ -1145,13 +1218,26 @@ def run_training(argv=None) -> dict:
     finally:
         if rig is not None:
             buffer.close()          # unblocks paced/waiting actors
-            for ac in actors:
-                ac.stop()
+            sup.stop()              # joins monitor, stops workers
             metrics.log(
                 "actor_learner_done",
                 learner_idle_frac=round(learner.idle_frac, 4),
                 learner_steps=learner.steps,
-                games_played=sum(ac.games_played for ac in actors))
+                restarts=sum(h.restarts for h in sup.handles()),
+                games_played=sum(
+                    h.worker.games_played for h in sup.handles()
+                    if h.worker is not None))
+    if drained:
+        # commit the drain point: the last completed iteration's
+        # state, saved through the normal checkpointer (no export —
+        # exports happen at save boundaries, which the resumed run
+        # reproduces identically). Exit 0 follows: a drain is a
+        # success, not a failure.
+        step_now = int(state.iteration)
+        if step_now != ckpt.latest_step():
+            ckpt.save(step_now, jax.device_get(state))
+        metrics.log("drain", phase="checkpoint", step=step_now,
+                    reason=sup.drain_reason)
     ckpt.wait()
     if watchdog is not None:
         watchdog.stop()

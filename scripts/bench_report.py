@@ -66,7 +66,11 @@ def load_records(path: str, date: str, platform: str | None):
                    # cap probability is its own row — the baseline
                    # (cap_p=1.0 or absent) and capped sides of the
                    # A/B must not collapse into one
-                   r.get("cap_p"))
+                   r.get("cap_p"),
+                   # recovery A/B axis (bench_zero_scale.py
+                   # --kill-actor-at): the killed-actor run and the
+                   # fault-free run are separate rows
+                   r.get("kill_at"))
             prev = latest.get(key)
             if prev is None or str(r.get("date")) >= str(prev.get("date")):
                 latest[key] = r
@@ -79,7 +83,7 @@ def load_records(path: str, date: str, platform: str | None):
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
                 "vs_baseline", "mfu", "host_gap_frac", "us_per_pos",
                 "sessions", "actors", "learner_idle_frac", "board",
-                "cap_p", "fullsearch_frac"}
+                "cap_p", "fullsearch_frac", "mttr_s"}
 
 
 def render_table(records) -> str:
@@ -107,11 +111,16 @@ def render_table(records) -> str:
     full-frac columns key the self-play economics A/B
     (``bench_selfplay.py --cap-ab``: games/min vs the probability a
     ply gets the full search budget; ``fullsearch_frac`` is the frac
-    the run actually drew — read the cap_p=1 row as the baseline)."""
+    the run actually drew — read the cap_p=1 row as the baseline).
+    The MTTR column renders ``mttr_s`` — the recovery A/B's
+    kill-to-first-post-restart-game time (``bench_zero_scale.py
+    --kill-actor-at``; ``kill_at`` stays in config and keys the
+    row)."""
     lines = ["| metric | value | unit | board | MFU | host gap "
              "| µs/pos | sessions | actors | learner idle "
-             "| cap p | full frac | config |",
-             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
+             "| cap p | full frac | MTTR | config |",
+             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+             "---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -136,10 +145,12 @@ def render_table(records) -> str:
         capp = "—" if capp in (None, "") else f"{float(capp):g}"
         ff = r.get("fullsearch_frac")
         ff = "—" if ff in (None, "") else f"{100.0 * float(ff):.1f}%"
+        mttr = r.get("mttr_s")
+        mttr = "—" if mttr in (None, "") else f"{float(mttr):g}s"
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
                      f" | {r.get('unit', '?')} | {board} | {u} | {gap}"
                      f" | {upp} | {sess} | {act} | {idle} | {capp}"
-                     f" | {ff} | {cfg} |")
+                     f" | {ff} | {mttr} | {cfg} |")
     return "\n".join(lines)
 
 

@@ -1,0 +1,353 @@
+"""Chaos soak: the supervised fleet under randomized fire.
+
+Runs the full actor/learner rig — free-running self-play actors, the
+sharded learner, and a :class:`~rocalphago_tpu.serve.evaluator.
+BatchingEvaluator` leg with its own request stream — under a
+probabilistic kill plan (``kill@…:p=`` specs, docs/RESILIENCE.md
+"Fault injection") and proves the supervision layer's headline
+claims (docs/RESILIENCE.md "Fleet supervision"):
+
+* the learner keeps making progress (``learner_steps_total`` is
+  monotonic and reaches the target) while actors, the learner step
+  itself, and the serving dispatcher are killed at random;
+* nothing wedges: the watchdog (logging mode) records ZERO stall
+  events over the whole soak;
+* nobody parks: every death is absorbed by a restart/failover, and
+  the lifecycle record (``worker_restart`` / ``worker_recovered`` /
+  ``learner_failover``) lands in ``metrics.jsonl``;
+* after the storm a fault-free GATE round runs clean — one learner
+  step and one served eval with finite outputs.
+
+Kill schedules are deterministic per seed at each barrier (the draw
+is a pure hash of seed/barrier/hit-count), but the interleaving of
+barrier hits across threads is not — so the harness asserts a
+MINIMUM kill count (``--min-kills``), not an exact schedule, and
+keeps soaking until both the step target and the kill floor are met
+(bounded by ``--deadline-s``).
+
+Tier-1 smoke: ``tests/test_fleet_chaos.py`` runs this with
+``--steps 3 --min-kills 2``; the @slow soak runs the default
+``--steps 12 --min-kills 6``.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py --out /tmp/soak
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default=None,
+                    help="run dir for metrics.jsonl + summary.json "
+                    "(default: a fresh temp dir)")
+    ap.add_argument("--board", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--actors", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=12,
+                    help="learner steps the soak must reach")
+    ap.add_argument("--sims", type=int, default=2)
+    ap.add_argument("--move-limit", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7,
+                    help="kill-schedule seed (per-barrier draws)")
+    ap.add_argument("--p-actor", type=float, default=0.3)
+    ap.add_argument("--p-learner", type=float, default=0.2)
+    ap.add_argument("--p-serve", type=float, default=0.3)
+    ap.add_argument("--plan", default=None,
+                    help="override the whole fault plan verbatim")
+    ap.add_argument("--min-kills", type=int, default=6,
+                    help="soak until at least this many injected "
+                    "kills landed across the fleet")
+    ap.add_argument("--deadline-s", type=float, default=300.0,
+                    help="hard wall-clock bound on the whole soak")
+    ap.add_argument("--serve-requests", type=int, default=40,
+                    help="eval requests the serving leg submits")
+    return ap
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+    out_dir = args.out or tempfile.mkdtemp(prefix="chaos_soak_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+    import optax
+
+    from rocalphago_tpu.data.replay import ReplayBuffer
+    from rocalphago_tpu.engine.jaxgo import GoConfig
+    from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
+    from rocalphago_tpu.io.metrics import MetricsLogger
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.obs import registry
+    from rocalphago_tpu.parallel import mesh as meshlib
+    from rocalphago_tpu.runtime import faults, watchdog
+    from rocalphago_tpu.runtime.supervisor import (
+        RestartPolicy,
+        Supervisor,
+    )
+    from rocalphago_tpu.serve.evaluator import BatchingEvaluator
+    from rocalphago_tpu.training.actor import (
+        DispatchGang,
+        ParamsPublisher,
+        SelfplayActor,
+    )
+    from rocalphago_tpu.training.learner import ZeroLearner
+    from rocalphago_tpu.training.zero import (
+        init_zero_state,
+        make_zero_iteration,
+    )
+
+    plan = args.plan if args.plan is not None else ",".join(
+        f"kill@{barrier}:p={p},seed={args.seed + i}"
+        for i, (barrier, p) in enumerate([
+            ("actor.game", args.p_actor),
+            ("learner.step", args.p_learner),
+            ("serve.dispatch", args.p_serve)])
+        if p > 0)
+    metrics = MetricsLogger(os.path.join(out_dir, "metrics.jsonl"),
+                            echo=False)
+    metrics.log("chaos_soak", phase="start", plan=plan,
+                steps=args.steps, actors=args.actors,
+                min_kills=args.min_kills, seed=args.seed)
+
+    # ------------------------------------------------- the tiny rig
+    feats = ("board", "ones")
+    vfeats = feats + ("color",)
+    pol = CNNPolicy(feats, board=args.board, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(vfeats, board=args.board, layers=1,
+                   filters_per_layer=2)
+    cfg = GoConfig(size=args.board)
+    n_dev = len(jax.devices())
+    while args.batch % n_dev:
+        n_dev -= 1
+    mesh = meshlib.make_mesh(n_dev)
+    iteration = make_zero_iteration(
+        cfg, feats, vfeats, pol.module.apply, val.module.apply,
+        optax.sgd(0.01), optax.sgd(0.01), batch=args.batch,
+        move_limit=args.move_limit, n_sim=args.sims, max_nodes=16,
+        sim_chunk=2, replay_chunk=4, mesh=mesh)
+    state0 = meshlib.replicate(mesh, init_zero_state(
+        pol.params, val.params, optax.sgd(0.01), optax.sgd(0.01),
+        seed=args.seed))
+
+    buf = ReplayBuffer(capacity=max(2 * args.actors, 4))
+    pub = ParamsPublisher()
+    gang = DispatchGang()
+    # quick restarts, no parks expected: the soak's kill rate is far
+    # below any honest crash-loop threshold at this window
+    policy = RestartPolicy(max_deaths=50, window_s=60.0,
+                           base_delay=0.05, max_delay=0.5,
+                           seed=args.seed)
+    sup = Supervisor(metrics=metrics, policy=policy, poll_s=0.05,
+                     heartbeat_s=60.0)
+    base_rng = state0.rng
+
+    def actor_factory(i):
+        def make(attempt, beat):
+            key = jax.random.fold_in(unpack_rng(base_rng), i + 1)
+            if attempt:
+                key = jax.random.fold_in(key, attempt)
+            return SelfplayActor(
+                iteration.play, pub, buf, pack_rng(key),
+                name=f"a{i}", lockstep=False, pace=False,
+                poll_s=0.1, gang=gang, metrics=metrics,
+                on_progress=beat)
+        return make
+
+    for i in range(args.actors):
+        sup.add(actor_factory(i), name=f"actor:{i}")
+    learner = ZeroLearner(iteration.learn, buf, sample=True,
+                          gang=gang, metrics=metrics)
+
+    # --------------------------------------------- the serving leg
+    # a pure-host eval program: the serving dispatcher's deaths and
+    # restarts are what the soak measures, not device throughput
+    def fake_eval(_pp, _vv, states):
+        b = states.shape[0]
+        return (np.full((b, args.board ** 2 + 1),
+                        1.0 / (args.board ** 2 + 1), np.float32),
+                np.zeros((b,), np.float32))
+
+    ev = BatchingEvaluator(fake_eval, None, None, batch_sizes=(2,),
+                           max_wait_us=100.0, metrics=metrics,
+                           restart_policy=policy)
+    serve_ok = [0]
+    serve_failed = [0]
+    serve_stop = threading.Event()
+
+    def submitter():
+        states = np.zeros((2, 4), np.float32)
+        for _ in range(args.serve_requests):
+            if serve_stop.is_set():
+                return
+            try:
+                priors, values = ev.evaluate(states, rows=2,
+                                             timeout=30.0)
+                assert np.isfinite(priors).all()
+                serve_ok[0] += 1
+            except Exception:  # noqa: BLE001 — counted, soak goes on
+                serve_failed[0] += 1
+            time.sleep(0.02)
+
+    sub_thread = threading.Thread(target=submitter,
+                                  name="soak-submitter", daemon=True)
+
+    def kill_count() -> int:
+        snap = registry.snapshot()["counters"]
+        return sum(v for k, v in snap.items()
+                   if k.startswith("supervisor_restarts_total"))
+
+    # --------------------------------------------------- the storm
+    faults.install(plan)
+    wd = watchdog.Watchdog(60.0, metrics=metrics, exit=False,
+                           name="soak").start()
+    pub.publish(state0.policy_params, state0.value_params, version=0)
+    sup.start()
+    sub_thread.start()
+
+    state = state0
+    learner_failovers = 0
+    steps_seen: list[int] = []
+    t0 = time.monotonic()
+    rc = 0
+    try:
+        while time.monotonic() - t0 < args.deadline_s:
+            done_steps = learner.steps >= args.steps
+            if done_steps and kill_count() >= args.min_kills:
+                break
+            if done_steps and not sub_thread.is_alive():
+                break           # kill floor unreachable: plan too mild
+            try:
+                out = learner.step(state, timeout=5.0)
+            except Exception as e:  # noqa: BLE001 — soak failover
+                learner_failovers += 1
+                metrics.log("learner_failover",
+                            error=f"{type(e).__name__}: {e}",
+                            restored_step=learner.steps,
+                            target=learner.steps + 1)
+                registry.counter(
+                    "supervisor_restarts_total", worker="learner",
+                    reason="transient").inc()
+                continue        # pre-step state is intact: re-step
+            if out is None:
+                if sup.parked():
+                    rc = 2
+                    break
+                continue
+            state, m, _ = out
+            pub.publish(state.policy_params, state.value_params,
+                        version=learner.steps)
+            steps_seen.append(learner.steps)
+            wd.beat()
+    finally:
+        serve_stop.set()
+        sub_thread.join(timeout=30.0)
+
+        # ------------------------------------------- the clean gate
+        faults.install("")
+        metrics.log("chaos_soak", phase="gate")
+        gate_ok = False
+        gate_loss = None
+        try:
+            out = None
+            gate_t0 = time.monotonic()
+            while out is None and time.monotonic() - gate_t0 < 60.0:
+                out = learner.step(state, timeout=5.0)
+            if out is not None:
+                _, m, _ = out
+                gate_loss = m.get("policy_loss")
+                priors, _ = ev.evaluate(
+                    np.zeros((2, 4), np.float32), rows=2,
+                    timeout=30.0)
+                gate_ok = (gate_loss is not None
+                           and np.isfinite(gate_loss)
+                           and np.isfinite(priors).all()
+                           and not ev._thread.parked)
+        except Exception as e:  # noqa: BLE001 — a red gate is a
+            #                     verdict, not a harness crash
+            metrics.log("chaos_soak", phase="gate_error",
+                        error=f"{type(e).__name__}: {e}")
+        finally:
+            buf.close()
+            sup.stop()
+            ev.close()
+            wd.stop()
+            faults.install(None)
+
+    # ------------------------------------------------- the verdict
+    kills = kill_count()
+    restarts = sum(h.restarts for h in sup.handles())
+    parked = [h.name for h in sup.parked()]
+    if ev._thread.parked:
+        parked.append(ev._thread.name)
+    mttrs = [h.last_mttr_s for h in sup.handles()
+             if h.last_mttr_s is not None]
+    stalls = sum(1 for line in open(metrics.path)
+                 if json.loads(line).get("event") == "stall")
+    events = {json.loads(line).get("event")
+              for line in open(metrics.path)}
+    monotonic_steps = all(b > a for a, b in
+                          zip(steps_seen, steps_seen[1:]))
+    summary = {
+        "plan": plan,
+        "learner_steps": learner.steps,
+        "monotonic": monotonic_steps,
+        "kills_total": kills,
+        "actor_restarts": restarts,
+        "dispatcher_restarts": ev._thread.restarts,
+        "learner_failovers": learner_failovers,
+        "parked": parked,
+        "serve_ok": serve_ok[0],
+        "serve_failed": serve_failed[0],
+        "mttr_mean_s": (round(sum(mttrs) / len(mttrs), 3)
+                        if mttrs else None),
+        "mttr_max_s": round(max(mttrs), 3) if mttrs else None,
+        "stall_events": stalls,
+        "gate_ok": gate_ok,
+        "gate_policy_loss": gate_loss,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    checks = {
+        "steps_reached": learner.steps >= args.steps,
+        "monotonic": monotonic_steps,
+        "min_kills": kills >= args.min_kills,
+        "no_parks": not parked,
+        "no_stalls": stalls == 0,
+        "gate_green": gate_ok,
+        "lifecycle_logged": ("worker_restart" in events
+                             or "learner_failover" in events),
+    }
+    summary["checks"] = checks
+    metrics.log("chaos_soak", phase="done", **{
+        k: v for k, v in summary.items() if k != "checks"})
+    metrics.close()
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2))
+    if rc == 0 and not all(checks.values()):
+        rc = 1
+    if rc:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"chaos_soak: FAILED checks: {failed}",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

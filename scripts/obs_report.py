@@ -266,6 +266,81 @@ def render_actor_learner(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(records, snap: dict) -> str:
+    """Fleet supervision health (runtime/supervisor.py;
+    docs/RESILIENCE.md "Fleet supervision"): restarts grouped by
+    worker and reason, parked workers, learner failovers, recovery
+    times (death detection → first post-restart heartbeat), and the
+    preemption-drain timeline — 'who died, who came back, how fast,
+    and did the drain land cleanly' in one block."""
+    restarts: dict = {}
+    parks, mttrs, failovers, drains = [], [], [], []
+    for r in records:
+        ev = r.get("event")
+        if ev == "worker_restart":
+            key = (str(r.get("worker", "?")),
+                   str(r.get("reason", "?")))
+            restarts[key] = restarts.get(key, 0) + 1
+        elif ev == "worker_parked":
+            parks.append(r)
+        elif ev == "worker_recovered":
+            if r.get("mttr_s") is not None:
+                mttrs.append(float(r["mttr_s"]))
+        elif ev == "learner_failover":
+            failovers.append(r)
+        elif ev == "drain":
+            drains.append(r)
+    if not (restarts or parks or failovers or drains):
+        # a copied log tail can keep the registry counters without
+        # the lifecycle events — summarize from the snapshot then
+        counters = {k: v for k, v in snap.get("counters", {}).items()
+                    if k.startswith("supervisor_")}
+        if counters:
+            return "\n".join(f"{k}={v}"
+                             for k, v in sorted(counters.items()))
+        return "(no fleet supervision records)"
+    lines = []
+    if restarts:
+        per_worker: dict = {}
+        for (w, reason), n in restarts.items():
+            per_worker.setdefault(w, {})[reason] = n
+        lines.append("restarts: " + "  ".join(
+            f"{w}={sum(d.values())} ("
+            + ", ".join(f"{k}={v}" for k, v in sorted(d.items()))
+            + ")" for w, d in sorted(per_worker.items())))
+    if parks:
+        lines.append("parked: " + "  ".join(
+            f"{p.get('worker', '?')} ({p.get('reason', '?')} after "
+            f"{p.get('deaths', '?')} deaths)" for p in parks))
+    if failovers:
+        last = failovers[-1]
+        lines.append(
+            f"learner failovers: {len(failovers)} (last restored "
+            f"step {last.get('restored_step', '?')}, target "
+            f"{last.get('target', '?')})")
+    if mttrs:
+        lines.append(
+            f"recovery: mean {sum(mttrs) / len(mttrs):.3f}s, max "
+            f"{max(mttrs):.3f}s over {len(mttrs)} restarts")
+    if drains:
+        t0 = drains[0].get("time")
+        steps = []
+        for d in drains:
+            label = str(d.get("phase", "?"))
+            if d is drains[0] and d.get("reason"):
+                label += f" ({d['reason']})"
+            if d.get("iteration") is not None:
+                label += f" @ iter {d['iteration']}"
+            if d.get("step") is not None:
+                label += f" @ step {d['step']}"
+            t = d.get("time")
+            if d is not drains[0] and t0 is not None and t is not None:
+                label += f" +{float(t) - float(t0):.1f}s"
+            steps.append(label)
+        lines.append("drain: " + " → ".join(steps))
+    return "\n".join(lines)
+
+
 def _aux_trend(records) -> dict:
     """``head -> (first, last)`` aux-loss gauge values across the
     run's registry snapshots (gauges only keep the latest value, so
@@ -393,6 +468,8 @@ def report(records, top: int | None = None) -> str:
              render_dispatch(reg or {}), "",
              "## actor/learner (replay ingest / learner idle)", "",
              render_actor_learner(reg or {}), "",
+             "## fleet health (restarts / parks / MTTR / drain)", "",
+             render_fleet(records, reg or {}), "",
              "## self-play economics (cap split / sims saved / aux)",
              "", render_selfplay_econ(records, reg or {}), "",
              "## curriculum (per-stage ladder / transfer verdict)", "",
@@ -433,6 +510,31 @@ FIXTURE = [
     {"event": "curriculum_transfer", "board": 13, "games": 32,
      "transfer": True, "wilson_lb": 0.6241, "wins_a": 26,
      "wins_b": 6, "draws": 0, "win_rate_a": 0.8125},
+    # fleet supervision lifecycle (runtime/supervisor.py): a
+    # transient actor death that recovers, a dispatcher restart, a
+    # crash-looping actor that parks, one learner failover, and a
+    # SIGTERM drain landing at an iteration boundary
+    {"event": "worker_restart", "worker": "actor:1",
+     "reason": "transient", "restarts": 1, "delay_s": 0.25,
+     "error": "InjectedFault: actor.game", "time": 100.0},
+    {"event": "worker_recovered", "worker": "actor:1", "restarts": 1,
+     "mttr_s": 2.4, "time": 102.4},
+    {"event": "worker_restart", "worker": "serve:dispatcher",
+     "reason": "error", "restarts": 1, "delay_s": 0.5,
+     "error": "InjectedKill: serve.dispatch", "time": 103.0},
+    {"event": "worker_recovered", "worker": "serve:dispatcher",
+     "restarts": 1, "mttr_s": 0.8, "time": 103.8},
+    {"event": "worker_parked", "worker": "actor:2",
+     "reason": "crash_loop", "deaths": 3,
+     "error": "InjectedKill: actor.game", "time": 104.0},
+    {"event": "learner_failover", "restored_step": 5, "target": 6,
+     "error": "InjectedKill: learner.step", "time": 105.0},
+    {"event": "drain", "phase": "requested", "reason": "sigterm",
+     "time": 110.0},
+    {"event": "drain", "phase": "loop_exit", "iteration": 2,
+     "reason": "sigterm", "time": 110.1},
+    {"event": "drain", "phase": "checkpoint", "step": 2,
+     "reason": "sigterm", "time": 110.9},
     # an EARLY snapshot (iteration 0): only its aux_loss gauges matter
     # — the econ section walks every snapshot to render the trend;
     # every other section reads the last snapshot only
@@ -504,6 +606,14 @@ def selftest() -> int:
               "learner: 7 steps, idle 12.0%",
               "staleness: p50≲0.5 p99≲2.5 (7 consumed)",
               "a0=16", "a1=16",
+              "fleet health (restarts / parks / MTTR / drain)",
+              "restarts: actor:1=1 (transient=1)  "
+              "serve:dispatcher=1 (error=1)",
+              "parked: actor:2 (crash_loop after 3 deaths)",
+              "learner failovers: 1 (last restored step 5, target 6)",
+              "recovery: mean 1.600s, max 2.400s over 2 restarts",
+              "drain: requested (sigterm) → loop_exit @ iter 2 "
+              "+0.1s → checkpoint @ step 2 +0.9s",
               "self-play economics (cap split / sims saved / aux)",
               "searches: 25.0% full / 75.0% cheap",
               "sims: mean 14.0/move over 64 moves, "

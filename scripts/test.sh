@@ -119,6 +119,19 @@ cd "$(dirname "$0")/.."
 # curriculum.stage spans in metrics.jsonl). The real 2-stage
 # curriculum run (two trainer invocations + transfer gate) is @slow.
 #
+# Fleet supervision (runtime/supervisor.py; docs/RESILIENCE.md
+# "Fleet supervision"): tests/test_fleet_chaos.py is tier-1 — the
+# probabilistic fault grammar (kill/:p=/:seed=/random, deterministic
+# schedules), supervisor units (restart+MTTR, crash-loop park,
+# lockstep restart REFUSAL, drain semantics, stale-heartbeat tags
+# reaching the watchdog's waiting_on), dispatcher resurrection and
+# park-fails-pending, the lockstep-kill-parks-loudly subprocess
+# proof, the SIGTERM drain → exact-resume bit-identity pin, and the
+# chaos-soak SMOKE (scripts/chaos_soak.py --steps 3 --min-kills 2:
+# randomized kills across actor/learner/serve barriers with the
+# green-gate check, ~40 s). The full soak (12 learner steps, ≥6
+# kills, defaults) is @slow and runs with --all.
+#
 # Concurrency proofing (runtime half): tests/test_lockcheck.py
 # units the ROCALPHAGO_LOCKCHECK=1 instrumented locks (observed
 # lock-order graph, cycle raise, held-sets, blocking-while-held,

@@ -225,6 +225,49 @@ def test_jsonl_ingester_tolerates_torn_tail(tmp_path):
     assert buf.next_batch(timeout=1.0).version == 3
 
 
+def test_jsonl_ingester_tolerates_shard_rotation(tmp_path):
+    """ISSUE 14: a restarted actor may recreate its shard from
+    scratch (preemption took the old file, or logrotate truncated
+    it). The stored offset then points past EOF — the ingester must
+    re-read from the top of the new incarnation instead of seeking
+    into the void and ingesting nothing forever."""
+    shard = str(tmp_path / "actor0.jsonl")
+    append_jsonl_record(shard, make_games(0), version=1)
+    append_jsonl_record(shard, make_games(1), version=2)
+    buf = ReplayBuffer(capacity=8)
+    ing = JsonlIngester(buf, str(tmp_path))
+    assert ing.poll() == 2
+    assert ing.shard_rotated == 0
+    # the actor's replacement truncates and starts a fresh stream
+    os.unlink(shard)
+    append_jsonl_record(shard, make_games(7), version=9)
+    assert ing.poll() == 1
+    assert ing.shard_rotated == 1
+    for want in (1, 2, 9):
+        assert buf.next_batch(timeout=1.0).version == want
+    # subsequent appends resume normal incremental tailing
+    append_jsonl_record(shard, make_games(8), version=10)
+    assert ing.poll() == 1
+    assert ing.shard_rotated == 1
+
+
+def test_discard_spill_clears_disk_without_reinserting(tmp_path):
+    """The lockstep drain-resume path: the resumed actor replays the
+    identical games from the checkpointed rng, so restoring the spill
+    would double-insert them — ``discard_spill`` removes the files
+    and a later ``restore`` finds nothing."""
+    spill = str(tmp_path / "spill")
+    buf = ReplayBuffer(capacity=4, spill_dir=spill)
+    buf.put(make_games(0), version=1, block=False)
+    buf.put(make_games(1), version=2, block=False)
+    assert len(os.listdir(spill)) == 2
+    buf2 = ReplayBuffer(capacity=4, spill_dir=spill)
+    assert buf2.discard_spill() == 2
+    assert os.listdir(spill) == []
+    assert buf2.restore() == 0
+    assert buf2.fill == 0
+
+
 # ------------------------------------------- publisher + actor
 
 
