@@ -1,0 +1,194 @@
+"""Gateway wire tax: moves/sec over the socket vs in-process.
+
+The acceptance bench for ``rocalphago_tpu/gateway`` (docs/GATEWAY.md):
+N concurrent game sessions served two ways over ONE warmed
+:class:`~rocalphago_tpu.serve.sessions.ServePool` —
+
+* **direct** — the pre-gateway baseline: each session is driven
+  in-process (thread per session, ladder-wrapped ``get_move`` on a
+  local ``GameState``), exactly what ``bench_serve.py``'s threaded
+  arm measures;
+* **gateway** — the same traffic through the full network stack:
+  :class:`~rocalphago_tpu.gateway.server.GatewayServer` on localhost,
+  :func:`~rocalphago_tpu.gateway.client.run_load` driving one NDJSON
+  connection per session (frame encode/decode, socket hops, the
+  per-request fault barrier and SLO arming all included).
+
+Per (conns, mode) config one record goes to ``results.jsonl``:
+aggregate ``moves/s`` (value) plus p50/p99 per-genmove latency; a
+``gateway_wire_tax`` record carries the gateway/direct rate ratio —
+the acceptance gate is ratio ≥ 0.8 at 16 connections (wire tax at
+most 20%).
+
+Usage::
+
+    python benchmarks/bench_gateway.py [--conns 1,4,16] [--board 9]
+        [--layers 6] [--filters 96] [--sims 8] [--moves 4] [--reps 3]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmarks._harness import report, std_parser  # noqa: E402
+
+
+def _percentile(sorted_vals, q):
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _run_threads(n, fn):
+    """Run ``fn(i)`` in n threads behind one start barrier; returns
+    (wall seconds, list of per-call exceptions)."""
+    ready = threading.Barrier(n + 1)
+    errors: list = []
+
+    def work(i):
+        try:
+            ready.wait()
+            fn(i)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    ready.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t0, errors
+
+
+def main():
+    ap = std_parser("gateway wire tax: socket vs in-process serving "
+                    "(direct/gateway A/B)")
+    ap.add_argument("--conns", default="1,4,16",
+                    help="comma list of concurrent-connection counts "
+                         "(= sessions on the direct side)")
+    ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--filters", type=int, default=96)
+    ap.add_argument("--sims", type=int, default=8,
+                    help="simulations per move")
+    ap.add_argument("--moves", type=int, default=4,
+                    help="genmoves per connection per rep")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="per-genmove SLO the gateway arms (default "
+                         "off: pure throughput A/B)")
+    ap.set_defaults(board=9)   # serving default, like bench_serve
+    a = ap.parse_args()
+
+    from rocalphago_tpu.engine import pygo
+    from rocalphago_tpu.gateway.client import run_load
+    from rocalphago_tpu.gateway.server import GatewayServer
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.serve.evaluator import default_batch_sizes
+    from rocalphago_tpu.serve.sessions import ServePool
+
+    conn_counts = [int(s) for s in a.conns.split(",") if s]
+    pol = CNNPolicy(("board", "ones"), board=a.board,
+                    layers=a.layers, filters_per_layer=a.filters)
+    val = CNNValue(("board", "ones", "color"), board=a.board,
+                   layers=a.layers, filters_per_layer=a.filters)
+
+    common = dict(board=a.board, layers=a.layers, filters=a.filters,
+                  sims=a.sims, moves=a.moves)
+
+    for n_conns in conn_counts:
+        sizes = default_batch_sizes(cap=n_conns)
+        pool = ServePool(val, pol, n_sim=a.sims,
+                         max_sessions=n_conns,
+                         queue_rows=4 * max(sizes),
+                         batch_sizes=sizes)
+        pool.warm()
+
+        # ---- direct: in-process threaded sessions, the baseline the
+        # wire tax is measured against (ladder-wrapped like the
+        # gateway's sessions, so the A/B isolates ONLY the wire)
+        best = None
+        for _ in range(a.reps):
+            sessions = [pool.open_session() for _ in range(n_conns)]
+            games = [pygo.GameState(size=a.board, komi=7.5)
+                     for _ in range(n_conns)]
+            lats: list = []
+            lat_lock = threading.Lock()
+
+            def play(i):
+                game = games[i]
+                for _ in range(a.moves):
+                    t0 = time.monotonic()
+                    mv = sessions[i].get_move(game)
+                    dt = time.monotonic() - t0
+                    with lat_lock:
+                        lats.append(dt)
+                    game.do_move(mv)
+
+            wall, errors = _run_threads(n_conns, play)
+            for s in sessions:
+                s.close()
+            if errors:
+                raise errors[0]
+            rate = n_conns * a.moves / wall
+            if best is None or rate > best[0]:
+                best = (rate, sorted(lats))
+        direct_rate, lats = best
+        report("gateway_moves_per_s", direct_rate, "moves/s",
+               conns=n_conns, mode="direct",
+               p50_s=round(_percentile(lats, 0.50), 4),
+               p99_s=round(_percentile(lats, 0.99), 4), **common)
+
+        # ---- gateway: identical traffic through the localhost
+        # socket server (one NDJSON connection per session)
+        server = GatewayServer(pool, max_conns=n_conns,
+                               slo_ms=a.slo_ms).start()
+
+        def settled():
+            # a closed client releases its slot at the handler's NEXT
+            # read; back-to-back reps must not race that or rep N+1
+            # sheds against rep N's still-draining connections
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if server.stats()["conns"]["live"] == 0:
+                    return
+                time.sleep(0.01)
+            raise RuntimeError("gateway connections did not settle")
+
+        best = None
+        for _ in range(a.reps):
+            settled()
+            out = run_load("127.0.0.1", server.port,
+                           conns=n_conns, moves=a.moves)
+            if out["sheds"] or out["disconnects"] or out["errors"]:
+                raise RuntimeError(
+                    f"gateway load not clean at {n_conns} conns: "
+                    f"{out['sheds']} sheds, "
+                    f"{out['disconnects']} disconnects, "
+                    f"{out['errors']} errors")
+            rate = out["moves"] / out["elapsed_s"]
+            if best is None or rate > best[0]:
+                best = (rate, sorted(out["latencies_s"]))
+        server.drain(reason="bench")
+        gateway_rate, lats = best
+        report("gateway_moves_per_s", gateway_rate, "moves/s",
+               conns=n_conns, mode="gateway",
+               p50_s=round(_percentile(lats, 0.50), 4),
+               p99_s=round(_percentile(lats, 0.99), 4), **common)
+
+        # the acceptance number: gateway throughput as a fraction of
+        # direct (≥ 0.8 at 16 conns = wire tax within 20%)
+        report("gateway_wire_tax", gateway_rate / direct_rate, "x",
+               conns=n_conns, **common)
+        pool.close()
+
+
+if __name__ == "__main__":
+    main()

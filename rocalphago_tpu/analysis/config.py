@@ -38,10 +38,14 @@ class LintConfig:
     docs_resilience: str = "docs/RESILIENCE.md"
     docs_knobs: str = "docs/KNOBS.md"
     docs_serving: str = "docs/SERVING.md"
+    docs_gateway: str = "docs/GATEWAY.md"
     report_modules: tuple = ("scripts/obs_report.py",)
     #: module whose ``ServePool.stats`` dict is the serve-probe
     #: block producer (diffed against docs_serving's JSON schema)
     serve_probe_module: str = "rocalphago_tpu/serve/sessions.py"
+    #: module whose ``GatewayServer.stats`` dict is the gateway-probe
+    #: block producer (diffed against docs_gateway's JSON schema)
+    gateway_probe_module: str = "rocalphago_tpu/gateway/server.py"
 
 
 _KEY_MAP = {
@@ -51,8 +55,10 @@ _KEY_MAP = {
     "docs.resilience": "docs_resilience",
     "docs.knobs": "docs_knobs",
     "docs.serving": "docs_serving",
+    "docs.gateway": "docs_gateway",
     "report_modules": "report_modules",
     "serve_probe_module": "serve_probe_module",
+    "gateway_probe_module": "gateway_probe_module",
 }
 
 

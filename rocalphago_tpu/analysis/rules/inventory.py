@@ -578,6 +578,97 @@ def serve_probe_drift(ctx):
     return findings
 
 
+@project_rule(
+    "gateway-probe-drift",
+    "the documented gateway health-probe block schema vs the fields "
+    "GatewayServer.stats actually emits")
+def gateway_probe_drift(ctx):
+    """The ``"gateway"`` block in the gateway's ``/healthz`` is the
+    LB health-check schema for the network front end
+    (docs/GATEWAY.md's fenced JSON example). Its producer is the dict
+    literal ``GatewayServer.stats`` returns
+    (``config.gateway_probe_module``); same both-direction diff as
+    ``serve-probe-drift``."""
+    import json as _json
+
+    doc = ctx.read_doc(ctx.config.docs_gateway)
+    if doc is None:
+        return []
+
+    def flatten_json(d, prefix=""):
+        out = set()
+        for k, v in d.items():
+            out.add(prefix + k)
+            if isinstance(v, dict):
+                out |= flatten_json(v, prefix + k + ".")
+        return out
+
+    documented = None
+    for block in re.findall(r"```json\s*\n(.*?)```", doc, re.S):
+        if '"gateway"' not in block:
+            continue
+        try:
+            data = _json.loads(block)
+        except ValueError:
+            continue
+        gateway = data.get("gateway")
+        if isinstance(gateway, dict):
+            documented = flatten_json(gateway)
+            break
+    if documented is None:
+        return []
+
+    def flatten_dict_node(node, prefix=""):
+        out = {}
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                path = prefix + k.value
+                out[path] = k.lineno
+                if isinstance(v, ast.Dict):
+                    out.update(flatten_dict_node(v, path + "."))
+        return out
+
+    produced = None
+    mod = next((m for m in ctx.modules
+                if m.rel == ctx.config.gateway_probe_module), None)
+    if mod is not None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == "GatewayServer":
+                for fn in node.body:
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) \
+                            and fn.name == "stats":
+                        for sub in ast.walk(fn):
+                            if isinstance(sub, ast.Return) \
+                                    and isinstance(sub.value, ast.Dict):
+                                produced = flatten_dict_node(sub.value)
+    if produced is None:
+        return []
+
+    findings = []
+    for key, line in sorted(produced.items()):
+        if key not in documented:
+            findings.append(Finding(
+                path=mod.rel, line=line, rule="gateway-probe-drift",
+                message=f"gateway-probe field '{key}' is emitted by "
+                        f"GatewayServer.stats but missing from the "
+                        f"schema in {ctx.config.docs_gateway} — load "
+                        "balancers key on that block; document it",
+                snippet=f"probe:{key}"))
+    for key in sorted(documented - set(produced)):
+        findings.append(Finding(
+            path=ctx.config.docs_gateway,
+            line=_doc_line_of(doc, key.rsplit(".", 1)[-1]),
+            rule="gateway-probe-drift",
+            message=f"documented gateway-probe field '{key}' is "
+                    "emitted by no code path — an LB health check "
+                    "reading it sees nothing; update the schema or "
+                    "restore the field",
+            snippet=f"doc-probe:{key}"))
+    return findings
+
+
 # --------------------------------------------------- KNOBS.md generator
 
 KNOBS_HEADER = """\

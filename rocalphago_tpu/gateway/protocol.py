@@ -1,0 +1,135 @@
+"""The gateway wire protocol: newline-delimited JSON, versioned.
+
+One frame = one JSON object on one line (NDJSON). The server speaks
+first (a ``hello`` frame carrying ``proto`` and the active board
+sizes — or a structured refusal when the gateway sheds the
+connection); after that the client drives request/response pairs
+correlated by ``id``:
+
+=============  =======================================================
+request        response
+=============  =======================================================
+``hello``      ``ok`` (optional; pins the protocol version — a
+               mismatch is ``bad_proto``)
+``new_game``   ``ok`` with the admitted ``board``/``komi`` (errors:
+               ``bad_board``, ``overload`` + ``retry_after_s``)
+``play``       ``ok`` (error ``illegal_move`` leaves the game
+               untouched)
+``genmove``    ``move`` with the vertex, elapsed wall time and the
+               resilience rung that produced it
+``komi``       ``ok`` (re-threads the live session's komi)
+``close``      ``ok`` (ends the game, releases the session slot; the
+               connection may open another game)
+=============  =======================================================
+
+Typed error codes (``{"type": "error", "code": …}``) are the
+protocol's refusal surface — a shed NEVER looks like a hang:
+``overload`` and ``draining`` carry ``retry_after_s`` so clients and
+load balancers back off instead of spinning. Frames are bounded at
+``ROCALPHAGO_GATEWAY_MAX_FRAME`` bytes; an oversized line is refused
+with ``frame_too_big`` and the connection is dropped (the reader
+cannot resynchronize mid-line). A torn frame (EOF before the
+newline) is a disconnect, not an error.
+
+Schema and examples: docs/GATEWAY.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: protocol revision carried in every hello; bumped on any frame
+#: schema change a deployed client could observe
+PROTO_VERSION = 1
+
+#: bound on one wire frame (bytes, newline included); env override
+MAX_FRAME_ENV = "ROCALPHAGO_GATEWAY_MAX_FRAME"
+
+#: every error code a frame may carry (docs/GATEWAY.md)
+ERROR_CODES = (
+    "bad_request",     # unparseable JSON / missing required field
+    "bad_proto",       # client hello pinned an unsupported version
+    "frame_too_big",   # line crossed the frame bound; connection drops
+    "unknown_type",    # message type outside the protocol table
+    "bad_board",       # requested size not served by this pool
+    "illegal_move",    # play refused; game state untouched
+    "no_game",         # play/genmove/komi/close before new_game
+    "game_over",       # move requested after the game ended
+    "overload",        # shed (admission/conn cap); retry_after_s set
+    "draining",        # server is drain-stopping; retry_after_s set
+    "internal",        # handler fault; this request failed, game holds
+)
+
+
+def max_frame_bytes() -> int:
+    raw = os.environ.get(MAX_FRAME_ENV, "")
+    return int(raw) if raw else 65536
+
+
+class ProtocolError(Exception):
+    """A frame the reader cannot accept; ``code`` names why and
+    ``fatal`` says whether the connection can survive it (a torn
+    byte stream cannot — the next line boundary is unknowable)."""
+
+    def __init__(self, code: str, msg: str, fatal: bool = False):
+        super().__init__(msg)
+        self.code = code
+        self.fatal = fatal
+
+
+def encode_frame(msg: dict) -> bytes:
+    """One dict → one NDJSON line (sorted keys: byte-stable frames
+    make wire-level tests and captures diffable)."""
+    return (json.dumps(msg, sort_keys=True) + "\n").encode("utf-8")
+
+
+def read_frame(reader, limit: int | None = None):
+    """Next frame off a buffered binary reader.
+
+    Returns the decoded dict, or None on a clean EOF / torn trailing
+    line (both are disconnects). Raises :class:`ProtocolError` for
+    an oversized line (fatal) or undecodable JSON (non-fatal: the
+    line boundary survived, the connection can report and go on).
+    """
+    limit = max_frame_bytes() if limit is None else limit
+    line = reader.readline(limit + 1)
+    if not line:
+        return None
+    if not line.endswith(b"\n"):
+        if len(line) > limit:
+            raise ProtocolError(
+                "frame_too_big",
+                f"frame exceeds {limit} bytes", fatal=True)
+        return None                       # torn frame at EOF
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        msg = json.loads(line.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ProtocolError("bad_request", f"undecodable frame: {e}")
+    if not isinstance(msg, dict):
+        raise ProtocolError("bad_request",
+                            "frame must be a JSON object")
+    return msg
+
+
+def error_frame(code: str, msg: str, id=None,
+                retry_after_s: float | None = None) -> dict:
+    assert code in ERROR_CODES, code
+    out = {"type": "error", "code": code, "msg": msg}
+    if id is not None:
+        out["id"] = id
+    if retry_after_s is not None:
+        out["retry_after_s"] = round(float(retry_after_s), 3)
+    return out
+
+
+def hello_frame(boards, default_board: int,
+                slo_ms: float | None) -> dict:
+    return {"type": "hello", "proto": PROTO_VERSION,
+            "name": "rocalphago-gateway",
+            "boards": [int(b) for b in boards],
+            "default_board": int(default_board),
+            "slo_ms": slo_ms}

@@ -1,0 +1,671 @@
+"""The gateway socket server: one connection ↔ one pool session.
+
+A threaded stdlib server over a :class:`~rocalphago_tpu.serve.
+sessions.ServePool` (or :class:`~rocalphago_tpu.multisize.pool.
+MultiSizePool` — ``new_game``'s ``board`` then routes to the member
+pool of that size). Each accepted connection gets a handler thread, a
+ladder-wrapped session (admission-controlled by the pool), and its
+own server-side :class:`~rocalphago_tpu.engine.pygo.GameState`; the
+wire stays NDJSON (:mod:`~rocalphago_tpu.gateway.protocol`).
+
+Load shedding is STRUCTURED, never a hang: past ``max_conns`` the
+accept loop answers with an ``overload`` error frame (carrying
+``retry_after_s``) and closes; a pool at its session cap turns
+``new_game`` into the same refusal. Every shed is counted
+(``gateway_connections_total{result=}``, ``gateway_errors_total
+{code=}``) so ``/metrics`` sees pressure before clients do.
+
+Per-request SLO: ``slo_ms`` (or ``ROCALPHAGO_GATEWAY_SLO_MS``) arms a
+:class:`~rocalphago_tpu.runtime.deadline.Deadline` per genmove — the
+session's anytime search answers inside it, and the reply reports
+whether the deadline fired.
+
+Faults: the handler runs each request behind the ``gateway.conn``
+barrier (docs/RESILIENCE.md) — an injected transient fails THAT
+request with a typed ``internal`` error, an injected kill aborts the
+connection; either way the session is closed, the admission slot
+released, and nothing escapes the handler (the ``serve.dispatch``
+-style fault wall; ``requests.unhandled`` in the probe counts any
+escape, and the soak green-gates on zero).
+
+Drain (docs/GATEWAY.md "Drain semantics"): :meth:`GatewayServer.
+drain` — or SIGTERM via the supervisor in :func:`main` — stops the
+accept loop, lets in-flight moves finish, nudges idle connections
+with a read-side shutdown (their handlers say goodbye and close
+their sessions), joins every handler within ``drain_s``, and leaves
+the process free to exit 0.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from rocalphago_tpu.analysis import lockcheck
+from rocalphago_tpu.engine import pygo
+from rocalphago_tpu.gateway import protocol
+from rocalphago_tpu.interface.gtp import (
+    move_to_vertex,
+    parse_color,
+    vertex_to_move,
+)
+from rocalphago_tpu.interface.resilient import percentile
+from rocalphago_tpu.obs import registry as obs_registry
+from rocalphago_tpu.runtime import faults
+from rocalphago_tpu.runtime.deadline import Deadline
+from rocalphago_tpu.serve.admission import AdmissionError
+
+#: cap on concurrently served connections (env override)
+MAX_CONNS_ENV = "ROCALPHAGO_GATEWAY_MAX_CONNS"
+#: per-genmove SLO in milliseconds ('' = off; env override)
+SLO_ENV = "ROCALPHAGO_GATEWAY_SLO_MS"
+#: drain grace: seconds in-flight handlers get to finish
+DRAIN_ENV = "ROCALPHAGO_GATEWAY_DRAIN_S"
+
+#: retry hint a shed/refused client receives (seconds)
+RETRY_AFTER_S = 1.0
+
+#: wire-latency samples kept for the probe's p50/p99
+_LAT_KEEP = 512
+
+
+def _env_float(name: str, default):
+    raw = os.environ.get(name, "")
+    return float(raw) if raw else default
+
+
+class _Game:
+    """One live game on one connection: the pool session plus the
+    server-side rules state the session's player searches from."""
+
+    def __init__(self, session, board: int, komi: float):
+        self.session = session
+        self.board = board
+        self.state = pygo.GameState(size=board, komi=komi)
+
+
+class GatewayServer:
+    """Threaded NDJSON front end over a serve pool (module docstring).
+
+    Parameters: ``pool`` (ServePool or MultiSizePool), ``host``/
+    ``port`` (0 = ephemeral), ``max_conns`` / ``slo_ms`` / ``drain_s``
+    (default from their env knobs), ``metrics`` (drain-phase events
+    land there for obs_report's gateway timeline).
+    """
+
+    def __init__(self, pool, host: str = "127.0.0.1", port: int = 0,
+                 max_conns: int | None = None,
+                 slo_ms: float | None = None,
+                 drain_s: float | None = None, metrics=None):
+        self.pool = pool
+        self.host = host
+        self._port_arg = int(port)
+        self.metrics = metrics
+        self.max_conns = (int(_env_float(MAX_CONNS_ENV, 64))
+                          if max_conns is None else int(max_conns))
+        self.slo_ms = (_env_float(SLO_ENV, None)
+                       if slo_ms is None else float(slo_ms))
+        self.drain_s = (_env_float(DRAIN_ENV, 10.0)
+                        if drain_s is None else float(drain_s))
+        self._max_frame = protocol.max_frame_bytes()
+        self._lock = lockcheck.make_lock("GatewayServer._lock")
+        self._conns: dict = {}       # guarded-by: self._lock
+        self._live = 0               # guarded-by: self._lock
+        self._next_cid = 0           # guarded-by: self._lock
+        self._accepted = 0           # guarded-by: self._lock
+        self._shed = 0               # guarded-by: self._lock
+        self._requests = 0           # guarded-by: self._lock
+        self._errors = 0             # guarded-by: self._lock
+        self._genmoves = 0           # guarded-by: self._lock
+        self._unhandled = 0          # guarded-by: self._lock
+        self._faults = 0             # guarded-by: self._lock
+        self._kills = 0              # guarded-by: self._lock
+        self._draining = False       # guarded-by: self._lock
+        self._lat: list = []         # guarded-by: self._lock
+        self._sock: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._closed = False
+        self._live_g = obs_registry.gauge("gateway_conns_live")
+        self._acc_c = obs_registry.counter("gateway_connections_total",
+                                           result="accepted")
+        self._shed_c = obs_registry.counter("gateway_connections_total",
+                                            result="shed")
+        self._wire_h = obs_registry.histogram("gateway_wire_seconds")
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "GatewayServer":
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self._port_arg))
+        s.listen(128)
+        # a timeout on the listener is the only portable way to wake
+        # the accept loop on drain: closing a socket from another
+        # thread does NOT interrupt a blocked accept() on Linux
+        s.settimeout(0.2)
+        self._sock = s
+        t = threading.Thread(target=self._accept_loop,
+                             name="gateway-accept")
+        t.start()
+        self._accept_thread = t
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def _emit(self, phase: str, **fields) -> None:
+        if self.metrics is not None:
+            self.metrics.log("drain", phase=phase, **fields)
+
+    def drain(self, reason: str = "requested",
+              timeout: float | None = None) -> None:
+        """Graceful stop: refuse new work, finish in-flight moves,
+        close every session, quiesce every thread (module docstring).
+        Idempotent; bounded by ``timeout`` (default ``drain_s``)."""
+        timeout = self.drain_s if timeout is None else timeout
+        with self._lock:
+            already = self._draining
+            self._draining = True
+        if already:
+            return
+        self._emit("gateway_requested", reason=reason)
+        # 1. stop accepting: closing the listener pops the accept loop
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        self._emit("gateway_accept_stopped")
+        # 2. nudge idle connections: a read-side shutdown EOFs their
+        # next readline; handlers finish the move in flight, say
+        # goodbye on the still-open write side, close their sessions
+        with self._lock:
+            conns = list(self._conns.values())
+        for conn, _t in conns:
+            try:
+                conn.shutdown(socket.SHUT_RD)
+            except OSError:
+                pass
+        deadline = Deadline.after(timeout)
+        for _conn, t in conns:
+            t.join(timeout=max(0.05, deadline.remaining() or 0.05))
+        # 3. anything still alive gets the write side cut too
+        with self._lock:
+            leftover = list(self._conns.values())
+        for conn, _t in leftover:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for _conn, t in leftover:
+            t.join(timeout=5.0)
+        with self._lock:
+            live = self._live
+        self._emit("gateway_drained", live_conns=live)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.drain(reason="close")
+
+    def __enter__(self) -> "GatewayServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------- accept
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                with self._lock:
+                    if self._draining:
+                        return
+                continue
+            except OSError:
+                return                 # listener closed: drain/close
+            with self._lock:
+                refuse = None
+                if self._draining:
+                    refuse = "draining"
+                elif self._live >= self.max_conns:
+                    refuse = "overload"
+                    self._shed += 1
+                else:
+                    self._live += 1
+                    self._accepted += 1
+                    cid = self._next_cid
+                    self._next_cid += 1
+                self._live_g.set(self._live)
+            if refuse is not None:
+                if refuse == "overload":
+                    self._shed_c.inc()
+                self._count_error(refuse)
+                self._send(conn, protocol.error_frame(
+                    refuse,
+                    f"gateway {refuse}: "
+                    f"{self.max_conns} connections live",
+                    retry_after_s=RETRY_AFTER_S))
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            self._acc_c.inc()
+            t = threading.Thread(target=self._handle,
+                                 args=(conn, cid),
+                                 name=f"gateway-conn-{cid}")
+            with self._lock:
+                self._conns[cid] = (conn, t)
+            t.start()
+
+    # ------------------------------------------------------- handler
+
+    def _send(self, conn, msg: dict) -> bool:
+        try:
+            conn.sendall(protocol.encode_frame(msg))
+            return True
+        except (OSError, ValueError):
+            return False               # peer gone mid-reply
+
+    def _count_error(self, code: str) -> None:
+        obs_registry.counter("gateway_errors_total", code=code).inc()
+        with self._lock:
+            self._errors += 1
+
+    def _handle(self, conn, cid: int) -> None:
+        game = None
+        reader = conn.makefile("rb")
+        try:
+            self._send(conn, protocol.hello_frame(
+                self._boards(), self._default_board(), self.slo_ms))
+            n = 0
+            while True:
+                with self._lock:
+                    draining = self._draining
+                if draining:
+                    self._send(conn, {"type": "goodbye",
+                                      "reason": "draining"})
+                    break
+                try:
+                    msg = protocol.read_frame(reader, self._max_frame)
+                except protocol.ProtocolError as e:
+                    self._count_error(e.code)
+                    self._send(conn, protocol.error_frame(
+                        e.code, str(e)))
+                    if e.fatal:
+                        break
+                    continue
+                if msg is None:
+                    break              # disconnect / torn frame
+                n += 1
+                with self._lock:
+                    self._requests += 1
+                rid = msg.get("id")
+                # the per-request fault wall (docs/RESILIENCE.md):
+                # a transient fails this request, a kill this
+                # connection — never the server
+                try:
+                    faults.barrier("gateway.conn", iteration=n)
+                except faults.InjectedKill as e:
+                    with self._lock:
+                        self._kills += 1
+                    obs_registry.counter("gateway_faults_total",
+                                         kind="kill").inc()
+                    self._send(conn, protocol.error_frame(
+                        "internal", f"connection aborted: {e}",
+                        id=rid))
+                    break
+                except Exception as e:  # noqa: BLE001 — injected
+                    with self._lock:
+                        self._faults += 1
+                    obs_registry.counter("gateway_faults_total",
+                                         kind="fault").inc()
+                    self._count_error("internal")
+                    self._send(conn, protocol.error_frame(
+                        "internal", f"transient fault: {e}", id=rid))
+                    continue
+                try:
+                    reply, game = self._dispatch(msg, game)
+                except Exception as e:  # noqa: BLE001 — fault wall:
+                    #   the connection must answer, the server live on
+                    with self._lock:
+                        self._unhandled += 1
+                    self._count_error("internal")
+                    reply = protocol.error_frame(
+                        "internal", f"{type(e).__name__}: {e}",
+                        id=rid)
+                if reply is not None and not self._send(conn, reply):
+                    break
+        finally:
+            if game is not None:
+                game.session.close()
+            try:
+                reader.close()     # drops the makefile's fd reference
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                self._conns.pop(cid, None)
+                self._live = max(0, self._live - 1)
+                self._live_g.set(self._live)
+
+    # ------------------------------------------------------ dispatch
+
+    def _dispatch(self, msg: dict, game):
+        """One request → (reply frame, game). Refusals are typed
+        error frames; only genuine bugs raise (counted unhandled)."""
+        rid = msg.get("id")
+        mtype = msg.get("type")
+        obs_registry.counter("gateway_requests_total",
+                             type=str(mtype)).inc()
+        if mtype == "hello":
+            proto = msg.get("proto", protocol.PROTO_VERSION)
+            if proto != protocol.PROTO_VERSION:
+                self._count_error("bad_proto")
+                return protocol.error_frame(
+                    "bad_proto",
+                    f"server speaks proto {protocol.PROTO_VERSION}, "
+                    f"client pinned {proto}", id=rid), game
+            return {"type": "ok", "id": rid,
+                    "proto": protocol.PROTO_VERSION}, game
+        if mtype == "new_game":
+            return self._new_game(msg, game)
+        if mtype == "close":
+            if game is not None:
+                game.session.close()
+            return {"type": "ok", "id": rid}, None
+        if mtype in ("play", "genmove", "komi"):
+            if game is None:
+                self._count_error("no_game")
+                return protocol.error_frame(
+                    "no_game", f"{mtype} before new_game",
+                    id=rid), game
+            if mtype == "komi":
+                komi = float(msg.get("komi", game.state.komi))
+                game.session.set_komi(komi)
+                game.state.komi = komi
+                return {"type": "ok", "id": rid}, game
+            if mtype == "play":
+                return self._play(msg, game), game
+            return self._genmove(msg, game), game
+        self._count_error("unknown_type")
+        return protocol.error_frame(
+            "unknown_type", f"unknown message type {mtype!r}",
+            id=rid), game
+
+    def _boards(self) -> tuple:
+        pool = self.pool
+        return (tuple(pool.sizes) if hasattr(pool, "pool_for")
+                else (pool.board,))
+
+    def _default_board(self) -> int:
+        pool = self.pool
+        return (pool.default_size if hasattr(pool, "pool_for")
+                else pool.board)
+
+    def _new_game(self, msg: dict, game):
+        rid = msg.get("id")
+        board = int(msg.get("board", self._default_board()))
+        if game is not None:
+            game.session.close()
+            game = None
+        try:
+            if hasattr(self.pool, "pool_for"):
+                session = self.pool.open_session(size=board)
+            else:
+                if board != self.pool.board:
+                    raise KeyError(board)
+                session = self.pool.open_session()
+        except KeyError:
+            self._count_error("bad_board")
+            return protocol.error_frame(
+                "bad_board",
+                f"board {board} not served (serving "
+                f"{list(self._boards())})", id=rid), None
+        except AdmissionError as e:
+            # the pool's AdmissionController said no: the structured
+            # refusal the load balancer backs off on
+            self._count_error("overload")
+            self._shed_c.inc()
+            with self._lock:
+                self._shed += 1
+            return protocol.error_frame(
+                "overload", str(e), id=rid,
+                retry_after_s=RETRY_AFTER_S), None
+        komi = msg.get("komi")
+        if komi is not None:
+            session.set_komi(float(komi))
+        eff_komi = float(komi) if komi is not None \
+            else float(session.raw.pool.cfg.komi)
+        game = _Game(session, board, eff_komi)
+        return {"type": "ok", "id": rid, "board": board,
+                "komi": eff_komi}, game
+
+    def _play(self, msg: dict, game) -> dict:
+        rid = msg.get("id")
+        state = game.state
+        prev = state.current_player
+        try:
+            color = parse_color(str(msg.get("color", "")))
+            move = vertex_to_move(str(msg.get("move", "")),
+                                  game.board)
+            state.current_player = color
+            if state.is_end_of_game:
+                raise _GameOver()
+            if move is not None and not state.is_legal(move):
+                raise ValueError("illegal move")
+            state.do_move(move, color)
+        except _GameOver:
+            state.current_player = prev
+            self._count_error("game_over")
+            return protocol.error_frame(
+                "game_over", "the game has ended", id=rid)
+        except Exception as e:  # noqa: BLE001 — refusal, state intact
+            state.current_player = prev
+            self._count_error("illegal_move")
+            return protocol.error_frame("illegal_move", str(e),
+                                        id=rid)
+        return {"type": "ok", "id": rid}
+
+    def _genmove(self, msg: dict, game) -> dict:
+        rid = msg.get("id")
+        state = game.state
+        if state.is_end_of_game:
+            self._count_error("game_over")
+            return protocol.error_frame(
+                "game_over", "the game has ended", id=rid)
+        try:
+            color = parse_color(str(msg.get("color", "")))
+        except ValueError as e:
+            self._count_error("bad_request")
+            return protocol.error_frame("bad_request", str(e),
+                                        id=rid)
+        prev = state.current_player
+        state.current_player = color
+        # per-request SLO: the deadline arms inside the session's
+        # anytime search (min of this and the pool's own SLO)
+        slo_s = None if self.slo_ms is None else self.slo_ms / 1e3
+        deadline = Deadline.after(slo_s)
+        game.session.raw.set_move_time(slo_s)
+        t0 = time.monotonic()
+        try:
+            move = game.session.get_move(state)
+            if move is not None and not state.is_legal(move):
+                move = None            # final guard, like the engine
+            state.do_move(move, color)
+        except Exception:
+            state.current_player = prev
+            raise
+        dt = time.monotonic() - t0
+        self._wire_h.observe(dt)
+        with self._lock:
+            self._genmoves += 1
+            self._lat.append(dt)
+            if len(self._lat) > _LAT_KEEP:
+                del self._lat[: len(self._lat) - _LAT_KEEP]
+        return {"type": "move", "id": rid,
+                "move": move_to_vertex(move, game.board),
+                "elapsed_ms": round(dt * 1e3, 3),
+                "slo_hit": bool(not deadline.unlimited
+                                and deadline.expired()),
+                "rung": getattr(game.session.player, "last_rung",
+                                None)}
+
+    # --------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """The probes' ``gateway`` block (schema: docs/GATEWAY.md —
+        the ``gateway-probe-drift`` lint rule diffs this literal
+        against the documented schema both ways)."""
+        with self._lock:
+            live = self._live
+            accepted = self._accepted
+            shed = self._shed
+            requests = self._requests
+            errors = self._errors
+            genmoves = self._genmoves
+            unhandled = self._unhandled
+            injected = self._faults
+            kills = self._kills
+            draining = self._draining
+            lat = sorted(self._lat)
+        p50 = percentile(lat, 0.5)
+        p99 = percentile(lat, 0.99)
+        return {
+            "proto": protocol.PROTO_VERSION,
+            "draining": draining,
+            "conns": {
+                "live": live,
+                "max": self.max_conns,
+                "accepted": accepted,
+                "shed": shed,
+            },
+            "requests": {
+                "total": requests,
+                "errors": errors,
+                "genmoves": genmoves,
+                "unhandled": unhandled,
+            },
+            "faults": {
+                "injected": injected,
+                "kills": kills,
+            },
+            "wire_ms": {
+                "p50": None if p50 is None else round(p50 * 1e3, 3),
+                "p99": None if p99 is None else round(p99 * 1e3, 3),
+            },
+            "slo_ms": self.slo_ms,
+            "drain_s": self.drain_s,
+            "boards": list(self._boards()),
+            "default_board": self._default_board(),
+        }
+
+
+class _GameOver(Exception):
+    """Internal: a move was requested after the game ended."""
+
+
+def main(argv=None) -> int:
+    """Launch a gateway over saved models and serve until SIGTERM
+    (the supervisor's drain — stop accepting, finish in-flight
+    moves, close sessions, exit 0) or Ctrl-C."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Network play gateway over a serve pool "
+                    "(docs/GATEWAY.md)")
+    ap.add_argument("--policy", required=True,
+                    help="policy model JSON spec")
+    ap.add_argument("--value", required=True,
+                    help="value model JSON spec")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9462)
+    ap.add_argument("--http-port", type=int, default=9463,
+                    help="/healthz + /metrics port (0 disables)")
+    ap.add_argument("--playouts", type=int, default=100)
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="per-genmove SLO (default "
+                         "ROCALPHAGO_GATEWAY_SLO_MS / off)")
+    ap.add_argument("--max-conns", type=int, default=None,
+                    help="connection cap (default "
+                         "ROCALPHAGO_GATEWAY_MAX_CONNS / 64)")
+    ap.add_argument("--sizes", default=None,
+                    help="comma list of board sizes for a multi-size "
+                         "pool (needs FCN heads; docs/MULTISIZE.md)")
+    ap.add_argument("--metrics", default=None,
+                    help="JSONL path for drain/degradation events")
+    a = ap.parse_args(argv)
+
+    from rocalphago_tpu.gateway.httpapi import GatewayHTTP
+    from rocalphago_tpu.models.nn_util import NeuralNetBase
+    from rocalphago_tpu.runtime.compilecache import enable_compile_cache
+    from rocalphago_tpu.runtime.supervisor import Supervisor
+
+    enable_compile_cache()
+    metrics = None
+    if a.metrics:
+        from rocalphago_tpu.io.metrics import MetricsLogger
+
+        metrics = MetricsLogger(a.metrics, echo=False)
+    policy = NeuralNetBase.load_model(a.policy)
+    value = NeuralNetBase.load_model(a.value)
+    if a.sizes:
+        from rocalphago_tpu.multisize import MultiSizePool
+
+        sizes = tuple(int(s) for s in a.sizes.split(",") if s.strip())
+        pool = MultiSizePool(value, policy, sizes=sizes,
+                             n_sim=a.playouts, metrics=metrics)
+    else:
+        from rocalphago_tpu.serve.sessions import ServePool
+
+        pool = ServePool(value, policy, n_sim=a.playouts,
+                         metrics=metrics)
+    pool.warm()
+    server = GatewayServer(pool, host=a.host, port=a.port,
+                           max_conns=a.max_conns, slo_ms=a.slo_ms,
+                           metrics=metrics).start()
+    http = None
+    if a.http_port:
+        http = GatewayHTTP(server, host=a.host,
+                           port=a.http_port).start()
+    sup = Supervisor(metrics=metrics)
+    sup.install_sigterm()
+    print(f"gateway: serving on {a.host}:{server.port} "
+          f"(http {'off' if http is None else http.port})")
+    try:
+        while not sup.draining:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        sup.request_drain(reason="keyboard")
+    server.drain(reason="sigterm")
+    if http is not None:
+        http.close()
+    pool.close()
+    if metrics is not None:
+        obs_registry.log_to(metrics)
+        metrics.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -58,6 +58,11 @@ def load_records(path: str, date: str, platform: str | None):
                    # serving sweep axes (bench_serve.py): each
                    # session count × drive mode is its own row
                    r.get("sessions"), r.get("mode"),
+                   # gateway sweep axis (bench_gateway.py): each
+                   # connection count is its own row — the direct
+                   # and gateway sides of the wire-tax A/B already
+                   # split on mode
+                   r.get("conns"),
                    # actor/learner scale axes (bench_zero_scale.py):
                    # each actor count × mesh shape is its own row
                    r.get("actors"), r.get("mesh_shape"),
@@ -82,8 +87,8 @@ def load_records(path: str, date: str, platform: str | None):
 
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
                 "vs_baseline", "mfu", "host_gap_frac", "us_per_pos",
-                "sessions", "actors", "learner_idle_frac", "board",
-                "cap_p", "fullsearch_frac", "mttr_s"}
+                "sessions", "conns", "actors", "learner_idle_frac",
+                "board", "cap_p", "fullsearch_frac", "mttr_s"}
 
 
 def render_table(records) -> str:
@@ -115,12 +120,15 @@ def render_table(records) -> str:
     The MTTR column renders ``mttr_s`` — the recovery A/B's
     kill-to-first-post-restart-game time (``bench_zero_scale.py
     --kill-actor-at``; ``kill_at`` stays in config and keys the
-    row)."""
+    row). The conns column keys the gateway wire-tax sweep
+    (``bench_gateway.py``: moves/sec vs concurrent connections, the
+    direct/gateway modes A/B'd per count — p50/p99 stay in
+    config)."""
     lines = ["| metric | value | unit | board | MFU | host gap "
-             "| µs/pos | sessions | actors | learner idle "
+             "| µs/pos | sessions | conns | actors | learner idle "
              "| cap p | full frac | MTTR | config |",
              "|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-             "---|"]
+             "---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -136,6 +144,8 @@ def render_table(records) -> str:
         upp = "—" if upp in (None, "") else f"{float(upp):g}"
         sess = r.get("sessions")
         sess = "—" if sess in (None, "") else str(sess)
+        conns = r.get("conns")
+        conns = "—" if conns in (None, "") else str(conns)
         act = r.get("actors")
         act = "—" if act in (None, "") else str(act)
         idle = r.get("learner_idle_frac")
@@ -149,8 +159,8 @@ def render_table(records) -> str:
         mttr = "—" if mttr in (None, "") else f"{float(mttr):g}s"
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
                      f" | {r.get('unit', '?')} | {board} | {u} | {gap}"
-                     f" | {upp} | {sess} | {act} | {idle} | {capp}"
-                     f" | {ff} | {mttr} | {cfg} |")
+                     f" | {upp} | {sess} | {conns} | {act} | {idle}"
+                     f" | {capp} | {ff} | {mttr} | {cfg} |")
     return "\n".join(lines)
 
 

@@ -1,0 +1,266 @@
+"""Gateway soak: the network front end under kills + overload.
+
+Runs a real :class:`~rocalphago_tpu.gateway.server.GatewayServer`
+(tiny nets, a warmed :class:`~rocalphago_tpu.serve.sessions.
+ServePool`, the /healthz+/metrics sidecar) and proves the gateway's
+headline claims (docs/GATEWAY.md):
+
+* **overload sheds are structured and counted** — the storm drives
+  MORE concurrent connections than ``--max-conns``, so every round
+  sheds; each shed is a typed ``overload`` frame client-side AND a
+  ``gateway_connections_total{result="shed"}`` increment scraped
+  back off ``/metrics`` (the two tallies must agree exactly);
+* **kills stay inside the fault wall** — a ``kill@gateway.conn``
+  plan (docs/RESILIENCE.md "Fault injection") aborts random
+  connections mid-conversation; the handler answers with a typed
+  ``internal`` error, the session closes, the slot frees, and
+  ``requests.unhandled`` stays ZERO for the whole soak;
+* **after the storm a fault-free GATE round runs clean** — exactly
+  ``--max-conns`` connections, every move lands, nothing shed;
+* **SIGTERM drains gracefully** — the supervisor's handler
+  (docs/RESILIENCE.md "Fleet supervision") flips ``draining``, the
+  gateway stops accepting, finishes in-flight moves, closes every
+  session (pool live count returns to zero) and the process is free
+  to exit 0; the drain timeline (``gateway_requested`` →
+  ``gateway_accept_stopped`` → ``gateway_drained``) lands in
+  ``metrics.jsonl``.
+
+Kill draws are deterministic per seed at each barrier hit, but the
+interleaving of connections is not — so the harness asserts a
+MINIMUM kill count (``--min-kills``) and keeps soaking until the
+floor is met (bounded by ``--deadline-s``), the same contract as
+``scripts/chaos_soak.py``.
+
+Tier-1 smoke: ``tests/test_gateway.py`` runs this with
+``--min-kills 1 --conns 3 --max-conns 2``; the @slow soak runs the
+defaults.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/gateway_soak.py --out /tmp/soak
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default=None,
+                    help="run dir for metrics.jsonl + summary.json "
+                    "(default: a fresh temp dir)")
+    ap.add_argument("--board", type=int, default=5)
+    ap.add_argument("--sims", type=int, default=2)
+    ap.add_argument("--conns", type=int, default=6,
+                    help="concurrent connections per storm round "
+                    "(keep it above --max-conns so rounds shed)")
+    ap.add_argument("--max-conns", type=int, default=3,
+                    help="the gateway's connection cap")
+    ap.add_argument("--moves", type=int, default=4,
+                    help="genmoves per connection per round")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="kill-schedule seed (per-barrier draws)")
+    ap.add_argument("--p-kill", type=float, default=0.15,
+                    help="per-request kill probability at the "
+                    "gateway.conn barrier")
+    ap.add_argument("--plan", default=None,
+                    help="override the whole fault plan verbatim")
+    ap.add_argument("--min-kills", type=int, default=3,
+                    help="soak until at least this many connections "
+                    "were kill-aborted")
+    ap.add_argument("--deadline-s", type=float, default=180.0,
+                    help="hard wall-clock bound on the whole soak")
+    ap.add_argument("--slo-ms", type=float, default=1000.0,
+                    help="per-genmove SLO the gateway arms")
+    return ap
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+    out_dir = args.out or tempfile.mkdtemp(prefix="gateway_soak_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    import time
+    import urllib.request
+
+    from rocalphago_tpu.gateway.client import run_load
+    from rocalphago_tpu.gateway.httpapi import GatewayHTTP
+    from rocalphago_tpu.gateway.server import GatewayServer
+    from rocalphago_tpu.io.metrics import MetricsLogger
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.runtime import faults
+    from rocalphago_tpu.runtime.supervisor import Supervisor
+    from rocalphago_tpu.serve.sessions import ServePool
+
+    plan = (args.plan if args.plan is not None else
+            f"kill@gateway.conn:p={args.p_kill},seed={args.seed}")
+    metrics = MetricsLogger(os.path.join(out_dir, "metrics.jsonl"),
+                            echo=False)
+    metrics.log("gateway_soak", phase="start", plan=plan,
+                conns=args.conns, max_conns=args.max_conns,
+                min_kills=args.min_kills, seed=args.seed)
+
+    # ------------------------------------------------- the tiny rig
+    feats = ("board", "ones")
+    pol = CNNPolicy(feats, board=args.board, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(feats + ("color",), board=args.board, layers=1,
+                   filters_per_layer=2)
+    pool = ServePool(val, pol, n_sim=args.sims,
+                     max_sessions=args.max_conns,
+                     batch_sizes=(1, 2), max_wait_us=2000.0,
+                     metrics=metrics)
+    pool.warm()
+    server = GatewayServer(pool, max_conns=args.max_conns,
+                           slo_ms=args.slo_ms,
+                           metrics=metrics).start()
+    http = GatewayHTTP(server).start()
+    sup = Supervisor(metrics=metrics)
+    sigterm_installed = sup.install_sigterm()
+
+    def settle(timeout_s: float = 10.0) -> None:
+        """Wait for the previous round's handlers to release their
+        slots — a straggler would turn the gate round into a shed."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if server.stats()["conns"]["live"] == 0:
+                return
+            time.sleep(0.05)
+
+    # --------------------------------------------------- the storm
+    faults.install(plan)
+    totals = {"moves": 0, "sheds": 0, "disconnects": 0, "errors": 0}
+    rounds = 0
+    t0 = time.monotonic()
+    rc = 0
+    gate = None
+    try:
+        while time.monotonic() - t0 < args.deadline_s:
+            stats = server.stats()
+            if (totals["moves"] > 0 and totals["sheds"] > 0
+                    and stats["faults"]["kills"] >= args.min_kills):
+                break
+            out = run_load("127.0.0.1", server.port,
+                           conns=args.conns, moves=args.moves,
+                           timeout=60.0)
+            for k in totals:
+                totals[k] += out[k]
+            rounds += 1
+            settle()
+    finally:
+        # ------------------------------------------- the clean gate
+        faults.install("")
+        metrics.log("gateway_soak", phase="gate")
+        try:
+            settle()
+            gate = run_load("127.0.0.1", server.port,
+                            conns=args.max_conns, moves=args.moves,
+                            timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — a red gate is a
+            #                     verdict, not a harness crash
+            metrics.log("gateway_soak", phase="gate_error",
+                        error=f"{type(e).__name__}: {e}")
+
+        # -------------------------- scrape the sheds off /metrics
+        metrics_shed = None
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/metrics",
+                timeout=10.0).read().decode()
+            for line in body.splitlines():
+                if line.startswith(
+                        'gateway_connections_total{result="shed"}'):
+                    metrics_shed = int(float(line.split()[-1]))
+        except Exception as e:  # noqa: BLE001 — counted as a miss
+            metrics.log("gateway_soak", phase="scrape_error",
+                        error=f"{type(e).__name__}: {e}")
+
+        # ------------------------------------- the SIGTERM drain
+        if sigterm_installed:
+            os.kill(os.getpid(), signal.SIGTERM)
+            drain_t0 = time.monotonic()
+            while (not sup.draining
+                   and time.monotonic() - drain_t0 < 10.0):
+                time.sleep(0.02)
+        else:                  # not the main thread (test harness)
+            sup.request_drain(reason="sigterm")
+        server.drain(reason="sigterm")
+        http.close()
+        final = server.stats()
+        pool_live = pool.stats()["sessions"]["live"]
+        pool.close()
+        sup.restore_sigterm()
+        faults.install(None)
+
+    # ------------------------------------------------- the verdict
+    kills = final["faults"]["kills"]
+    drain_phases = {json.loads(line).get("phase")
+                    for line in open(metrics.path)
+                    if json.loads(line).get("event") == "drain"}
+    summary = {
+        "plan": plan,
+        "rounds": rounds,
+        "moves": totals["moves"],
+        "sheds_client": totals["sheds"],
+        "sheds_server": final["conns"]["shed"],
+        "sheds_metrics": metrics_shed,
+        "disconnects": totals["disconnects"],
+        "client_errors": totals["errors"],
+        "kills": kills,
+        "unhandled": final["requests"]["unhandled"],
+        "gate": gate,
+        "drained": final["draining"],
+        "live_conns_after_drain": final["conns"]["live"],
+        "pool_sessions_after_drain": pool_live,
+        "drain_phases": sorted(p for p in drain_phases if p),
+        "sigterm_installed": sigterm_installed,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    checks = {
+        "moves_landed": totals["moves"] > 0,
+        "sheds_observed": totals["sheds"] > 0,
+        "sheds_counted": (metrics_shed is not None
+                          and metrics_shed == final["conns"]["shed"]
+                          and metrics_shed > 0),
+        "min_kills": kills >= args.min_kills,
+        "no_unhandled": final["requests"]["unhandled"] == 0,
+        "gate_green": (gate is not None and gate["sheds"] == 0
+                       and gate["disconnects"] == 0
+                       and gate["errors"] == 0
+                       and gate["moves"]
+                       == args.max_conns * args.moves),
+        "drain_clean": (final["draining"]
+                        and final["conns"]["live"] == 0
+                        and pool_live == 0
+                        and {"gateway_requested",
+                             "gateway_accept_stopped",
+                             "gateway_drained"} <= drain_phases),
+    }
+    summary["checks"] = checks
+    metrics.log("gateway_soak", phase="done", **{
+        k: v for k, v in summary.items() if k != "checks"})
+    metrics.close()
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2))
+    if rc == 0 and not all(checks.values()):
+        rc = 1
+    if rc:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"gateway_soak: FAILED checks: {failed}",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

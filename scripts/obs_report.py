@@ -341,6 +341,65 @@ def render_fleet(records, snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_gateway(records, snap: dict) -> str:
+    """Network gateway health (gateway/server.py; docs/GATEWAY.md):
+    connections accepted vs shed, the request/error mix, wire-latency
+    percentiles, and the gateway drain timeline — 'did the front door
+    shed cleanly and how slow was the wire' in one block."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    conns = {k: v for k, v in counters.items()
+             if k.startswith("gateway_connections_total")}
+    reqs = {k: v for k, v in counters.items()
+            if k.startswith("gateway_requests_total")}
+    errors = {k: v for k, v in counters.items()
+              if k.startswith("gateway_errors_total")}
+    wire = snap.get("histograms", {}).get("gateway_wire_seconds")
+    drains = [r for r in records
+              if r.get("event") == "drain"
+              and str(r.get("phase", "")).startswith("gateway_")]
+    if not (conns or reqs or errors or wire or drains):
+        return "(no gateway records)"
+    lines = []
+    if conns:
+        def count(result):
+            return conns.get(
+                f'gateway_connections_total{{result="{result}"}}', 0)
+
+        live = gauges.get("gateway_conns_live")
+        live_s = "" if live is None else f", {int(live)} live"
+        lines.append(f"connections: {count('accepted')} accepted, "
+                     f"{count('shed')} shed{live_s}")
+    if reqs:
+        lines.append("requests: " + "  ".join(
+            f"{k.split('type=', 1)[-1].strip(chr(34) + '{}')}={v}"
+            for k, v in sorted(reqs.items())))
+    if errors:
+        lines.append("errors: " + "  ".join(
+            f"{k.split('code=', 1)[-1].strip(chr(34) + '{}')}={v}"
+            for k, v in sorted(errors.items())))
+    if wire and wire.get("count"):
+        p50 = quantile_from_buckets(wire, 0.5)
+        p99 = quantile_from_buckets(wire, 0.99)
+        lines.append(f"wire: {wire['count']} genmoves, "
+                     f"p50≲{p50}s p99≲{p99}s")
+    if drains:
+        t0 = drains[0].get("time")
+        steps = []
+        for d in drains:
+            label = str(d.get("phase", "?"))
+            if d is drains[0] and d.get("reason"):
+                label += f" ({d['reason']})"
+            if d.get("live_conns") is not None:
+                label += f" ({d['live_conns']} live)"
+            t = d.get("time")
+            if d is not drains[0] and t0 is not None and t is not None:
+                label += f" +{float(t) - float(t0):.1f}s"
+            steps.append(label)
+        lines.append("drain: " + " → ".join(steps))
+    return "\n".join(lines)
+
+
 def _aux_trend(records) -> dict:
     """``head -> (first, last)`` aux-loss gauge values across the
     run's registry snapshots (gauges only keep the latest value, so
@@ -470,6 +529,8 @@ def report(records, top: int | None = None) -> str:
              render_actor_learner(reg or {}), "",
              "## fleet health (restarts / parks / MTTR / drain)", "",
              render_fleet(records, reg or {}), "",
+             "## gateway (connections / sheds / wire latency / drain)",
+             "", render_gateway(records, reg or {}), "",
              "## self-play economics (cap split / sims saved / aux)",
              "", render_selfplay_econ(records, reg or {}), "",
              "## curriculum (per-stage ladder / transfer verdict)", "",
@@ -535,6 +596,14 @@ FIXTURE = [
      "reason": "sigterm", "time": 110.1},
     {"event": "drain", "phase": "checkpoint", "step": 2,
      "reason": "sigterm", "time": 110.9},
+    # the gateway's own drain timeline (gateway/server.py): stop
+    # accepting, finish in-flight moves, close every session
+    {"event": "drain", "phase": "gateway_requested",
+     "reason": "sigterm", "time": 111.0},
+    {"event": "drain", "phase": "gateway_accept_stopped",
+     "time": 111.1},
+    {"event": "drain", "phase": "gateway_drained", "live_conns": 0,
+     "time": 111.6},
     # an EARLY snapshot (iteration 0): only its aux_loss gauges matter
     # — the econ section walks every snapshot to render the trend;
     # every other section reads the last snapshot only
@@ -557,7 +626,12 @@ FIXTURE = [
                      "learner_steps_total": 7,
                      'actor_games_total{actor="a0"}': 16,
                      'actor_games_total{actor="a1"}': 16,
-                     "policy_targets_pruned_total": 37},
+                     "policy_targets_pruned_total": 37,
+                     'gateway_connections_total{result="accepted"}': 9,
+                     'gateway_connections_total{result="shed"}': 3,
+                     'gateway_requests_total{type="new_game"}': 9,
+                     'gateway_requests_total{type="genmove"}': 40,
+                     'gateway_errors_total{code="overload"}': 3},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
                    'device_occupancy{runner="device_mcts"}': 0.983,
                    "replay_fill_games": 6,
@@ -566,7 +640,8 @@ FIXTURE = [
                    "actor_params_version": 7,
                    "selfplay_fullsearch_frac": 0.25,
                    'aux_loss{head="ownership"}': 0.41,
-                   'aux_loss{head="score"}': 18.5},
+                   'aux_loss{head="score"}': 18.5,
+                   "gateway_conns_live": 0},
         "histograms": {"gtp_genmove_seconds": {
             "count": 42, "sum": 33.6,
             "buckets": {"0.5": 17, "1": 40, "2.5": 42,
@@ -586,7 +661,11 @@ FIXTURE = [
                 "buckets": {"0.25": 5, "0.5": 7, "+Inf": 7}},
             "selfplay_sims_per_move": {
                 "count": 64, "sum": 896.0,
-                "buckets": {"10": 48, "50": 64, "+Inf": 64}}}}},
+                "buckets": {"10": 48, "50": 64, "+Inf": 64}},
+            "gateway_wire_seconds": {
+                "count": 40, "sum": 3.0,
+                "buckets": {"0.05": 10, "0.1": 38, "0.25": 40,
+                            "+Inf": 40}}}}},
 ]
 
 
@@ -614,6 +693,14 @@ def selftest() -> int:
               "recovery: mean 1.600s, max 2.400s over 2 restarts",
               "drain: requested (sigterm) → loop_exit @ iter 2 "
               "+0.1s → checkpoint @ step 2 +0.9s",
+              "gateway (connections / sheds / wire latency / drain)",
+              "connections: 9 accepted, 3 shed, 0 live",
+              "requests: genmove=40  new_game=9",
+              "errors: overload=3",
+              "wire: 40 genmoves, p50≲0.1s p99≲0.25s",
+              "drain: gateway_requested (sigterm) → "
+              "gateway_accept_stopped +0.1s → "
+              "gateway_drained (0 live) +0.6s",
               "self-play economics (cap split / sims saved / aux)",
               "searches: 25.0% full / 75.0% cheap",
               "sims: mean 14.0/move over 64 moves, "

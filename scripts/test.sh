@@ -132,6 +132,20 @@ cd "$(dirname "$0")/.."
 # green-gate check, ~40 s). The full soak (12 learner steps, ≥6
 # kills, defaults) is @slow and runs with --all.
 #
+# Network gateway (rocalphago_tpu/gateway; docs/GATEWAY.md):
+# tests/test_gateway.py is tier-1 — NDJSON framing units (torn/
+# oversized/undecodable frames), the full wire conversation over a
+# real socket, every typed refusal (bad_proto, unknown_type,
+# no_game, illegal_move, bad_board, overload at BOTH the connection
+# cap and the pool's admission cap), abrupt-disconnect slot
+# reclamation, the gateway.conn fault wall (transient fails one
+# request, kill aborts one connection, zero unhandled), graceful
+# drain (goodbye + 503 health + phase events), /healthz + /metrics,
+# multi-size board routing, the GTP --connect bridge, and the
+# gateway-soak SMOKE (scripts/gateway_soak.py in a subprocess:
+# kills under load, sheds reconciled against /metrics, clean
+# SIGTERM drain, exit 0). The multi-minute default soak is @slow.
+#
 # Concurrency proofing (runtime half): tests/test_lockcheck.py
 # units the ROCALPHAGO_LOCKCHECK=1 instrumented locks (observed
 # lock-order graph, cycle raise, held-sets, blocking-while-held,
