@@ -26,10 +26,12 @@ Typed error codes (``{"type": "error", "code": …}``) are the
 protocol's refusal surface — a shed NEVER looks like a hang:
 ``overload`` and ``draining`` carry ``retry_after_s`` so clients and
 load balancers back off instead of spinning. Frames are bounded at
-``ROCALPHAGO_GATEWAY_MAX_FRAME`` bytes; an oversized line is refused
-with ``frame_too_big`` and the connection is dropped (the reader
-cannot resynchronize mid-line). A torn frame (EOF before the
-newline) is a disconnect, not an error.
+``ROCALPHAGO_GATEWAY_MAX_FRAME`` bytes (newline included); a line
+over the bound is refused with ``frame_too_big`` and the connection
+is dropped (the reader cannot resynchronize mid-line). A torn frame
+(EOF before the newline) is a disconnect, not an error; a blank
+line is neither — it is skipped, so keepalive-style bare newlines
+do not kill the game.
 
 Schema and examples: docs/GATEWAY.md.
 """
@@ -88,23 +90,30 @@ def read_frame(reader, limit: int | None = None):
     """Next frame off a buffered binary reader.
 
     Returns the decoded dict, or None on a clean EOF / torn trailing
-    line (both are disconnects). Raises :class:`ProtocolError` for
-    an oversized line (fatal) or undecodable JSON (non-fatal: the
-    line boundary survived, the connection can report and go on).
+    line (both are disconnects). Blank lines are not frames and not
+    disconnects — a keepalive-style bare newline is skipped and the
+    read continues. Raises :class:`ProtocolError` for a line longer
+    than ``limit`` bytes, newline included (fatal) or undecodable
+    JSON (non-fatal: the line boundary survived, the connection can
+    report and go on).
     """
     limit = max_frame_bytes() if limit is None else limit
-    line = reader.readline(limit + 1)
-    if not line:
-        return None
-    if not line.endswith(b"\n"):
+    while True:
+        line = reader.readline(limit + 1)
+        if not line:
+            return None
         if len(line) > limit:
+            # longer than the bound whether or not the newline made
+            # it into the read: a complete limit+1-byte line and a
+            # partial read mid-line are both over
             raise ProtocolError(
                 "frame_too_big",
                 f"frame exceeds {limit} bytes", fatal=True)
-        return None                       # torn frame at EOF
-    line = line.strip()
-    if not line:
-        return None
+        if not line.endswith(b"\n"):
+            return None                   # torn frame at EOF
+        line = line.strip()
+        if line:
+            break                         # blank line: keep reading
     try:
         msg = json.loads(line.decode("utf-8"))
     except (ValueError, UnicodeDecodeError) as e:

@@ -199,16 +199,28 @@ class GatewayServer:
         deadline = Deadline.after(timeout)
         for _conn, t in conns:
             t.join(timeout=max(0.05, deadline.remaining() or 0.05))
-        # 3. anything still alive gets the write side cut too
-        with self._lock:
-            leftover = list(self._conns.values())
-        for conn, _t in leftover:
-            try:
-                conn.close()
-            except OSError:
-                pass
-        for _conn, t in leftover:
-            t.join(timeout=5.0)
+        # 3. stragglers — including connections admitted just before
+        # _draining was set and registered after step 2's snapshot —
+        # get the read-side nudge again plus the write side cut;
+        # close() alone does not wake a blocked readline on Linux, so
+        # loop the SHUT_RD until _conns empties or the tail expires
+        tail = Deadline.after(5.0)
+        while True:
+            with self._lock:
+                leftover = list(self._conns.values())
+            if not leftover or tail.expired():
+                break
+            for conn, _t in leftover:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            for _conn, t in leftover:
+                t.join(timeout=max(0.05, tail.remaining() or 0.05))
         with self._lock:
             live = self._live
         self._emit("gateway_drained", live_conns=live)
@@ -399,7 +411,13 @@ class GatewayServer:
                     "no_game", f"{mtype} before new_game",
                     id=rid), game
             if mtype == "komi":
-                komi = float(msg.get("komi", game.state.komi))
+                try:
+                    komi = float(msg.get("komi", game.state.komi))
+                except (TypeError, ValueError) as e:
+                    self._count_error("bad_request")
+                    return protocol.error_frame(
+                        "bad_request", f"unparseable komi: {e}",
+                        id=rid), game
                 game.session.set_komi(komi)
                 game.state.komi = komi
                 return {"type": "ok", "id": rid}, game
@@ -423,7 +441,19 @@ class GatewayServer:
 
     def _new_game(self, msg: dict, game):
         rid = msg.get("id")
-        board = int(msg.get("board", self._default_board()))
+        # client fields parse BEFORE any side effect: a malformed
+        # value is a typed refusal, never a leaked session or a
+        # torn-down previous game
+        try:
+            board = int(msg.get("board", self._default_board()))
+            komi = msg.get("komi")
+            if komi is not None:
+                komi = float(komi)
+        except (TypeError, ValueError) as e:
+            self._count_error("bad_request")
+            return protocol.error_frame(
+                "bad_request",
+                f"unparseable new_game field: {e}", id=rid), game
         if game is not None:
             game.session.close()
             game = None
@@ -450,12 +480,18 @@ class GatewayServer:
             return protocol.error_frame(
                 "overload", str(e), id=rid,
                 retry_after_s=RETRY_AFTER_S), None
-        komi = msg.get("komi")
-        if komi is not None:
-            session.set_komi(float(komi))
-        eff_komi = float(komi) if komi is not None \
-            else float(session.raw.pool.cfg.komi)
-        game = _Game(session, board, eff_komi)
+        try:
+            if komi is not None:
+                session.set_komi(komi)
+            eff_komi = komi if komi is not None \
+                else float(session.raw.pool.cfg.komi)
+            game = _Game(session, board, eff_komi)
+        except BaseException:
+            # the admission slot must come back even on a genuine
+            # bug — a raise between open and _Game would otherwise
+            # strand the session until restart
+            session.close()
+            raise
         return {"type": "ok", "id": rid, "board": board,
                 "komi": eff_komi}, game
 

@@ -140,6 +140,25 @@ def test_oversized_frame_is_fatal():
     assert ei.value.fatal
 
 
+def test_frame_bound_counts_the_newline():
+    ok = b'{"a": "' + b"x" * 22 + b'"}\n'      # exactly 32 bytes
+    assert len(ok) == 32
+    assert protocol.read_frame(io.BytesIO(ok), limit=32) is not None
+    over = b'{"a": "' + b"x" * 23 + b'"}\n'    # 33 bytes, complete line
+    with pytest.raises(protocol.ProtocolError) as ei:
+        protocol.read_frame(io.BytesIO(over), limit=32)
+    assert ei.value.code == "frame_too_big"
+    assert ei.value.fatal
+
+
+def test_blank_lines_are_skipped_not_disconnects():
+    wire = b"\n\n" + protocol.encode_frame({"type": "ok"}) + b"\n"
+    reader = io.BytesIO(wire)
+    assert protocol.read_frame(reader) == {"type": "ok"}
+    # the trailing blank line runs into EOF: a disconnect
+    assert protocol.read_frame(reader) is None
+
+
 def test_undecodable_frame_is_nonfatal():
     for bad in (b"{oops}\n", b"[1, 2]\n", b'"str"\n'):
         with pytest.raises(protocol.ProtocolError) as ei:
@@ -270,6 +289,64 @@ def test_bad_board_names_what_is_served(server):
         assert str(SIZE) in str(ei.value)
     finally:
         client.close()
+    settle(server)
+
+
+def test_malformed_new_game_fields_do_not_leak_sessions(server, pool):
+    """A non-numeric ``komi``/``board`` is a typed ``bad_request``
+    that never reaches the pool — repeated past ``max_sessions``
+    it must not eat admission slots (the REVIEW.md leak)."""
+    before = server.stats()
+    client = GatewayClient("127.0.0.1", server.port)
+    try:
+        for _ in range(pool.stats()["sessions"]["max"] + 1):
+            with pytest.raises(GatewayError) as ei:
+                client.request({"type": "new_game", "komi": "abc"})
+            assert ei.value.code == "bad_request"
+        with pytest.raises(GatewayError) as ei:
+            client.request({"type": "new_game", "komi": [6.5]})
+        assert ei.value.code == "bad_request"
+        with pytest.raises(GatewayError) as ei:
+            client.request({"type": "new_game", "board": "five"})
+        assert ei.value.code == "bad_request"
+        assert pool.stats()["sessions"]["live"] == 0
+        # every slot survived: a real game still opens
+        assert client.new_game()["type"] == "ok"
+    finally:
+        client.close()
+    settle(server, pool)
+    after = server.stats()
+    assert after["requests"]["unhandled"] \
+        == before["requests"]["unhandled"]
+
+
+def test_malformed_komi_is_bad_request_and_game_holds(server, pool):
+    before = server.stats()["requests"]["unhandled"]
+    client = GatewayClient("127.0.0.1", server.port)
+    try:
+        client.new_game()
+        with pytest.raises(GatewayError) as ei:
+            client.request({"type": "komi", "komi": {"k": 1}})
+        assert ei.value.code == "bad_request"
+        # the game survived the refusal
+        assert client.genmove("b")["type"] == "move"
+    finally:
+        client.close()
+    settle(server, pool)
+    assert server.stats()["requests"]["unhandled"] == before
+
+
+def test_blank_line_over_wire_is_harmless(server):
+    sock, reader = raw_conn(server.port)
+    try:
+        sock.sendall(b"\n")
+        sock.sendall(protocol.encode_frame(
+            {"type": "hello", "id": 1,
+             "proto": protocol.PROTO_VERSION}))
+        assert protocol.read_frame(reader)["type"] == "ok"
+    finally:
+        reader.close()
+        sock.close()
     settle(server)
 
 
