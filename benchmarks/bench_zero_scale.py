@@ -83,6 +83,23 @@ def main() -> None:
     ap.add_argument("--cap-cheap", type=int, default=None,
                     help="cheap budget for capped plies "
                     "(default sims/4)")
+    ap.add_argument("--wire", action="store_true",
+                    help="wire rig: actors run as PROCESSES shipping "
+                    "games to an in-process replay service "
+                    "(docs/REPLAYNET.md) and the learner samples the "
+                    "service's buffer — the wire-tax A/B against the "
+                    "in-process sweep (rows: zero_wire_ingest_"
+                    "games_per_min + learner_idle_frac)")
+    ap.add_argument("--wire-measure-s", type=float, default=20.0,
+                    help="wire: minimum timed-window length — the "
+                    "learner keeps stepping past --steps until this "
+                    "much wall clock has elapsed, so the ingest rate "
+                    "is measured over a meaningful window")
+    ap.add_argument("--wire-warmup-s", type=float, default=600.0,
+                    help="wire: wait budget for every actor process "
+                    "to compile and ship its first game before the "
+                    "timed window opens (matches the in-process "
+                    "sweep, whose actors start compile-hot)")
     ap.set_defaults(board=5, batch=8)
     args = ap.parse_args()
     econ = {}
@@ -138,6 +155,92 @@ def main() -> None:
            batch=args.batch, board=args.board, actors=0,
            mesh_shape=mesh_shape,
            selfplay_frac=round(selfplay_frac, 4), **econ)
+
+    # ---------------- wire sweep: actor processes over replaynet
+    if args.wire:
+        import shutil
+        import subprocess
+        import tempfile
+
+        from rocalphago_tpu.replaynet.server import ReplayService
+
+        for n_actors in [int(x) for x in str(args.actors).split(",")]:
+            buf = ReplayBuffer(capacity=max(2 * n_actors, 4))
+            # evict mode: the sampling learner never pops, so the
+            # buffer is a sliding window (same semantics as the
+            # in-process free-run sweep)
+            svc = ReplayService(buf, evict=True).start()
+            tmp = tempfile.mkdtemp(prefix="zero_wire_")
+            procs = [subprocess.Popen(
+                [sys.executable, "-m",
+                 "rocalphago_tpu.replaynet.actor",
+                 "--connect", f"127.0.0.1:{svc.port}",
+                 "--spool-dir", os.path.join(tmp, f"a{i}"),
+                 "--actor-id", str(i), "--mode", "selfplay",
+                 "--games", "1000000", "--seed", "0",
+                 "--board", str(args.board),
+                 "--batch", str(args.batch),
+                 "--move-limit", str(args.move_limit),
+                 "--sims", str(args.sims),
+                 "--sim-chunk", str(args.sim_chunk)])
+                for i in range(n_actors)]
+            try:
+                # warmup: every actor pays its play compile cold (the
+                # in-process sweep's actors start hot off the sync
+                # baseline) — open the timed window once each has
+                # shipped at least one game
+                t_warm = time.monotonic()
+                while (buf.ingested_games < n_actors * args.batch
+                       and time.monotonic() - t_warm
+                       < args.wire_warmup_s):
+                    if any(p.poll() is not None for p in procs):
+                        raise RuntimeError(
+                            "wire actor process died during warmup")
+                    time.sleep(0.5)
+                base_ingested = buf.ingested_games
+                learner = ZeroLearner(iteration.learn, buf,
+                                      sample=True)
+                state = state0
+                t0 = time.monotonic()
+                steps_done = 0
+                while (steps_done < args.steps
+                       or time.monotonic() - t0
+                       < args.wire_measure_s):
+                    out = learner.step(state, timeout=300.0)
+                    if out is None:
+                        raise RuntimeError(
+                            "wire learner starved at step "
+                            f"{steps_done}")
+                    state, m, _ = out
+                    steps_done += 1
+                dt = time.monotonic() - t0
+                ingested = buf.ingested_games - base_ingested
+            finally:
+                for p in procs:
+                    p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait()
+                svc.drain("bench")
+                buf.close()
+                shutil.rmtree(tmp, ignore_errors=True)
+            idle = round(learner.idle_frac, 4)
+            report("zero_wire_ingest_games_per_min",
+                   ingested * 60.0 / dt, "games/min",
+                   batch=args.batch, board=args.board,
+                   actors=n_actors, mesh_shape=mesh_shape,
+                   learner_idle_frac=idle,
+                   sync_selfplay_frac=round(selfplay_frac, 4),
+                   **econ)
+            report("zero_wire_learner_steps_per_s",
+                   steps_done / dt, "steps/s", batch=args.batch,
+                   board=args.board, actors=n_actors,
+                   mesh_shape=mesh_shape, learner_idle_frac=idle,
+                   **econ)
+        return
 
     # ---------------- actor/learner sweep
     for n_actors in [int(x) for x in str(args.actors).split(",")]:

@@ -39,6 +39,7 @@ class LintConfig:
     docs_knobs: str = "docs/KNOBS.md"
     docs_serving: str = "docs/SERVING.md"
     docs_gateway: str = "docs/GATEWAY.md"
+    docs_replaynet: str = "docs/REPLAYNET.md"
     report_modules: tuple = ("scripts/obs_report.py",)
     #: module whose ``ServePool.stats`` dict is the serve-probe
     #: block producer (diffed against docs_serving's JSON schema)
@@ -46,6 +47,9 @@ class LintConfig:
     #: module whose ``GatewayServer.stats`` dict is the gateway-probe
     #: block producer (diffed against docs_gateway's JSON schema)
     gateway_probe_module: str = "rocalphago_tpu/gateway/server.py"
+    #: module whose ``ReplayService.stats`` dict is the replaynet
+    #: probe producer (diffed against docs_replaynet's JSON schema)
+    replaynet_probe_module: str = "rocalphago_tpu/replaynet/server.py"
 
 
 _KEY_MAP = {
@@ -56,9 +60,11 @@ _KEY_MAP = {
     "docs.knobs": "docs_knobs",
     "docs.serving": "docs_serving",
     "docs.gateway": "docs_gateway",
+    "docs.replaynet": "docs_replaynet",
     "report_modules": "report_modules",
     "serve_probe_module": "serve_probe_module",
     "gateway_probe_module": "gateway_probe_module",
+    "replaynet_probe_module": "replaynet_probe_module",
 }
 
 

@@ -486,21 +486,19 @@ def report_unknown_metric(ctx):
     return findings
 
 
-@project_rule(
-    "serve-probe-drift",
-    "the documented serve health-probe block schema vs the fields "
-    "ServePool.stats actually emits")
-def serve_probe_drift(ctx):
-    """The ``"serve"`` block in ``rocalphago-health`` /
-    ``rocalphago-stats`` is the LB health-check schema
-    (docs/SERVING.md's fenced JSON example). Its producer is the
-    dict literal ``ServePool.stats`` returns
-    (``config.serve_probe_module``); this rule flattens both to
-    dotted key paths and diffs BOTH directions — the same pattern as
-    the metric/barrier tables."""
+def _probe_drift(ctx, *, rule: str, doc_rel: str, block_key: str,
+                 module_rel: str, class_name: str,
+                 consumer: str) -> list:
+    """Shared engine of the three probe-drift rules: the fenced JSON
+    block in ``doc_rel`` containing ``block_key`` is the documented
+    schema; the dict literal ``class_name.stats`` returns in
+    ``module_rel`` is the producer. Both flatten to dotted key paths
+    and diff BOTH directions — the same pattern as the metric/
+    barrier tables. ``consumer`` names who keys on the block (for
+    the finding message)."""
     import json as _json
 
-    doc = ctx.read_doc(ctx.config.docs_serving)
+    doc = ctx.read_doc(doc_rel)
     if doc is None:
         return []
 
@@ -514,15 +512,15 @@ def serve_probe_drift(ctx):
 
     documented = None
     for block in re.findall(r"```json\s*\n(.*?)```", doc, re.S):
-        if '"serve"' not in block:
+        if f'"{block_key}"' not in block:
             continue
         try:
             data = _json.loads(block)
         except ValueError:
             continue
-        serve = data.get("serve")
-        if isinstance(serve, dict):
-            documented = flatten_json(serve)
+        probe = data.get(block_key)
+        if isinstance(probe, dict):
+            documented = flatten_json(probe)
             break
     if documented is None:
         return []
@@ -538,12 +536,11 @@ def serve_probe_drift(ctx):
         return out
 
     produced = None
-    mod = next((m for m in ctx.modules
-                if m.rel == ctx.config.serve_probe_module), None)
+    mod = next((m for m in ctx.modules if m.rel == module_rel), None)
     if mod is not None:
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.ClassDef) \
-                    and node.name == "ServePool":
+                    and node.name == class_name:
                 for fn in node.body:
                     if isinstance(fn, (ast.FunctionDef,
                                        ast.AsyncFunctionDef)) \
@@ -559,23 +556,40 @@ def serve_probe_drift(ctx):
     for key, line in sorted(produced.items()):
         if key not in documented:
             findings.append(Finding(
-                path=mod.rel, line=line, rule="serve-probe-drift",
-                message=f"serve-probe field '{key}' is emitted by "
-                        f"ServePool.stats but missing from the "
-                        f"schema in {ctx.config.docs_serving} — load "
-                        "balancers key on that block; document it",
+                path=mod.rel, line=line, rule=rule,
+                message=f"{block_key}-probe field '{key}' is emitted "
+                        f"by {class_name}.stats but missing from the "
+                        f"schema in {doc_rel} — {consumer} key on "
+                        "that block; document it",
                 snippet=f"probe:{key}"))
     for key in sorted(documented - set(produced)):
         findings.append(Finding(
-            path=ctx.config.docs_serving,
+            path=doc_rel,
             line=_doc_line_of(doc, key.rsplit(".", 1)[-1]),
-            rule="serve-probe-drift",
-            message=f"documented serve-probe field '{key}' is "
-                    "emitted by no code path — an LB health check "
-                    "reading it sees nothing; update the schema or "
-                    "restore the field",
+            rule=rule,
+            message=f"documented {block_key}-probe field '{key}' is "
+                    f"emitted by no code path — a {consumer} reader "
+                    "sees nothing; update the schema or restore the "
+                    "field",
             snippet=f"doc-probe:{key}"))
     return findings
+
+
+@project_rule(
+    "serve-probe-drift",
+    "the documented serve health-probe block schema vs the fields "
+    "ServePool.stats actually emits")
+def serve_probe_drift(ctx):
+    """The ``"serve"`` block in ``rocalphago-health`` /
+    ``rocalphago-stats`` is the LB health-check schema
+    (docs/SERVING.md's fenced JSON example). Its producer is the
+    dict literal ``ServePool.stats`` returns
+    (``config.serve_probe_module``)."""
+    return _probe_drift(
+        ctx, rule="serve-probe-drift",
+        doc_rel=ctx.config.docs_serving, block_key="serve",
+        module_rel=ctx.config.serve_probe_module,
+        class_name="ServePool", consumer="load balancers")
 
 
 @project_rule(
@@ -589,84 +603,29 @@ def gateway_probe_drift(ctx):
     literal ``GatewayServer.stats`` returns
     (``config.gateway_probe_module``); same both-direction diff as
     ``serve-probe-drift``."""
-    import json as _json
+    return _probe_drift(
+        ctx, rule="gateway-probe-drift",
+        doc_rel=ctx.config.docs_gateway, block_key="gateway",
+        module_rel=ctx.config.gateway_probe_module,
+        class_name="GatewayServer", consumer="load balancers")
 
-    doc = ctx.read_doc(ctx.config.docs_gateway)
-    if doc is None:
-        return []
 
-    def flatten_json(d, prefix=""):
-        out = set()
-        for k, v in d.items():
-            out.add(prefix + k)
-            if isinstance(v, dict):
-                out |= flatten_json(v, prefix + k + ".")
-        return out
-
-    documented = None
-    for block in re.findall(r"```json\s*\n(.*?)```", doc, re.S):
-        if '"gateway"' not in block:
-            continue
-        try:
-            data = _json.loads(block)
-        except ValueError:
-            continue
-        gateway = data.get("gateway")
-        if isinstance(gateway, dict):
-            documented = flatten_json(gateway)
-            break
-    if documented is None:
-        return []
-
-    def flatten_dict_node(node, prefix=""):
-        out = {}
-        for k, v in zip(node.keys, node.values):
-            if isinstance(k, ast.Constant) and isinstance(k.value, str):
-                path = prefix + k.value
-                out[path] = k.lineno
-                if isinstance(v, ast.Dict):
-                    out.update(flatten_dict_node(v, path + "."))
-        return out
-
-    produced = None
-    mod = next((m for m in ctx.modules
-                if m.rel == ctx.config.gateway_probe_module), None)
-    if mod is not None:
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef) \
-                    and node.name == "GatewayServer":
-                for fn in node.body:
-                    if isinstance(fn, (ast.FunctionDef,
-                                       ast.AsyncFunctionDef)) \
-                            and fn.name == "stats":
-                        for sub in ast.walk(fn):
-                            if isinstance(sub, ast.Return) \
-                                    and isinstance(sub.value, ast.Dict):
-                                produced = flatten_dict_node(sub.value)
-    if produced is None:
-        return []
-
-    findings = []
-    for key, line in sorted(produced.items()):
-        if key not in documented:
-            findings.append(Finding(
-                path=mod.rel, line=line, rule="gateway-probe-drift",
-                message=f"gateway-probe field '{key}' is emitted by "
-                        f"GatewayServer.stats but missing from the "
-                        f"schema in {ctx.config.docs_gateway} — load "
-                        "balancers key on that block; document it",
-                snippet=f"probe:{key}"))
-    for key in sorted(documented - set(produced)):
-        findings.append(Finding(
-            path=ctx.config.docs_gateway,
-            line=_doc_line_of(doc, key.rsplit(".", 1)[-1]),
-            rule="gateway-probe-drift",
-            message=f"documented gateway-probe field '{key}' is "
-                    "emitted by no code path — an LB health check "
-                    "reading it sees nothing; update the schema or "
-                    "restore the field",
-            snippet=f"doc-probe:{key}"))
-    return findings
+@project_rule(
+    "replaynet-probe-drift",
+    "the documented replaynet stats-probe block schema vs the fields "
+    "ReplayService.stats actually emits")
+def replaynet_probe_drift(ctx):
+    """The ``"replaynet"`` block a ``stats`` frame returns is the
+    schema the soak harness green-gates on and dashboards scrape
+    (docs/REPLAYNET.md's fenced JSON example). Its producer is the
+    dict literal ``ReplayService.stats`` returns
+    (``config.replaynet_probe_module``); same both-direction diff
+    as the other probe rules."""
+    return _probe_drift(
+        ctx, rule="replaynet-probe-drift",
+        doc_rel=ctx.config.docs_replaynet, block_key="replaynet",
+        module_rel=ctx.config.replaynet_probe_module,
+        class_name="ReplayService", consumer="soak harnesses")
 
 
 # --------------------------------------------------- KNOBS.md generator

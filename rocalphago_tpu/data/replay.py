@@ -40,6 +40,7 @@ backend.
 from __future__ import annotations
 
 import glob
+import hashlib
 import json
 import os
 import time
@@ -128,14 +129,40 @@ class ReplayEntry(NamedTuple):
     t_ingest: float
 
 
+def compute_game_id(games: ZeroGames) -> str:
+    """Content-hash identity of one batch: sha256 over every
+    present field's name, dtype, shape and raw bytes (16 hex chars).
+
+    The id is a pure function of the game CONTENT — transport
+    metadata (``version``/``seq``) is excluded — so the same batch
+    re-encoded, re-shipped after an ambiguous ack, re-read after a
+    shard rotation or re-spilled under a fresh sequence number hashes
+    to the same id. That property is what lets every dedup window
+    (replaynet's server, :class:`JsonlIngester`) collapse
+    at-least-once delivery into effectively exactly-once."""
+    h = hashlib.sha256()
+    for name, arr in zip(ZeroGames._fields, games):
+        if arr is None:
+            continue
+        a = np.asarray(arr)
+        h.update(name.encode("utf-8"))
+        h.update(str(a.dtype).encode("utf-8"))
+        h.update(str(a.shape).encode("utf-8"))
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
+
+
 def games_to_record(games: ZeroGames, version: int = 0,
-                    seq: int = 0) -> dict:
+                    seq: int = 0, game_id: str | None = None) -> dict:
     """JSON-serializable record preserving shapes and dtypes.
     Optional (None) fields are simply absent from the record — a
     flags-off game writes exactly the v1 field set plus the
-    ``schema`` tag."""
+    ``schema`` tag. Every record carries its content-hash
+    ``game_id`` (:func:`compute_game_id`; pass it in when already
+    known to skip the rehash)."""
     rec = {"version": int(version), "seq": int(seq),
-           "schema": RECORD_SCHEMA}
+           "schema": RECORD_SCHEMA,
+           "game_id": game_id or compute_game_id(games)}
     for name, arr in zip(ZeroGames._fields, games):
         if arr is None:
             continue
@@ -143,6 +170,18 @@ def games_to_record(games: ZeroGames, version: int = 0,
         rec[name] = a.tolist()
         rec[name + "_dtype"] = str(a.dtype)
     return rec
+
+
+def record_game_id(rec: dict, games: ZeroGames | None = None) -> str:
+    """A record's ``game_id`` — the embedded one when present, else
+    recomputed from ``games`` (the parsed batch; older records wrote
+    no id, and the content hash is recomputable by design)."""
+    gid = rec.get("game_id")
+    if gid:
+        return str(gid)
+    if games is None:
+        games, _ = record_to_games(rec)
+    return compute_game_id(games)
 
 
 def record_to_games(rec: dict) -> tuple[ZeroGames, int]:
@@ -197,18 +236,30 @@ class ReplayBuffer:
         self._ingested = 0                     # guarded-by: self._cond
         self._t_first: float | None = None     # guarded-by: self._cond
         self._rng = np.random.default_rng(seed)  # guarded-by: self._cond
+        # spill filenames carry an incarnation tag so THIS buffer's
+        # files can never collide with (or be mistaken for) a dead
+        # incarnation's leftovers: restore() ingests only foreign
+        # tags, and a live put during restore can't overwrite the
+        # old file restore is about to read
+        self._spill_tag = (f"{os.getpid():x}."
+                           f"{int(time.time() * 1e3) & 0xffffffff:08x}")
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
 
     # ------------------------------------------------------- producers
 
     def put(self, games: ZeroGames, version: int = 0,
-            block: bool = False, timeout: float | None = None) -> bool:
+            block: bool = False, timeout: float | None = None,
+            evict: bool = True) -> bool:
         """Append a batch; True if accepted, False on timeout/closed.
 
         ``block=True`` waits for room (producer pacing — bounds
         sample staleness by construction); ``block=False`` evicts the
-        oldest entry when full.
+        oldest entry when full. ``evict=False`` turns a full
+        non-blocking put into a plain refusal (return False, buffer
+        untouched) — the mode a LOSSLESS ingest path needs: the
+        replay service answers ``overload`` with ``retry_after_s``
+        instead of silently dropping the oldest game.
         """
         games = ZeroGames(*(None if x is None else np.asarray(x)
                             for x in games))
@@ -226,6 +277,8 @@ class ReplayBuffer:
                     return False
                 self._cond.wait(rem)
             if self._closed:
+                return False
+            if not evict and len(self._entries) >= self.capacity:
                 return False
             while len(self._entries) >= self.capacity:
                 old = self._entries.pop(0)
@@ -257,6 +310,32 @@ class ReplayBuffer:
         if evicted_games:
             registry.counter("replay_evicted_games_total").inc(
                 evicted_games)
+        return True
+
+    def requeue(self, entry: ReplayEntry) -> bool:
+        """Put a consumed entry BACK at the head of the FIFO.
+
+        The take-side loss guard: when the replay service pops an
+        entry for ``next_batch`` and then fails to send the reply
+        (peer died mid-response), the entry is requeued — same seq,
+        same position — and re-spilled, so the failed delivery costs
+        nothing. Capacity is deliberately allowed to overshoot by
+        the requeued entry (dropping here would be the exact loss
+        the guard exists to prevent). False only when closed.
+        """
+        with self._cond:
+            if self._closed:
+                return False
+            self._entries.insert(0, entry)
+            fill = sum(int(e.games.winners.shape[0])
+                       for e in self._entries)
+            self._cond.notify_all()
+        if self.spill_dir:
+            atomic.atomic_write_json(
+                self._spill_path(entry.seq),
+                games_to_record(entry.games, entry.version, entry.seq),
+                indent=None)
+        registry.gauge("replay_fill_games").set(fill)
         return True
 
     # ------------------------------------------------------- consumers
@@ -347,29 +426,86 @@ class ReplayBuffer:
         Tolerant: unreadable/torn files are skipped. All on-disk
         files are consumed (removed) and the survivors re-spilled
         under fresh sequence numbers, so a second crash can't
-        double-restore."""
+        double-restore.
+
+        The insert is ONE critical section: restore-while-producers-
+        publish is a real path (a replay service restores its spill
+        while reconnecting actors are already shipping), and
+        inserting the recovered entries one ``put`` at a time would
+        let live puts interleave into the middle of the restored
+        stream — reordering the FIFO. Under the single section the
+        restored entries land contiguously, before or after any live
+        put, and both streams keep their own order."""
         if not self.spill_dir:
             return 0
-        paths = sorted(glob.glob(
-            os.path.join(self.spill_dir, "entry.*.json")))
+        paths = sorted(
+            p for p in glob.glob(
+                os.path.join(self.spill_dir, "entry.*.json"))
+            if f".{self._spill_tag}." not in os.path.basename(p))
         recovered = []
         for path in paths:
             try:
                 with open(path, encoding="utf-8") as f:
                     rec = json.load(f)
-                recovered.append(record_to_games(rec))
+                games, version = record_to_games(rec)
             except (OSError, ValueError, KeyError, TypeError):
                 continue
+            recovered.append((ZeroGames(
+                *(None if x is None else np.asarray(x)
+                  for x in games)), version))
+        evict_seqs: list[int] = []
+        evicted_games = 0
+        new_entries: list[ReplayEntry] = []
+        with self._cond:
+            if self._closed:
+                return 0
+            for games, version in recovered:
+                while len(self._entries) >= self.capacity:
+                    old = self._entries.pop(0)
+                    evict_seqs.append(old.seq)
+                    evicted_games += int(old.games.winners.shape[0])
+                entry = ReplayEntry(self._seq, int(version), games,
+                                    time.monotonic())
+                self._seq += 1
+                self._entries.append(entry)
+                self._ingested += int(games.winners.shape[0])
+                new_entries.append(entry)
+            if new_entries and self._t_first is None:
+                self._t_first = time.monotonic()
+            fill = sum(int(e.games.winners.shape[0])
+                       for e in self._entries)
+            self._cond.notify_all()
+        # file I/O stays outside the lock: consume the old files
+        # first, then re-spill only the entries still IN the buffer
+        # (a restored entry evicted by a later restored one, or a
+        # live entry evicted mid-restore, must not leave a spill
+        # file behind to double-restore next time)
         for path in paths:
             try:
                 os.unlink(path)
             except OSError:
                 pass
-        n = 0
-        for games, version in recovered:
-            if self.put(games, version=version, block=False):
-                n += 1
-        return n
+        evicted = set(evict_seqs)
+        for entry in new_entries:
+            if entry.seq in evicted:
+                continue
+            atomic.atomic_write_json(
+                self._spill_path(entry.seq),
+                games_to_record(entry.games, entry.version,
+                                entry.seq),
+                indent=None)
+        restored_seqs = {e.seq for e in new_entries}
+        for seq in evict_seqs:
+            if seq not in restored_seqs:
+                self._unspill(seq)
+        if new_entries:
+            registry.counter("replay_spilled_total").inc(
+                len(new_entries))
+            registry.gauge("replay_fill_games").set(fill)
+        if evicted_games:
+            registry.counter("replay_evicted_games_total").inc(
+                evicted_games)
+        return len(new_entries)
 
     def discard_spill(self) -> int:
         """Delete every spilled entry WITHOUT restoring it; returns
@@ -390,7 +526,9 @@ class ReplayBuffer:
         return n
 
     def _spill_path(self, seq: int) -> str:
-        return os.path.join(self.spill_dir, f"entry.{seq:08d}.json")
+        return os.path.join(
+            self.spill_dir,
+            f"entry.{self._spill_tag}.{seq:08d}.json")
 
     def _unspill(self, seq: int) -> None:
         try:
@@ -417,15 +555,25 @@ class JsonlIngester:
     (an actor restarted by its supervisor truncates and rewrites, or
     logrotate swapped the file) is re-read from byte 0 — counted in
     ``shard_rotated`` — instead of silently tailing past EOF forever.
+
+    Rotation re-reads make ingest at-least-once; the bounded
+    ``game_id`` window (:func:`record_game_id` content hashes, the
+    newest ``dedup_window`` ids) makes it effectively exactly-once:
+    a record already ingested before the rotation is counted in
+    ``dedup_hits`` and skipped, never double-fed to the buffer.
     """
 
-    def __init__(self, buffer: ReplayBuffer, path: str):
+    def __init__(self, buffer: ReplayBuffer, path: str,
+                 dedup_window: int = 4096):
         self.buffer = buffer
         self.path = path
         self.skipped = 0
         self.schema_skipped = 0
         self.shard_rotated = 0
+        self.dedup_hits = 0
+        self.dedup_window = int(dedup_window)
         self._offsets: dict[str, int] = {}
+        self._seen: dict[str, None] = {}   # insertion-ordered id ring
 
     def poll(self) -> int:
         """Ingest every complete new line; returns entries added."""
@@ -452,7 +600,9 @@ class JsonlIngester:
                 if not line.strip():
                     continue
                 try:
-                    games, version = record_to_games(json.loads(line))
+                    rec = json.loads(line)
+                    games, version = record_to_games(rec)
+                    gid = record_game_id(rec, games)
                 except UnknownSchemaError:
                     # a NEWER writer shares the stream (rolling
                     # upgrade): count separately — the operator's cue
@@ -463,8 +613,14 @@ class JsonlIngester:
                 except (ValueError, KeyError, TypeError):
                     self.skipped += 1
                     continue
+                if gid in self._seen:
+                    self.dedup_hits += 1
+                    continue
                 if self.buffer.put(games, version=version):
                     added += 1
+                    self._seen[gid] = None
+                    while len(self._seen) > self.dedup_window:
+                        self._seen.pop(next(iter(self._seen)))
             self._offsets[shard] = offset + end + 1
         return added
 

@@ -8,6 +8,13 @@ structured refusal (``overload``/``draining``) surfaces as
 callers back off instead of spinning; a dropped connection is
 :class:`GatewayClosed`.
 
+:func:`connect_with_retry` is the backoff-aware way in: it wraps
+the constructor in the shared :func:`rocalphago_tpu.net.client
+.call_with_backoff` loop, so a shed client sleeps at least the
+server's ``retry_after_s`` (deterministic-jitter backoff as the
+floor) and succeeds on a later attempt instead of hand-rolling the
+sleep — or spinning.
+
 :func:`run_load` drives N concurrent synthetic games (one
 connection each, barrier-started) and returns per-genmove latencies
 plus shed/disconnect counts — the measurement half of the wire-tax
@@ -21,6 +28,7 @@ import threading
 import time
 
 from rocalphago_tpu.gateway import protocol
+from rocalphago_tpu.net import client as net_client
 
 
 class GatewayError(Exception):
@@ -147,6 +155,25 @@ class GatewayClient:
             self.sock.close()
         except OSError:
             pass
+
+
+def connect_with_retry(host: str, port: int, *, timeout: float = 60.0,
+                       attempts: int = 6, base_delay: float = 0.25,
+                       max_delay: float = 5.0, seed: int = 0,
+                       sleep=time.sleep) -> GatewayClient:
+    """Connect like :class:`GatewayClient`, but ride out sheds.
+
+    A :class:`GatewayRefused` (``overload``/``draining``) or a
+    dropped connection retries on the shared reconnect/backoff loop,
+    sleeping at least the refusal's ``retry_after_s`` each round;
+    the final attempt's exception propagates unchanged. ``sleep`` is
+    injectable so tests assert the schedule instead of waiting it.
+    """
+    return net_client.call_with_backoff(
+        lambda: GatewayClient(host, port, timeout=timeout),
+        attempts=attempts, base_delay=base_delay,
+        max_delay=max_delay, seed=seed, key="gateway.connect",
+        sleep=sleep)
 
 
 # ------------------------------------------------------ load generator

@@ -33,13 +33,19 @@ is dropped (the reader cannot resynchronize mid-line). A torn frame
 line is neither — it is skipped, so keepalive-style bare newlines
 do not kill the game.
 
+Framing (the reader rules, sorted-key encoding, typed error
+frames) is the shared :mod:`rocalphago_tpu.net.protocol` core —
+this module pins the gateway's protocol CONTENT on top of it: the
+version, the error-code vocabulary, the frame bound and the hello.
+
 Schema and examples: docs/GATEWAY.md.
 """
 
 from __future__ import annotations
 
-import json
 import os
+
+from rocalphago_tpu.net import protocol as _net
 
 #: protocol revision carried in every hello; bumped on any frame
 #: schema change a deployed client could observe
@@ -64,75 +70,31 @@ ERROR_CODES = (
 )
 
 
+#: the shared framing core's exception, re-exported so every
+#: existing ``protocol.ProtocolError`` caller keeps working
+ProtocolError = _net.ProtocolError
+
+encode_frame = _net.encode_frame
+
+
 def max_frame_bytes() -> int:
     raw = os.environ.get(MAX_FRAME_ENV, "")
     return int(raw) if raw else 65536
 
 
-class ProtocolError(Exception):
-    """A frame the reader cannot accept; ``code`` names why and
-    ``fatal`` says whether the connection can survive it (a torn
-    byte stream cannot — the next line boundary is unknowable)."""
-
-    def __init__(self, code: str, msg: str, fatal: bool = False):
-        super().__init__(msg)
-        self.code = code
-        self.fatal = fatal
-
-
-def encode_frame(msg: dict) -> bytes:
-    """One dict → one NDJSON line (sorted keys: byte-stable frames
-    make wire-level tests and captures diffable)."""
-    return (json.dumps(msg, sort_keys=True) + "\n").encode("utf-8")
-
-
 def read_frame(reader, limit: int | None = None):
-    """Next frame off a buffered binary reader.
-
-    Returns the decoded dict, or None on a clean EOF / torn trailing
-    line (both are disconnects). Blank lines are not frames and not
-    disconnects — a keepalive-style bare newline is skipped and the
-    read continues. Raises :class:`ProtocolError` for a line longer
-    than ``limit`` bytes, newline included (fatal) or undecodable
-    JSON (non-fatal: the line boundary survived, the connection can
-    report and go on).
-    """
-    limit = max_frame_bytes() if limit is None else limit
-    while True:
-        line = reader.readline(limit + 1)
-        if not line:
-            return None
-        if len(line) > limit:
-            # longer than the bound whether or not the newline made
-            # it into the read: a complete limit+1-byte line and a
-            # partial read mid-line are both over
-            raise ProtocolError(
-                "frame_too_big",
-                f"frame exceeds {limit} bytes", fatal=True)
-        if not line.endswith(b"\n"):
-            return None                   # torn frame at EOF
-        line = line.strip()
-        if line:
-            break                         # blank line: keep reading
-    try:
-        msg = json.loads(line.decode("utf-8"))
-    except (ValueError, UnicodeDecodeError) as e:
-        raise ProtocolError("bad_request", f"undecodable frame: {e}")
-    if not isinstance(msg, dict):
-        raise ProtocolError("bad_request",
-                            "frame must be a JSON object")
-    return msg
+    """Next frame off a buffered binary reader, bounded at the
+    gateway's frame limit by default (shared reader rules:
+    :func:`rocalphago_tpu.net.protocol.read_frame`)."""
+    return _net.read_frame(
+        reader, max_frame_bytes() if limit is None else limit)
 
 
 def error_frame(code: str, msg: str, id=None,
                 retry_after_s: float | None = None) -> dict:
-    assert code in ERROR_CODES, code
-    out = {"type": "error", "code": code, "msg": msg}
-    if id is not None:
-        out["id"] = id
-    if retry_after_s is not None:
-        out["retry_after_s"] = round(float(retry_after_s), 3)
-    return out
+    return _net.error_frame(code, msg, id=id,
+                            retry_after_s=retry_after_s,
+                            codes=ERROR_CODES)
 
 
 def hello_frame(boards, default_board: int,

@@ -34,13 +34,17 @@ accept loop, lets in-flight moves finish, nudges idle connections
 with a read-side shutdown (their handlers say goodbye and close
 their sessions), joins every handler within ``drain_s``, and leaves
 the process free to exit 0.
+
+The accept loop, admission refusals, connection registry and the
+three-step drain are the shared :class:`~rocalphago_tpu.net.server
+.LineServerCore` (composed — the same machinery the replay service
+runs); this module keeps the gateway-specific parts: session
+mapping, dispatch, the per-request SLO and the probe.
 """
 
 from __future__ import annotations
 
 import os
-import socket
-import threading
 import time
 
 from rocalphago_tpu.analysis import lockcheck
@@ -52,6 +56,7 @@ from rocalphago_tpu.interface.gtp import (
     vertex_to_move,
 )
 from rocalphago_tpu.interface.resilient import percentile
+from rocalphago_tpu.net.server import LineServerCore
 from rocalphago_tpu.obs import registry as obs_registry
 from rocalphago_tpu.runtime import faults
 from rocalphago_tpu.runtime.deadline import Deadline
@@ -111,10 +116,6 @@ class GatewayServer:
                         if drain_s is None else float(drain_s))
         self._max_frame = protocol.max_frame_bytes()
         self._lock = lockcheck.make_lock("GatewayServer._lock")
-        self._conns: dict = {}       # guarded-by: self._lock
-        self._live = 0               # guarded-by: self._lock
-        self._next_cid = 0           # guarded-by: self._lock
-        self._accepted = 0           # guarded-by: self._lock
         self._shed = 0               # guarded-by: self._lock
         self._requests = 0           # guarded-by: self._lock
         self._errors = 0             # guarded-by: self._lock
@@ -122,10 +123,7 @@ class GatewayServer:
         self._unhandled = 0          # guarded-by: self._lock
         self._faults = 0             # guarded-by: self._lock
         self._kills = 0              # guarded-by: self._lock
-        self._draining = False       # guarded-by: self._lock
         self._lat: list = []         # guarded-by: self._lock
-        self._sock: socket.socket | None = None
-        self._accept_thread: threading.Thread | None = None
         self._closed = False
         self._live_g = obs_registry.gauge("gateway_conns_live")
         self._acc_c = obs_registry.counter("gateway_connections_total",
@@ -133,97 +131,35 @@ class GatewayServer:
         self._shed_c = obs_registry.counter("gateway_connections_total",
                                             result="shed")
         self._wire_h = obs_registry.histogram("gateway_wire_seconds")
+        # accept/admission/registry/drain: the shared wire core
+        # (docs/GATEWAY.md semantics, byte-identical refusals)
+        self._core = LineServerCore(
+            host=host, port=port, max_conns=self.max_conns,
+            drain_s=self.drain_s, handler=self._handle,
+            refusal=self._refusal_frame, name="gateway",
+            metrics=metrics, live_gauge=self._live_g,
+            accepted_counter=self._acc_c, shed_counter=self._shed_c)
 
     # ------------------------------------------------------ lifecycle
 
     def start(self) -> "GatewayServer":
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((self.host, self._port_arg))
-        s.listen(128)
-        # a timeout on the listener is the only portable way to wake
-        # the accept loop on drain: closing a socket from another
-        # thread does NOT interrupt a blocked accept() on Linux
-        s.settimeout(0.2)
-        self._sock = s
-        t = threading.Thread(target=self._accept_loop,
-                             name="gateway-accept")
-        t.start()
-        self._accept_thread = t
+        self._core.start()
         return self
 
     @property
     def port(self) -> int:
-        return self._sock.getsockname()[1]
+        return self._core.port
 
     @property
     def draining(self) -> bool:
-        with self._lock:
-            return self._draining
-
-    def _emit(self, phase: str, **fields) -> None:
-        if self.metrics is not None:
-            self.metrics.log("drain", phase=phase, **fields)
+        return self._core.draining
 
     def drain(self, reason: str = "requested",
               timeout: float | None = None) -> None:
         """Graceful stop: refuse new work, finish in-flight moves,
         close every session, quiesce every thread (module docstring).
         Idempotent; bounded by ``timeout`` (default ``drain_s``)."""
-        timeout = self.drain_s if timeout is None else timeout
-        with self._lock:
-            already = self._draining
-            self._draining = True
-        if already:
-            return
-        self._emit("gateway_requested", reason=reason)
-        # 1. stop accepting: closing the listener pops the accept loop
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5.0)
-        self._emit("gateway_accept_stopped")
-        # 2. nudge idle connections: a read-side shutdown EOFs their
-        # next readline; handlers finish the move in flight, say
-        # goodbye on the still-open write side, close their sessions
-        with self._lock:
-            conns = list(self._conns.values())
-        for conn, _t in conns:
-            try:
-                conn.shutdown(socket.SHUT_RD)
-            except OSError:
-                pass
-        deadline = Deadline.after(timeout)
-        for _conn, t in conns:
-            t.join(timeout=max(0.05, deadline.remaining() or 0.05))
-        # 3. stragglers — including connections admitted just before
-        # _draining was set and registered after step 2's snapshot —
-        # get the read-side nudge again plus the write side cut;
-        # close() alone does not wake a blocked readline on Linux, so
-        # loop the SHUT_RD until _conns empties or the tail expires
-        tail = Deadline.after(5.0)
-        while True:
-            with self._lock:
-                leftover = list(self._conns.values())
-            if not leftover or tail.expired():
-                break
-            for conn, _t in leftover:
-                try:
-                    conn.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-            for _conn, t in leftover:
-                t.join(timeout=max(0.05, tail.remaining() or 0.05))
-        with self._lock:
-            live = self._live
-        self._emit("gateway_drained", live_conns=live)
+        self._core.drain(reason=reason, timeout=timeout)
 
     def close(self) -> None:
         if self._closed:
@@ -237,79 +173,33 @@ class GatewayServer:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    # -------------------------------------------------------- accept
-
-    def _accept_loop(self) -> None:
-        while True:
-            try:
-                conn, _addr = self._sock.accept()
-            except socket.timeout:
-                with self._lock:
-                    if self._draining:
-                        return
-                continue
-            except OSError:
-                return                 # listener closed: drain/close
-            with self._lock:
-                refuse = None
-                if self._draining:
-                    refuse = "draining"
-                elif self._live >= self.max_conns:
-                    refuse = "overload"
-                    self._shed += 1
-                else:
-                    self._live += 1
-                    self._accepted += 1
-                    cid = self._next_cid
-                    self._next_cid += 1
-                self._live_g.set(self._live)
-            if refuse is not None:
-                if refuse == "overload":
-                    self._shed_c.inc()
-                self._count_error(refuse)
-                self._send(conn, protocol.error_frame(
-                    refuse,
-                    f"gateway {refuse}: "
-                    f"{self.max_conns} connections live",
-                    retry_after_s=RETRY_AFTER_S))
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                continue
-            self._acc_c.inc()
-            t = threading.Thread(target=self._handle,
-                                 args=(conn, cid),
-                                 name=f"gateway-conn-{cid}")
-            with self._lock:
-                self._conns[cid] = (conn, t)
-            t.start()
-
     # ------------------------------------------------------- handler
 
+    def _refusal_frame(self, code: str) -> dict:
+        """At-accept shed (``overload``/``draining``): the typed
+        refusal the core sends before closing the connection."""
+        self._count_error(code)
+        return protocol.error_frame(
+            code,
+            f"gateway {code}: {self.max_conns} connections live",
+            retry_after_s=RETRY_AFTER_S)
+
     def _send(self, conn, msg: dict) -> bool:
-        try:
-            conn.sendall(protocol.encode_frame(msg))
-            return True
-        except (OSError, ValueError):
-            return False               # peer gone mid-reply
+        return self._core.send(conn, msg)
 
     def _count_error(self, code: str) -> None:
         obs_registry.counter("gateway_errors_total", code=code).inc()
         with self._lock:
             self._errors += 1
 
-    def _handle(self, conn, cid: int) -> None:
+    def _handle(self, conn, reader, cid: int) -> None:
         game = None
-        reader = conn.makefile("rb")
         try:
             self._send(conn, protocol.hello_frame(
                 self._boards(), self._default_board(), self.slo_ms))
             n = 0
             while True:
-                with self._lock:
-                    draining = self._draining
-                if draining:
+                if self._core.draining:
                     self._send(conn, {"type": "goodbye",
                                       "reason": "draining"})
                     break
@@ -366,18 +256,6 @@ class GatewayServer:
         finally:
             if game is not None:
                 game.session.close()
-            try:
-                reader.close()     # drops the makefile's fd reference
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except OSError:
-                pass
-            with self._lock:
-                self._conns.pop(cid, None)
-                self._live = max(0, self._live - 1)
-                self._live_g.set(self._live)
 
     # ------------------------------------------------------ dispatch
 
@@ -571,9 +449,8 @@ class GatewayServer:
         """The probes' ``gateway`` block (schema: docs/GATEWAY.md —
         the ``gateway-probe-drift`` lint rule diffs this literal
         against the documented schema both ways)."""
+        wire = self._core.counters()
         with self._lock:
-            live = self._live
-            accepted = self._accepted
             shed = self._shed
             requests = self._requests
             errors = self._errors
@@ -581,18 +458,18 @@ class GatewayServer:
             unhandled = self._unhandled
             injected = self._faults
             kills = self._kills
-            draining = self._draining
             lat = sorted(self._lat)
         p50 = percentile(lat, 0.5)
         p99 = percentile(lat, 0.99)
         return {
             "proto": protocol.PROTO_VERSION,
-            "draining": draining,
+            "draining": wire["draining"],
             "conns": {
-                "live": live,
+                "live": wire["live"],
                 "max": self.max_conns,
-                "accepted": accepted,
-                "shed": shed,
+                "accepted": wire["accepted"],
+                # at-accept conn sheds (core) + pool-admission sheds
+                "shed": wire["shed"] + shed,
             },
             "requests": {
                 "total": requests,

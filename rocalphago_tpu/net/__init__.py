@@ -1,0 +1,26 @@
+"""Shared wire-service core: the machinery every NDJSON server reuses.
+
+PR 15 built the play gateway; this package extracts the parts of it
+that were never gateway-specific so the replay service (and any
+later wire front end) reuses ONE proven implementation instead of a
+divergent copy:
+
+* :mod:`~rocalphago_tpu.net.protocol` — NDJSON framing (one JSON
+  object per line, sorted keys), the frame-bound / torn-frame /
+  blank-line reader rules, and typed error frames;
+* :mod:`~rocalphago_tpu.net.server` — :class:`~rocalphago_tpu.net
+  .server.LineServerCore`: the threaded accept loop with structured
+  admission (``overload``/``draining`` refusals, never hangs), the
+  per-connection handler threads and registry, and the bounded
+  three-step graceful drain;
+* :mod:`~rocalphago_tpu.net.client` — :func:`~rocalphago_tpu.net
+  .client.call_with_backoff`: the reconnect/backoff loop every wire
+  client shares, honoring a refusal's ``retry_after_s`` hint on top
+  of :func:`rocalphago_tpu.runtime.retries.backoff_delay`'s
+  deterministic jitter.
+
+Protocol *content* (message types, error-code vocabularies, hello
+frames, versioning) stays with each service — ``gateway/`` and
+``replaynet/`` each pin their own — so this layer never needs a
+cross-service schema bump.
+"""

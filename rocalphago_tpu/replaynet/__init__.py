@@ -1,0 +1,36 @@
+"""Replay over the wire: the networked replay service.
+
+PR 11's actor/learner split kept actors as in-process threads
+feeding one :class:`~rocalphago_tpu.data.replay.ReplayBuffer`. This
+package puts a wire between them — the Pgx/KataGo distributed
+shape: actor processes (other cores, other hosts) stream finished
+self-play games to a replay service the learner consumes from —
+with fault tolerance as the headline, not an afterthought:
+
+* :mod:`~rocalphago_tpu.replaynet.protocol` — the NDJSON protocol
+  content (``put_games``/``next_batch``/``stats`` over schema-v2
+  game records) on the shared :mod:`rocalphago_tpu.net` framing;
+* :mod:`~rocalphago_tpu.replaynet.server` — :class:`~rocalphago_tpu
+  .replaynet.server.ReplayService`: at-least-once ingestion made
+  effectively exactly-once (content-hash ``game_id`` dedup window,
+  ack only after the buffer accepts), structured ``overload``/
+  ``draining`` shedding with ``retry_after_s``, per-request fault
+  barriers ``replay.put``/``replay.take``/``replay.conn``, and a
+  graceful drain that leaves the buffer spilled for restart;
+* :mod:`~rocalphago_tpu.replaynet.client` — :class:`~rocalphago_tpu
+  .replaynet.client.ReplayClient` (deadline-bounded requests,
+  reconnect with deterministic-jitter backoff honoring
+  ``retry_after_s``, and DEGRADED MODE: games spool to a local
+  crash-safe WAL while the service is unreachable and re-ship in
+  order on reconnect) plus the learner-side
+  :class:`~rocalphago_tpu.replaynet.client.RemoteReplayBuffer`;
+* :mod:`~rocalphago_tpu.replaynet.actor` — the actor process
+  entrypoint (real self-play from saved model specs, or the
+  synthetic generator the chaos soak storms).
+
+Wire format, ack/dedup semantics, the degraded-mode state machine,
+probe schema and measured numbers: docs/REPLAYNET.md. Chaos
+verdicts: ``scripts/replay_soak.py``.
+"""
+
+from rocalphago_tpu.replaynet.protocol import PROTO_VERSION  # noqa: F401

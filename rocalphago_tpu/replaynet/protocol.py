@@ -1,0 +1,99 @@
+"""The replaynet wire protocol: NDJSON frames for game transport.
+
+Framing (sorted-key encoding, the frame-bound / torn-frame / blank-
+line reader rules) is the shared :mod:`rocalphago_tpu.net.protocol`
+core — this module pins the replay service's protocol CONTENT. The
+server speaks first (a ``hello`` carrying ``proto``, the record
+``schema`` it accepts and the buffer capacity — or a structured
+refusal when the service sheds at accept); after that the client
+drives request/response pairs correlated by ``id``:
+
+==============  ======================================================
+request         response
+==============  ======================================================
+``hello``       ``ok`` (optional; pins the protocol version — a
+                mismatch is ``bad_proto``)
+``put_games``   ``ok`` with the ``game_id`` and ``dup`` flag — sent
+                ONLY after the buffer accepted (and spilled) the
+                record, so an ack in hand means the game is durable
+                server-side; a retry of an already-ingested id acks
+                ``dup: true`` without re-inserting (errors:
+                ``bad_schema``, ``overload`` + ``retry_after_s``)
+``next_batch``  ``batch`` with the record and its buffer ``seq``, or
+                ``empty`` when nothing arrived within ``timeout_s``
+``stats``       ``stats`` with the service probe block
+                (docs/REPLAYNET.md schema)
+==============  ======================================================
+
+``put_games`` carries one schema-v2 game record
+(:func:`rocalphago_tpu.data.replay.games_to_record`) including its
+content-hash ``game_id`` — the identity every dedup decision keys
+on. Typed error codes are the refusal surface — a shed NEVER looks
+like a hang: ``overload`` (buffer full or connection cap) and
+``draining`` carry ``retry_after_s`` so actors back off into their
+spool instead of spinning. Frames are bounded at
+``ROCALPHAGO_REPLAYNET_MAX_FRAME`` bytes (default 8 MiB — a frame
+carries a whole game batch, not a genmove); a line over the bound
+is refused with ``frame_too_big`` and the connection drops.
+
+Schema and examples: docs/REPLAYNET.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+from rocalphago_tpu.data.replay import RECORD_SCHEMA
+from rocalphago_tpu.net import protocol as _net
+
+#: protocol revision carried in every hello; bumped on any frame
+#: schema change a deployed client could observe
+PROTO_VERSION = 1
+
+#: bound on one wire frame (bytes, newline included); env override.
+#: Replay frames carry whole game batches, so the default is 8 MiB
+#: where the gateway's is 64 KiB.
+MAX_FRAME_ENV = "ROCALPHAGO_REPLAYNET_MAX_FRAME"
+
+#: every error code a frame may carry (docs/REPLAYNET.md)
+ERROR_CODES = (
+    "bad_request",     # unparseable JSON / missing required field
+    "bad_proto",       # client hello pinned an unsupported version
+    "frame_too_big",   # line crossed the frame bound; connection drops
+    "unknown_type",    # message type outside the protocol table
+    "bad_schema",      # record schema newer than this server reads
+    "overload",        # shed (buffer/conn cap); retry_after_s set
+    "draining",        # server is drain-stopping; retry_after_s set
+    "internal",        # handler fault; this request failed, conn holds
+)
+
+ProtocolError = _net.ProtocolError
+
+encode_frame = _net.encode_frame
+
+
+def max_frame_bytes() -> int:
+    raw = os.environ.get(MAX_FRAME_ENV, "")
+    return int(raw) if raw else 8 << 20
+
+
+def read_frame(reader, limit: int | None = None):
+    """Next frame off a buffered binary reader, bounded at the
+    replaynet frame limit by default (shared reader rules:
+    :func:`rocalphago_tpu.net.protocol.read_frame`)."""
+    return _net.read_frame(
+        reader, max_frame_bytes() if limit is None else limit)
+
+
+def error_frame(code: str, msg: str, id=None,
+                retry_after_s: float | None = None) -> dict:
+    return _net.error_frame(code, msg, id=id,
+                            retry_after_s=retry_after_s,
+                            codes=ERROR_CODES)
+
+
+def hello_frame(capacity: int) -> dict:
+    return {"type": "hello", "proto": PROTO_VERSION,
+            "name": "rocalphago-replaynet",
+            "schema": RECORD_SCHEMA,
+            "capacity": int(capacity)}

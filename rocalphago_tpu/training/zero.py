@@ -786,6 +786,15 @@ def run_training(argv=None) -> dict:
                          "published params")
     ap.add_argument("--actors", type=int, default=1,
                     help="self-play actor threads (--actor-learner)")
+    ap.add_argument("--replay-connect", default=None,
+                    metavar="HOST:PORT",
+                    help="consume games from a networked replay "
+                         "service (docs/REPLAYNET.md) instead of "
+                         "in-process actors: implies "
+                         "--actor-learner with zero local actor "
+                         "threads — self-play comes from actor "
+                         "PROCESSES (rocalphago_tpu.replaynet"
+                         ".actor) shipping to the service")
     ap.add_argument("--replay-capacity", type=int, default=None,
                     help="replay buffer capacity in game batches "
                          "(default $ROCALPHAGO_REPLAY_CAPACITY or 8)")
@@ -991,7 +1000,8 @@ def run_training(argv=None) -> dict:
     # test pins.
     rig = None
     sup = None
-    if a.actor_learner:
+    publisher = None
+    if a.actor_learner or a.replay_connect:
         from rocalphago_tpu.data.replay import ReplayBuffer
         from rocalphago_tpu.runtime import supervisor as superv
         from rocalphago_tpu.training.actor import (
@@ -1001,7 +1011,33 @@ def run_training(argv=None) -> dict:
         )
         from rocalphago_tpu.training.learner import ZeroLearner
 
-        lockstep = a.actors == 1 and not a.replay_sample
+        lockstep = (a.actors == 1 and not a.replay_sample
+                    and not a.replay_connect)
+    if a.replay_connect:
+        # the wire rig: the learner consumes a remote replay service
+        # over RemoteReplayBuffer (FIFO over the wire; reconnect with
+        # backoff inside the client); actor processes ship to the
+        # service, so there is no in-process publisher — actors pin
+        # their own params version
+        from rocalphago_tpu.replaynet.client import (
+            RemoteReplayBuffer,
+            ReplayClient,
+        )
+
+        rhost, _, rport = a.replay_connect.rpartition(":")
+        buffer = RemoteReplayBuffer(
+            ReplayClient(rhost or "127.0.0.1", int(rport)))
+        gang = DispatchGang()
+        sup = superv.Supervisor(metrics=metrics)
+        learner = ZeroLearner(iteration.learn, buffer, gang=gang,
+                              sample=a.replay_sample, metrics=metrics)
+        sup.install_sigterm()
+        sup.start()
+        rig = (buffer, publisher, sup, learner)
+        metrics.log("actor_learner", actors=0, lockstep=False,
+                    remote=a.replay_connect, sample=a.replay_sample,
+                    supervised=True)
+    elif a.actor_learner:
         buffer = ReplayBuffer(
             capacity=a.replay_capacity,
             spill_dir=(os.path.join(a.out_dir, "replay")
@@ -1184,7 +1220,7 @@ def run_training(argv=None) -> dict:
                             metrics.log("ladder", iteration=it,
                                         opponent=snap[0], **lr)
                         faults.barrier("zero.post_gate", it)
-                if rig is not None:
+                if rig is not None and publisher is not None:
                     # version it+1 = exactly the pair the synchronous
                     # loop would hand iteration it+1 (post-gate best,
                     # or the fresh candidate without gating)

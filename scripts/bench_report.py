@@ -109,7 +109,11 @@ def render_table(records) -> str:
     actor/learner scale sweep (``bench_zero_scale.py``: ingest
     games/min and learner steps/s vs actor count — actors=0 is the
     synchronous baseline, whose self-play fraction stays in config as
-    ``selfplay_frac``; ``mesh_shape`` also stays in config). The
+    ``selfplay_frac``; ``mesh_shape`` also stays in config). The same
+    two columns key the wire-rig A/B (``bench_zero_scale.py --wire``:
+    ``zero_wire_*`` rows put actor PROCESSES behind replaynet — read
+    the learner-idle column against the in-process row at the same
+    actor count for the wire tax; docs/REPLAYNET.md). The
     board column keys multi-size sweeps (``bench_multisize.py``: one
     FCN checkpoint served per board size — read same-metric rows
     across boards for the size-scaling table). The cap-p and

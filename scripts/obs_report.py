@@ -400,6 +400,75 @@ def render_gateway(records, snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_replaynet(records, snap: dict) -> str:
+    """Replay service health (replaynet/server.py + client.py;
+    docs/REPLAYNET.md): connections accepted vs shed, the request/
+    error mix, ingest volume with the dup-hit tax, batches served,
+    the actor-side spool depth and reconnects, and the replaynet
+    drain timeline — 'did every game land exactly once and how hard
+    did the clients have to work for it' in one block."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    conns = {k: v for k, v in counters.items()
+             if k.startswith("replaynet_connections_total")}
+    reqs = {k: v for k, v in counters.items()
+            if k.startswith("replaynet_requests_total")}
+    errors = {k: v for k, v in counters.items()
+              if k.startswith("replaynet_errors_total")}
+    ingest = counters.get("replaynet_ingest_games_total")
+    drains = [r for r in records
+              if r.get("event") == "drain"
+              and str(r.get("phase", "")).startswith("replaynet_")]
+    if not (conns or reqs or errors or ingest or drains):
+        return "(no replaynet records)"
+    lines = []
+    if conns:
+        def count(result):
+            return conns.get(
+                f'replaynet_connections_total{{result="{result}"}}',
+                0)
+
+        live = gauges.get("replaynet_conns_live")
+        live_s = "" if live is None else f", {int(live)} live"
+        lines.append(f"connections: {count('accepted')} accepted, "
+                     f"{count('shed')} shed{live_s}")
+    if reqs:
+        lines.append("requests: " + "  ".join(
+            f"{k.split('type=', 1)[-1].strip(chr(34) + '{}')}={v}"
+            for k, v in sorted(reqs.items())))
+    if errors:
+        lines.append("errors: " + "  ".join(
+            f"{k.split('code=', 1)[-1].strip(chr(34) + '{}')}={v}"
+            for k, v in sorted(errors.items())))
+    if ingest is not None:
+        dups = counters.get("replaynet_dedup_hits_total", 0)
+        batches = counters.get("replaynet_batches_out_total", 0)
+        lines.append(f"ingest: {ingest} games ({dups} dup acks), "
+                     f"{batches} batches out")
+    shipped = counters.get("replaynet_shipped_games_total")
+    if shipped is not None:
+        spool = gauges.get("replaynet_spool_depth")
+        recon = counters.get("replaynet_reconnects_total", 0)
+        spool_s = "" if spool is None else f", spool depth {int(spool)}"
+        lines.append(f"clients: {shipped} games shipped, "
+                     f"{recon} reconnects{spool_s}")
+    if drains:
+        t0 = drains[0].get("time")
+        steps = []
+        for d in drains:
+            label = str(d.get("phase", "?"))
+            if d is drains[0] and d.get("reason"):
+                label += f" ({d['reason']})"
+            if d.get("live_conns") is not None:
+                label += f" ({d['live_conns']} live)"
+            t = d.get("time")
+            if d is not drains[0] and t0 is not None and t is not None:
+                label += f" +{float(t) - float(t0):.1f}s"
+            steps.append(label)
+        lines.append("drain: " + " → ".join(steps))
+    return "\n".join(lines)
+
+
 def _aux_trend(records) -> dict:
     """``head -> (first, last)`` aux-loss gauge values across the
     run's registry snapshots (gauges only keep the latest value, so
@@ -531,6 +600,8 @@ def report(records, top: int | None = None) -> str:
              render_fleet(records, reg or {}), "",
              "## gateway (connections / sheds / wire latency / drain)",
              "", render_gateway(records, reg or {}), "",
+             "## replaynet (ingest / dup acks / spool / drain)",
+             "", render_replaynet(records, reg or {}), "",
              "## self-play economics (cap split / sims saved / aux)",
              "", render_selfplay_econ(records, reg or {}), "",
              "## curriculum (per-stage ladder / transfer verdict)", "",
@@ -604,6 +675,14 @@ FIXTURE = [
      "time": 111.1},
     {"event": "drain", "phase": "gateway_drained", "live_conns": 0,
      "time": 111.6},
+    # the replay service's drain timeline (replaynet/server.py):
+    # same three-step shared core, replaynet_ prefix
+    {"event": "drain", "phase": "replaynet_requested",
+     "reason": "sigterm", "time": 112.0},
+    {"event": "drain", "phase": "replaynet_accept_stopped",
+     "time": 112.1},
+    {"event": "drain", "phase": "replaynet_drained", "live_conns": 0,
+     "time": 112.4},
     # an EARLY snapshot (iteration 0): only its aux_loss gauges matter
     # — the econ section walks every snapshot to render the trend;
     # every other section reads the last snapshot only
@@ -631,7 +710,18 @@ FIXTURE = [
                      'gateway_connections_total{result="shed"}': 3,
                      'gateway_requests_total{type="new_game"}': 9,
                      'gateway_requests_total{type="genmove"}': 40,
-                     'gateway_errors_total{code="overload"}': 3},
+                     'gateway_errors_total{code="overload"}': 3,
+                     'replaynet_connections_total{result="accepted"}':
+                         11,
+                     'replaynet_connections_total{result="shed"}': 1,
+                     'replaynet_requests_total{type="put_games"}': 30,
+                     'replaynet_requests_total{type="next_batch"}': 28,
+                     'replaynet_errors_total{code="overload"}': 2,
+                     "replaynet_ingest_games_total": 56,
+                     "replaynet_dedup_hits_total": 4,
+                     "replaynet_batches_out_total": 26,
+                     "replaynet_shipped_games_total": 56,
+                     "replaynet_reconnects_total": 5},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
                    'device_occupancy{runner="device_mcts"}': 0.983,
                    "replay_fill_games": 6,
@@ -641,7 +731,9 @@ FIXTURE = [
                    "selfplay_fullsearch_frac": 0.25,
                    'aux_loss{head="ownership"}': 0.41,
                    'aux_loss{head="score"}': 18.5,
-                   "gateway_conns_live": 0},
+                   "gateway_conns_live": 0,
+                   "replaynet_conns_live": 0,
+                   "replaynet_spool_depth": 3},
         "histograms": {"gtp_genmove_seconds": {
             "count": 42, "sum": 33.6,
             "buckets": {"0.5": 17, "1": 40, "2.5": 42,
@@ -701,6 +793,16 @@ def selftest() -> int:
               "drain: gateway_requested (sigterm) → "
               "gateway_accept_stopped +0.1s → "
               "gateway_drained (0 live) +0.6s",
+              "replaynet (ingest / dup acks / spool / drain)",
+              "connections: 11 accepted, 1 shed, 0 live",
+              "requests: next_batch=28  put_games=30",
+              "errors: overload=2",
+              "ingest: 56 games (4 dup acks), 26 batches out",
+              "clients: 56 games shipped, 5 reconnects, "
+              "spool depth 3",
+              "drain: replaynet_requested (sigterm) → "
+              "replaynet_accept_stopped +0.1s → "
+              "replaynet_drained (0 live) +0.4s",
               "self-play economics (cap split / sims saved / aux)",
               "searches: 25.0% full / 75.0% cheap",
               "sims: mean 14.0/move over 64 moves, "

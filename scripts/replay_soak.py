@@ -1,0 +1,419 @@
+"""Replay-service soak: the wire under kill storms, lossless.
+
+Runs a real multi-process rig — a :mod:`rocalphago_tpu.replaynet`
+service SUBPROCESS (crash-safe spill + dedup window), N synthetic
+actor SUBPROCESSES (spool-first WAL shipping, degraded mode), and an
+in-harness consumer draining ``next_batch`` — and storms it:
+
+* **wire-barrier kills** — a probabilistic plan arms all three
+  service barriers (``replay.put`` / ``replay.take`` /
+  ``replay.conn``; docs/RESILIENCE.md): connections abort
+  mid-request, clients reconnect with backoff and re-ship, the
+  dedup window absorbs every retry;
+* **whole-actor kills** — SIGKILL at arbitrary points, restart with
+  the same spool dir: the actor resumes from ``acked ∪ spooled``
+  and regenerates at most the one game that never reached its WAL
+  (to the SAME content hash, by construction);
+* **service restarts** — SIGTERM mid-traffic: graceful drain
+  (in-flight requests finish, dedup window persists, unconsumed
+  entries stay spilled), exit 0, restart restores buffer AND
+  window; actors spool through the downtime and re-ship in order.
+
+The verdict is exact-set equality, not statistics: every game id
+each actor DURABLY produced (its acked ledger ∪ remaining spool —
+which the harness also recomputes independently from the synthetic
+generator's determinism) must equal the set the consumer took off
+the wire. No loss, no duplicates, zero unhandled handler escapes,
+and a clean final drain (``replaynet_requested`` →
+``replaynet_accept_stopped`` → ``replaynet_drained`` in the service
+metrics, final exit 0).
+
+Tier-1 smoke: ``tests/test_replaynet.py`` runs this with small
+floors; the @slow soak runs the defaults (≥10 barrier kills, every
+barrier hit, ≥1 actor kill, ≥1 service restart).
+
+Usage::
+
+    python scripts/replay_soak.py --out /tmp/replay_soak
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default=None,
+                    help="run dir for logs + summary.json "
+                    "(default: a fresh temp dir)")
+    ap.add_argument("--actors", type=int, default=3)
+    ap.add_argument("--games", type=int, default=12,
+                    help="games per actor per spawn (targets grow "
+                    "when the storm needs more put traffic)")
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--plies", type=int, default=4)
+    ap.add_argument("--board", type=int, default=5)
+    ap.add_argument("--capacity", type=int, default=8,
+                    help="service buffer capacity (small enough "
+                    "that overload shedding happens)")
+    ap.add_argument("--rate-s", type=float, default=0.1,
+                    help="actor pacing between games")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--p-put", type=float, default=0.12,
+                    help="kill probability at replay.put")
+    ap.add_argument("--p-take", type=float, default=0.12,
+                    help="kill probability at replay.take")
+    ap.add_argument("--p-conn", type=float, default=0.04,
+                    help="kill probability at replay.conn")
+    ap.add_argument("--plan", default=None,
+                    help="override the whole fault plan verbatim")
+    ap.add_argument("--min-kills", type=int, default=10,
+                    help="total barrier-kill floor across the storm")
+    ap.add_argument("--min-barrier-kills", type=int, default=1,
+                    help="per-barrier kill floor (each of put/take/"
+                    "conn)")
+    ap.add_argument("--min-actor-kills", type=int, default=1)
+    ap.add_argument("--min-service-restarts", type=int, default=1)
+    ap.add_argument("--chaos-interval-s", type=float, default=3.0,
+                    help="seconds between actor-kill / service-"
+                    "restart actions")
+    ap.add_argument("--deadline-s", type=float, default=240.0,
+                    help="hard wall-clock bound on the storm phase")
+    ap.add_argument("--drain-s", type=float, default=8.0,
+                    help="service drain grace per restart")
+    return ap
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+    out_dir = args.out or tempfile.mkdtemp(prefix="replay_soak_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from rocalphago_tpu.data.replay import compute_game_id
+    from rocalphago_tpu.replaynet.actor import synth_games
+    from rocalphago_tpu.replaynet.client import ReplayClient, ReplayConn
+    from rocalphago_tpu.runtime import faults
+
+    plan = (args.plan if args.plan is not None else
+            f"kill@replay.put:p={args.p_put}:seed={args.seed},"
+            f"kill@replay.take:p={args.p_take}:seed={args.seed + 1},"
+            f"kill@replay.conn:p={args.p_conn}:seed={args.seed + 2}")
+    port = _free_port()
+    spill_dir = os.path.join(out_dir, "spill")
+    service_metrics = os.path.join(out_dir, "service.metrics.jsonl")
+    service_log = open(os.path.join(out_dir, "service.log"), "ab")
+    actor_log = open(os.path.join(out_dir, "actors.log"), "ab")
+    total_target = args.actors * args.games  # grows with respawns
+
+    # ------------------------------------------------- subprocesses
+
+    def start_service(fault_plan: str) -> subprocess.Popen:
+        env = dict(os.environ)
+        if fault_plan:
+            env[faults.FAULT_PLAN_ENV] = fault_plan
+        else:
+            env.pop(faults.FAULT_PLAN_ENV, None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "rocalphago_tpu.replaynet.server",
+             "--host", "127.0.0.1", "--port", str(port),
+             "--spill-dir", spill_dir,
+             "--capacity", str(args.capacity),
+             "--dedup-window", str(max(4096, 4 * total_target)),
+             "--drain-s", str(args.drain_s),
+             "--metrics", service_metrics],
+            env=env, cwd=REPO_ROOT,
+            stdout=service_log, stderr=service_log)
+        # wait until it serves (reads the hello)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replay service died at start "
+                    f"(rc={proc.returncode}); see service.log")
+            try:
+                ReplayConn("127.0.0.1", port, timeout=1.0).close()
+                return proc
+            except Exception:  # noqa: BLE001 — not up yet
+                time.sleep(0.1)
+        raise RuntimeError("replay service never came up")
+
+    targets = {i: args.games for i in range(args.actors)}
+
+    def spawn_actor(i: int) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "rocalphago_tpu.replaynet.actor",
+             "--connect", f"127.0.0.1:{port}",
+             "--spool-dir", os.path.join(out_dir, f"actor{i}"),
+             "--actor-id", str(i), "--games", str(targets[i]),
+             "--mode", "synthetic", "--seed", str(args.seed),
+             "--batch", str(args.batch), "--plies", str(args.plies),
+             "--board", str(args.board),
+             "--rate-s", str(args.rate_s),
+             "--attempts", "3", "--flush-timeout", "20"],
+            cwd=REPO_ROOT, stdout=actor_log, stderr=actor_log)
+
+    def fetch_stats(tries: int = 5) -> dict | None:
+        """One stats frame off the live service; None when every try
+        was eaten (e.g. by replay.conn kills)."""
+        for _ in range(tries):
+            try:
+                conn = ReplayConn("127.0.0.1", port, timeout=2.0)
+                try:
+                    return conn.request({"type": "stats"})["replaynet"]
+                finally:
+                    conn.close()
+            except Exception:  # noqa: BLE001 — killed/draining: retry
+                time.sleep(0.1)
+        return None
+
+    # ---------------------------------------------------- consumer
+    taken: set[str] = set()
+    taken_lock = threading.Lock()
+    batches = {"n": 0}
+    stop = threading.Event()
+
+    def consume() -> None:
+        while not stop.is_set():
+            conn = None
+            try:
+                conn = ReplayConn("127.0.0.1", port, timeout=8.0)
+                while not stop.is_set():
+                    reply = conn.request({"type": "next_batch",
+                                          "timeout_s": 1.0})
+                    if reply.get("type") != "batch":
+                        continue
+                    gid = str(reply["record"].get("game_id", ""))
+                    with taken_lock:
+                        if gid:
+                            taken.add(gid)
+                        batches["n"] += 1
+            except Exception:  # noqa: BLE001 — kill/drain: reconnect
+                time.sleep(0.1)
+            finally:
+                if conn is not None:
+                    conn.close()
+
+    # ------------------------------------------------------ storm
+    service = start_service(plan)
+    actors = {i: spawn_actor(i) for i in range(args.actors)}
+    consumer = threading.Thread(target=consume, name="soak-consumer")
+    consumer.start()
+
+    closed_segments: list[dict] = []   # last stats of dead services
+    latest: dict | None = None
+    actor_kills = 0
+    service_restarts = 0
+    clean_rcs: list[int] = []
+
+    def kill_totals() -> dict:
+        segs = closed_segments + ([latest] if latest else [])
+        out = {"kills": 0, "put_kills": 0, "take_kills": 0,
+               "conn_kills": 0, "unhandled": 0}
+        for s in segs:
+            f = s.get("faults", {})
+            out["kills"] += f.get("kills", 0)
+            out["put_kills"] += f.get("put_kills", 0)
+            out["take_kills"] += f.get("take_kills", 0)
+            out["conn_kills"] += f.get("conn_kills", 0)
+            out["unhandled"] += s.get("requests", {}).get(
+                "unhandled", 0)
+        return out
+
+    def floors_met(t: dict) -> bool:
+        return (t["kills"] >= args.min_kills
+                and t["put_kills"] >= args.min_barrier_kills
+                and t["take_kills"] >= args.min_barrier_kills
+                and t["conn_kills"] >= args.min_barrier_kills
+                and actor_kills >= args.min_actor_kills
+                and service_restarts >= args.min_service_restarts)
+
+    def restart_service(fault_plan: str, reason: str) -> None:
+        nonlocal service, service_restarts, latest
+        snap = fetch_stats()
+        if snap is not None:
+            latest = snap
+        if latest is not None:
+            closed_segments.append(latest)
+            latest = None
+        service.send_signal(signal.SIGTERM)
+        try:
+            rc = service.wait(timeout=args.drain_s + 20.0)
+        except subprocess.TimeoutExpired:
+            service.kill()
+            rc = service.wait()
+        clean_rcs.append(rc)
+        service = start_service(fault_plan)
+        service_restarts += 1
+
+    t0 = time.monotonic()
+    next_chaos = t0 + args.chaos_interval_s
+    toggle = 0
+    rc = 0
+    try:
+        while time.monotonic() - t0 < args.deadline_s:
+            snap = fetch_stats(tries=2)
+            if snap is not None:
+                latest = snap
+            totals = kill_totals()
+            if floors_met(totals):
+                break
+            # keep put traffic flowing: a finished actor respawns
+            # with a bigger target (the expected set grows with it)
+            for i, p in actors.items():
+                if p.poll() is not None:
+                    targets[i] += args.games
+                    total_target = sum(targets.values())
+                    actors[i] = spawn_actor(i)
+            now = time.monotonic()
+            if now >= next_chaos:
+                next_chaos = now + args.chaos_interval_s
+                if (toggle % 2 == 0
+                        or service_restarts
+                        >= args.min_service_restarts):
+                    live = [i for i, p in actors.items()
+                            if p.poll() is None]
+                    if live:
+                        i = live[toggle % len(live)]
+                        actors[i].send_signal(signal.SIGKILL)
+                        actors[i].wait()
+                        actor_kills += 1
+                        actors[i] = spawn_actor(i)  # resumes
+                else:
+                    restart_service(plan, reason="storm")
+                toggle += 1
+            time.sleep(0.3)
+
+        # --------------------------------------- clean final phase
+        # fault-free service incarnation; actors finish and drain
+        # their spools; the consumer empties the buffer
+        restart_service("", reason="clean_phase")
+        expected = {
+            compute_game_id(synth_games(
+                args.seed, i, k, batch=args.batch,
+                plies=args.plies, board=args.board))
+            for i, tgt in targets.items() for k in range(tgt)}
+        drain_deadline = time.monotonic() + 120.0
+        while time.monotonic() < drain_deadline:
+            for i, p in actors.items():
+                if p.poll() is not None and p.returncode != 0:
+                    # rc 2 = spool still held games (service was
+                    # down): one clean respawn drains it
+                    actors[i] = spawn_actor(i)
+            with taken_lock:
+                done = expected <= taken
+            if done and all(p.poll() == 0
+                            for p in actors.values()):
+                break
+            time.sleep(0.3)
+        actor_rcs = {i: p.poll() for i, p in actors.items()}
+    finally:
+        stop.set()
+        consumer.join(timeout=30.0)
+        for p in actors.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        final_stats = fetch_stats()
+        if final_stats is not None:
+            latest = final_stats
+        service.send_signal(signal.SIGTERM)
+        try:
+            final_rc = service.wait(timeout=args.drain_s + 20.0)
+        except subprocess.TimeoutExpired:
+            service.kill()
+            final_rc = service.wait()
+        service_log.close()
+        actor_log.close()
+
+    # ---------------------------------------------------- verdict
+    if latest is not None:
+        closed_segments.append(latest)
+        latest = None
+    totals = kill_totals()
+    produced: set[str] = set()
+    for i in range(args.actors):
+        spool = os.path.join(out_dir, f"actor{i}")
+        c = ReplayClient("127.0.0.1", port, spool_dir=spool)
+        produced |= c.produced_ids()
+    drain_phases = set()
+    try:
+        with open(service_metrics, encoding="utf-8") as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("event") == "drain":
+                    drain_phases.add(ev.get("phase"))
+    except OSError:
+        pass
+    summary = {
+        "plan": plan,
+        "expected_games": len(expected),
+        "produced_games": len(produced),
+        "taken_games": len(taken),
+        "taken_batches": batches["n"],
+        "actor_targets": targets,
+        "actor_rcs": actor_rcs,
+        "actor_kills": actor_kills,
+        "service_restarts": service_restarts,
+        "service_clean_rcs": clean_rcs,
+        "service_final_rc": final_rc,
+        **totals,
+        "drain_phases": sorted(p for p in drain_phases if p),
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    checks = {
+        "produced_matches_expected": produced == expected,
+        "taken_matches_produced": taken == produced,
+        "min_kills": totals["kills"] >= args.min_kills,
+        "put_kills": totals["put_kills"] >= args.min_barrier_kills,
+        "take_kills": totals["take_kills"] >= args.min_barrier_kills,
+        "conn_kills": totals["conn_kills"] >= args.min_barrier_kills,
+        "actor_kills": actor_kills >= args.min_actor_kills,
+        "service_restarts": (service_restarts
+                             >= args.min_service_restarts),
+        "actors_exited_clean": all(v == 0
+                                   for v in actor_rcs.values()),
+        "no_unhandled": totals["unhandled"] == 0,
+        "service_exits_clean": (final_rc == 0
+                                and all(r == 0 for r in clean_rcs)),
+        "drain_clean": {"replaynet_requested",
+                        "replaynet_accept_stopped",
+                        "replaynet_drained"} <= drain_phases,
+    }
+    summary["checks"] = checks
+    with open(os.path.join(out_dir, "summary.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2))
+    if not all(checks.values()):
+        failed = [k for k, v in checks.items() if not v]
+        print(f"replay_soak: FAILED checks: {failed}",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,7 @@ from rocalphago_tpu.gateway.client import (
     GatewayClosed,
     GatewayError,
     GatewayRefused,
+    connect_with_retry,
     run_load,
 )
 from rocalphago_tpu.gateway.server import GatewayServer
@@ -408,6 +409,42 @@ def test_connection_cap_sheds_with_retry_hint(pool):
         settle(srv)
         assert srv.stats()["conns"]["accepted"] == 2
     finally:
+        srv.close()
+
+
+def test_connect_with_retry_rides_out_a_shed(pool):
+    """ISSUE 17 satellite: a client shed at accept backs off AT
+    LEAST the server's ``retry_after_s`` (not just the jitter
+    floor) and is admitted on a later attempt once a slot frees —
+    the injectable sleep doubles as the slot-freeing hook, so the
+    test asserts the schedule instead of waiting it out."""
+    srv = GatewayServer(pool, max_conns=1).start()
+    hog = GatewayClient("127.0.0.1", srv.port)
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        hog.close()
+        settle(srv)
+
+    try:
+        c = connect_with_retry("127.0.0.1", srv.port, attempts=4,
+                               base_delay=0.01, max_delay=0.05,
+                               sleep=sleep)
+        c.close()
+        # exactly one shed round, floored by the refusal's hint
+        # (jitter alone tops out at max_delay=0.05 here)
+        assert len(sleeps) == 1 and sleeps[0] >= 1.0
+        settle(srv)
+        assert srv.stats()["conns"]["shed"] == 1
+        assert srv.stats()["conns"]["accepted"] == 2
+        # and a dead port still propagates the final failure
+        with pytest.raises(OSError):
+            connect_with_retry("127.0.0.1", 1, attempts=2,
+                               base_delay=0.01, max_delay=0.02,
+                               timeout=1.0, sleep=lambda s: None)
+    finally:
+        hog.close()
         srv.close()
 
 

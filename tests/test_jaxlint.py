@@ -779,6 +779,61 @@ class ServePool:
         assert "queue.depth" in msgs
 
 
+REPLAYNET_DOC = '''
+## Probe
+
+```json
+{"replaynet": {
+  "draining": false,
+  "ingest": {"puts": 4, "dup_hits": 1},
+  "buffer": {"fill": 2}}}
+```
+'''
+
+
+class TestReplaynetProbeRule:
+    """ISSUE 17: the ``replaynet`` stats block is the soak's
+    green-gate schema — same both-direction drift contract as the
+    serve/gateway probes."""
+
+    CFG = dict(replaynet_probe_module="<fixture>.py",
+               docs_replaynet="docs/REPLAYNET.md")
+    DOCS = {"docs/REPLAYNET.md": REPLAYNET_DOC}
+
+    def test_matching_schema_is_clean(self):
+        src = """
+class ReplayService:
+    def stats(self):
+        return {
+            "draining": self._draining,
+            "ingest": {"puts": self._puts,
+                       "dup_hits": self._dup_hits},
+            "buffer": {"fill": self.buffer.fill},
+        }
+"""
+        fs = [f for f in lint_source(src, config=LintConfig(**self.CFG),
+                                     docs=self.DOCS)
+              if f.rule == "replaynet-probe-drift"]
+        assert fs == []
+
+    def test_drift_fires_both_directions(self):
+        src = """
+class ReplayService:
+    def stats(self):
+        return {
+            "draining": self._draining,
+            "ingest": {"puts": self._puts, "rogue": 1},
+            "buffer": {"fill": self.buffer.fill},
+        }
+"""
+        fs = [f for f in lint_source(src, config=LintConfig(**self.CFG),
+                                     docs=self.DOCS)
+              if f.rule == "replaynet-probe-drift"]
+        msgs = " | ".join(f.message for f in fs)
+        assert "ingest.rogue" in msgs        # emitted, undocumented
+        assert "ingest.dup_hits" in msgs     # documented, unproduced
+
+
 # ----------------------------------------------- suppression + baseline
 
 
@@ -895,7 +950,8 @@ baseline = ".b.json"
                     "stale-metric-doc", "undocumented-span",
                     "undocumented-barrier", "stale-barrier-doc",
                     "knob-doc-drift", "report-unknown-metric",
-                    "serve-probe-drift", "unguarded-attr-access",
+                    "serve-probe-drift", "gateway-probe-drift",
+                    "replaynet-probe-drift", "unguarded-attr-access",
                     "guarded-by-unknown-lock", "lock-order-inversion",
                     "blocking-call-under-lock", "callback-under-lock",
                     "thread-no-join"):

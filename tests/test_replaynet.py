@@ -1,0 +1,428 @@
+"""The networked replay service (docs/REPLAYNET.md).
+
+Tier-1 units for ISSUE 17's lossless wire: ack-after-accept and the
+dedup window (exactly-once over at-least-once shipping), typed
+overload/draining refusals with ``retry_after_s``, the client's
+degraded-mode WAL spool + in-order re-ship, restart recovery
+(buffer AND dedup window from the spill), the synthetic actor's
+deterministic content hashes and resume, and a small kill-storm run
+of ``scripts/replay_soak.py``. The multi-minute storm with default
+floors is @slow. All jax-free (the replaynet import chain carries
+no jax on purpose — see the soak's process budget).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from rocalphago_tpu.data import replay
+from rocalphago_tpu.replaynet import protocol
+from rocalphago_tpu.replaynet.actor import synth_games
+from rocalphago_tpu.replaynet.client import (
+    RemoteReplayBuffer,
+    ReplayClient,
+    ReplayConn,
+    ReplayError,
+    ReplayRefused,
+)
+from rocalphago_tpu.replaynet.server import ReplayService
+from rocalphago_tpu.runtime import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+nosleep = lambda s: None  # noqa: E731 — tests never wait out backoff
+
+
+def make_games(seed=0, t=3, b=2, a=26):
+    r = np.random.default_rng(seed)
+    return replay.ZeroGames(
+        actions=r.integers(0, a, (t, b)).astype(np.int32),
+        live=r.integers(0, 2, (t, b)).astype(bool),
+        visits=r.integers(0, 5, (t, b, a)).astype(np.int32),
+        winners=r.integers(-1, 2, (b,)).astype(np.int32),
+        finished=r.integers(0, 2, (b,)).astype(bool),
+    )
+
+
+@pytest.fixture
+def service():
+    svc = ReplayService(capacity=4).start()
+    yield svc
+    svc.close()
+
+
+def client_for(svc, **kw):
+    kw.setdefault("sleep", nosleep)
+    kw.setdefault("attempts", 2)
+    return ReplayClient("127.0.0.1", svc.port, **kw)
+
+
+# ------------------------------------------------------ wire basics
+
+
+def test_hello_then_put_ack_then_batch_roundtrip(service):
+    with client_for(service) as c:
+        games = make_games(3)
+        gid = c.put_games(games, version=5)
+        assert gid == replay.compute_game_id(games)
+        reply = c.next_batch()
+        assert reply["record"]["game_id"] == gid
+        got, version = replay.record_to_games(reply["record"])
+        assert version == 5
+        assert np.array_equal(got.actions, games.actions)
+        assert c.next_batch(timeout_s=0.0) is None   # now empty
+    st = service.stats()
+    assert st["ingest"] == {"puts": 1, "games": 2, "dup_hits": 0,
+                            "refused": 0}
+    assert st["takes"]["batches"] == 1
+    assert st["takes"]["empties"] == 1
+    assert st["requests"]["unhandled"] == 0
+
+
+def test_duplicate_put_acks_dup_without_reinserting(service):
+    with client_for(service) as c:
+        games = make_games(4)
+        c.put_games(games)
+        c.put_games(games)        # at-least-once retry, same content
+        assert c.dup_acks == 1
+        st = c.stats()
+        assert st["ingest"]["puts"] == 1
+        assert st["ingest"]["dup_hits"] == 1
+        assert st["buffer"]["fill"] == 1
+        assert st["dedup_window"]["size"] == 1
+
+
+def test_full_buffer_refuses_with_retry_hint():
+    svc = ReplayService(capacity=1).start()
+    try:
+        with client_for(svc) as c:
+            c.put_games(make_games(0))
+            with pytest.raises(ReplayRefused) as ei:
+                c.put_games(make_games(1))
+            assert ei.value.code == "overload"
+            assert ei.value.retry_after_s == 1.0
+        st = svc.stats()
+        assert st["ingest"]["refused"] >= 1
+        # the refused id was released from the window: the game is
+        # NOT falsely remembered as ingested
+        assert st["dedup_window"]["size"] == 1
+    finally:
+        svc.close()
+
+
+def test_evict_mode_slides_the_window_instead_of_refusing():
+    svc = ReplayService(capacity=1, evict=True).start()
+    try:
+        with client_for(svc) as c:
+            c.put_games(make_games(0))
+            c.put_games(make_games(1))     # evicts, never refuses
+            st = c.stats()
+        assert st["ingest"]["puts"] == 2
+        assert st["ingest"]["refused"] == 0
+        assert st["buffer"]["fill"] == 1
+    finally:
+        svc.close()
+
+
+def test_typed_errors_bad_schema_unknown_type_bad_proto(service):
+    conn = ReplayConn("127.0.0.1", service.port, timeout=5.0)
+    try:
+        assert conn.hello["proto"] == protocol.PROTO_VERSION
+        assert conn.hello["schema"] == replay.RECORD_SCHEMA
+        rec = replay.games_to_record(make_games(0), 0)
+        rec["schema"] = replay.RECORD_SCHEMA + 1
+        with pytest.raises(ReplayError) as ei:
+            conn.request({"type": "put_games", "record": rec})
+        assert ei.value.code == "bad_schema"
+        with pytest.raises(ReplayError) as ei:
+            conn.request({"type": "put_games", "record": "nope"})
+        assert ei.value.code == "bad_request"
+        with pytest.raises(ReplayError) as ei:
+            conn.request({"type": "genmove"})
+        assert ei.value.code == "unknown_type"
+        with pytest.raises(ReplayError) as ei:
+            conn.request({"type": "hello",
+                          "proto": protocol.PROTO_VERSION + 1})
+        assert ei.value.code == "bad_proto"
+        # after four typed refusals the connection still works
+        ok = conn.request({"type": "hello",
+                           "proto": protocol.PROTO_VERSION})
+        assert ok["type"] == "ok"
+    finally:
+        conn.close()
+    assert service.stats()["requests"]["unhandled"] == 0
+
+
+def test_injected_transient_fails_request_not_connection(service):
+    faults.install("io_error@replay.put:1")
+    try:
+        with client_for(service, attempts=3) as c:
+            gid = c.put_games(make_games(9))   # retried past the fault
+        st = service.stats()
+        assert st["faults"]["injected"] == 1
+        assert st["ingest"]["puts"] == 1
+        assert gid
+    finally:
+        faults.install("")
+
+
+def test_injected_kill_aborts_connection_and_retry_dedups(service):
+    faults.install("kill@replay.put:1")
+    try:
+        with client_for(service, attempts=3) as c:
+            c.put_games(make_games(10))
+            assert c.reconnects == 1
+        st = service.stats()
+        assert st["faults"]["put_kills"] == 1
+        assert st["ingest"]["puts"] == 1
+        assert st["requests"]["unhandled"] == 0
+    finally:
+        faults.install("")
+
+
+# --------------------------------------------------- degraded mode
+
+
+def test_spool_wal_survives_outage_and_flushes_in_order(tmp_path):
+    spool = str(tmp_path / "wal")
+    # nothing listens here yet: every ship attempt fails fast
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    c = ReplayClient("127.0.0.1", port, spool_dir=spool,
+                     attempts=2, sleep=nosleep, timeout=2.0)
+    gids = [c.put_games(make_games(i), version=i) for i in range(3)]
+    assert c.degraded and c.spool_depth == 3
+    assert c.produced_ids() == set(gids)
+    # the service comes up on that exact port; flush ships FIFO
+    svc = ReplayService(host="127.0.0.1", port=port,
+                        capacity=8).start()
+    try:
+        assert c.flush() == 3
+        assert not c.degraded and c.spool_depth == 0
+        assert c.produced_ids() == set(gids)       # now all acked
+        for want in range(3):
+            got = c.next_batch()
+            assert got["record"]["version"] == want  # FIFO preserved
+    finally:
+        c.close()
+        svc.close()
+
+
+def test_spool_resume_after_crash_reships_only_unacked(tmp_path):
+    spool = str(tmp_path / "wal")
+    svc = ReplayService(capacity=8).start()
+    try:
+        c = ReplayClient("127.0.0.1", svc.port, spool_dir=spool,
+                         attempts=2, sleep=nosleep)
+        g0, g1 = make_games(0), make_games(1)
+        c.put_games(g0)
+        c.put_games(g1)
+        assert c.spool_depth == 0
+        # crash window 1: ledger appended but unlink lost — recreate
+        # the spool file; a resumed client must unlink, not re-ship
+        rec0 = replay.games_to_record(
+            g0, 0, game_id=replay.compute_game_id(g0))
+        with open(os.path.join(spool, "game.00000007.json"),
+                  "w", encoding="utf-8") as f:
+            json.dump(rec0, f)
+        # crash window 2: the ship REACHED the server but the actor
+        # died before the ack landed in its ledger — the spool file
+        # remains, and the SERVER's dedup window absorbs the re-ship
+        g2 = make_games(2)
+        rec2 = replay.games_to_record(
+            g2, 0, game_id=replay.compute_game_id(g2))
+        with client_for(svc) as other:
+            other.put_games(g2)
+        with open(os.path.join(spool, "game.00000008.json"),
+                  "w", encoding="utf-8") as f:
+            json.dump(rec2, f)
+        c.close()
+        c2 = ReplayClient("127.0.0.1", svc.port, spool_dir=spool,
+                          attempts=2, sleep=nosleep)
+        assert c2._spool_next == 9      # indices resume past the WAL
+        assert c2.flush() == 1          # only the unacked window 2
+        assert c2.dup_acks == 1         # ...and the server deduped it
+        assert c2.spool_depth == 0
+        assert svc.stats()["ingest"]["puts"] == 3   # g2 once, ever
+        c2.close()
+    finally:
+        svc.close()
+
+
+def test_torn_spool_entry_is_dropped_not_fatal(tmp_path, service):
+    spool = str(tmp_path / "wal")
+    os.makedirs(spool)
+    with open(os.path.join(spool, "game.00000000.json"), "w",
+              encoding="utf-8") as f:
+        f.write('{"torn')           # crashed mid-write (pre-rename
+        #                             copies never look like this;
+        #                             belt and braces anyway)
+    c = client_for(service, spool_dir=spool)
+    assert c.flush() == 0
+    assert c.spool_depth == 0
+    c.close()
+
+
+# ----------------------------------------------- restart + recover
+
+
+def test_restart_restores_buffer_and_dedup_window(tmp_path):
+    spill = str(tmp_path / "spill")
+    svc = ReplayService(capacity=8, spill_dir=spill).start()
+    games = [make_games(i) for i in range(3)]
+    with client_for(svc) as c:
+        gids = [c.put_games(g, version=i)
+                for i, g in enumerate(games)]
+    svc.drain(reason="test")
+    svc.buffer.close()
+    assert os.path.exists(os.path.join(spill, "dedup.json"))
+    svc2 = ReplayService(capacity=8, spill_dir=spill)
+    assert svc2.recover() == 3
+    svc2.start()
+    try:
+        with client_for(svc2) as c:
+            # the old incarnation's acks still dedup
+            c.put_games(games[1], version=1)
+            assert c.dup_acks == 1
+            for i, gid in enumerate(gids):      # FIFO across restart
+                reply = c.next_batch()
+                assert reply["record"]["game_id"] == gid
+                assert reply["record"]["version"] == i
+        st = svc2.stats()
+        assert st["ingest"]["puts"] == 0        # nothing re-ingested
+        assert st["dedup_window"]["size"] == 3
+    finally:
+        svc2.close()
+
+
+def test_drain_refuses_new_puts_with_typed_frame(service):
+    with client_for(service) as c:
+        c.put_games(make_games(0))
+        service.drain(reason="test")
+        with pytest.raises((ReplayError, OSError)) as ei:
+            c._request({"type": "put_games",
+                        "record": replay.games_to_record(
+                            make_games(1), 0)},
+                       key="replaynet.put")
+        if isinstance(ei.value, ReplayError):
+            assert ei.value.code in ("draining", "internal")
+
+
+# --------------------------------------------------- learner adapter
+
+
+def test_remote_replay_buffer_duck_types_for_the_learner(service):
+    with client_for(service) as c:
+        games = make_games(2)
+        c.put_games(games, version=7)
+        rbuf = RemoteReplayBuffer(client_for(service))
+        entry = rbuf.next_batch(timeout=1.0)
+        assert entry.version == 7
+        assert np.array_equal(entry.games.visits, games.visits)
+        assert rbuf.sample(timeout=0.0) is None     # drained
+        rbuf.close()
+        assert rbuf.closed
+        assert rbuf.next_batch() is None            # closed -> None
+
+
+def test_remote_buffer_turns_outage_into_empty(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rbuf = RemoteReplayBuffer(
+        ReplayClient("127.0.0.1", port, attempts=2,
+                     sleep=nosleep, timeout=1.0))
+    assert rbuf.next_batch(timeout=0.0) is None
+    rbuf.close()
+
+
+# ------------------------------------------------- synthetic actor
+
+
+def test_synth_games_content_hash_is_deterministic():
+    a = synth_games(7, 1, 3, batch=2, plies=4, board=5)
+    b = synth_games(7, 1, 3, batch=2, plies=4, board=5)
+    assert replay.compute_game_id(a) == replay.compute_game_id(b)
+    c = synth_games(7, 1, 4, batch=2, plies=4, board=5)
+    assert replay.compute_game_id(a) != replay.compute_game_id(c)
+    assert a.visits.shape == (4, 2, 26)
+
+
+def test_actor_cli_ships_and_resume_is_idempotent(tmp_path, service):
+    from rocalphago_tpu.replaynet import actor
+
+    spool = str(tmp_path / "a0")
+    argv = ["--connect", f"127.0.0.1:{service.port}",
+            "--spool-dir", spool, "--actor-id", "0",
+            "--games", "3", "--mode", "synthetic", "--seed", "5"]
+    assert actor.main(argv) == 0
+    st = service.stats()
+    assert st["ingest"]["puts"] == 3
+    assert st["ingest"]["games"] == 6          # batch 2
+    # a restarted actor resumes from acked ∪ spool: nothing re-ships
+    assert actor.main(argv) == 0
+    st = service.stats()
+    assert st["ingest"]["puts"] == 3
+    assert st["ingest"]["dup_hits"] == 0       # resume, not re-ship
+    c = ReplayClient("127.0.0.1", service.port, spool_dir=spool)
+    assert len(c.produced_ids()) == 3
+    c.close()
+
+
+# ------------------------------------------------------------- soak
+
+
+def run_soak(tmp_path, extra):
+    out_dir = str(tmp_path / "soak")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "replay_soak.py"),
+         "--out", out_dir, *extra],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=REPO, capture_output=True, text=True, timeout=560)
+    return proc, os.path.join(out_dir, "summary.json")
+
+
+def check_soak(proc, out):
+    assert proc.returncode == 0, \
+        f"soak failed:\n{proc.stdout}\n{proc.stderr}"
+    with open(out) as f:
+        summary = json.load(f)
+    assert all(summary["checks"].values()), summary["checks"]
+    assert summary["taken_games"] == summary["produced_games"] \
+        == summary["expected_games"] > 0
+    assert summary["unhandled"] == 0
+    return summary
+
+
+def test_replay_soak_smoke(tmp_path):
+    """The kill storm, sized for the fast tier: kills at all three
+    wire barriers, one whole-actor SIGKILL + resume, one SIGTERM
+    service restart with spill recovery, and the exact-set
+    produced == taken green gate."""
+    proc, out = run_soak(tmp_path, [
+        "--actors", "2", "--games", "6", "--p-put", "0.3",
+        "--p-take", "0.3", "--p-conn", "0.1", "--min-kills", "3",
+        "--chaos-interval-s", "2", "--deadline-s", "120",
+        "--drain-s", "5"])
+    summary = check_soak(proc, out)
+    assert summary["kills"] >= 3
+    assert summary["actor_kills"] >= 1
+    assert summary["service_restarts"] >= 1
+
+
+@pytest.mark.slow
+def test_replay_soak_full(tmp_path):
+    proc, out = run_soak(tmp_path, [])
+    summary = check_soak(proc, out)
+    assert summary["kills"] >= 10
+    for k in ("put_kills", "take_kills", "conn_kills"):
+        assert summary[k] >= 1
