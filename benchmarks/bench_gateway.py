@@ -84,6 +84,13 @@ def main():
     ap.add_argument("--slo-ms", type=float, default=None,
                     help="per-genmove SLO the gateway arms (default "
                          "off: pure throughput A/B)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="when > 0, add a third arm: the same "
+                         "traffic through a RolloutRouter federating "
+                         "this many gateway replicas (every replica "
+                         "pool shares ONE compiled searcher); "
+                         "reports mode=router rows plus the "
+                         "router/gateway rate ratio (the router tax)")
     ap.set_defaults(board=9)   # serving default, like bench_serve
     a = ap.parse_args()
 
@@ -187,6 +194,75 @@ def main():
         # direct (≥ 0.8 at 16 conns = wire tax within 20%)
         report("gateway_wire_tax", gateway_rate / direct_rate, "x",
                conns=n_conns, **common)
+
+        # ---- router: the same traffic once more, now through a
+        # federation front door (docs/ROLLOUT.md) — the extra hop's
+        # cost relative to one bare gateway is the router tax
+        if a.replicas > 0:
+            from rocalphago_tpu.rollout.router import (
+                Replica,
+                RolloutRouter,
+            )
+
+            extra_pools = [
+                ServePool(val, pol, n_sim=a.sims,
+                          max_sessions=n_conns,
+                          queue_rows=4 * max(sizes),
+                          batch_sizes=sizes,
+                          searcher=pool.search)
+                for _ in range(a.replicas - 1)]
+            servers = [GatewayServer(p, max_conns=n_conns,
+                                     slo_ms=a.slo_ms).start()
+                       for p in [pool] + extra_pools]
+            reps = [Replica("127.0.0.1", s.port, gateway=s,
+                            name=f"r{i}")
+                    for i, s in enumerate(servers)]
+            router = RolloutRouter(reps,
+                                   max_conns=n_conns).start()
+
+            def router_settled():
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    if (router.stats()["conns"]["live"] == 0
+                            and all(s.stats()["conns"]["live"] == 0
+                                    for s in servers)):
+                        return
+                    time.sleep(0.01)
+                raise RuntimeError(
+                    "router connections did not settle")
+
+            best = None
+            for _ in range(a.reps):
+                router_settled()
+                out = run_load("127.0.0.1", router.port,
+                               conns=n_conns, moves=a.moves)
+                if out["sheds"] or out["disconnects"] or \
+                        out["errors"]:
+                    raise RuntimeError(
+                        f"router load not clean at {n_conns} "
+                        f"conns: {out['sheds']} sheds, "
+                        f"{out['disconnects']} disconnects, "
+                        f"{out['errors']} errors")
+                rate = out["moves"] / out["elapsed_s"]
+                if best is None or rate > best[0]:
+                    best = (rate, sorted(out["latencies_s"]))
+            router.drain(reason="bench")
+            router.close()
+            for s in servers:
+                s.drain(reason="bench")
+                s.close()
+            for p in extra_pools:
+                p.close()
+            router_rate, lats = best
+            report("gateway_moves_per_s", router_rate, "moves/s",
+                   conns=n_conns, mode="router",
+                   replicas=a.replicas,
+                   p50_s=round(_percentile(lats, 0.50), 4),
+                   p99_s=round(_percentile(lats, 0.99), 4),
+                   **common)
+            report("gateway_router_tax",
+                   router_rate / gateway_rate, "x",
+                   conns=n_conns, replicas=a.replicas, **common)
         pool.close()
 
 

@@ -40,6 +40,7 @@ class LintConfig:
     docs_serving: str = "docs/SERVING.md"
     docs_gateway: str = "docs/GATEWAY.md"
     docs_replaynet: str = "docs/REPLAYNET.md"
+    docs_rollout: str = "docs/ROLLOUT.md"
     report_modules: tuple = ("scripts/obs_report.py",)
     #: module whose ``ServePool.stats`` dict is the serve-probe
     #: block producer (diffed against docs_serving's JSON schema)
@@ -50,6 +51,12 @@ class LintConfig:
     #: module whose ``ReplayService.stats`` dict is the replaynet
     #: probe producer (diffed against docs_replaynet's JSON schema)
     replaynet_probe_module: str = "rocalphago_tpu/replaynet/server.py"
+    #: module whose ``RolloutRouter.stats`` dict is the router probe
+    #: producer (diffed against docs_rollout's JSON schema)
+    router_probe_module: str = "rocalphago_tpu/rollout/router.py"
+    #: module whose ``CanaryController.stats`` dict is the canary
+    #: probe producer (diffed against docs_rollout's JSON schema)
+    canary_probe_module: str = "rocalphago_tpu/rollout/canary.py"
 
 
 _KEY_MAP = {
@@ -61,10 +68,13 @@ _KEY_MAP = {
     "docs.serving": "docs_serving",
     "docs.gateway": "docs_gateway",
     "docs.replaynet": "docs_replaynet",
+    "docs.rollout": "docs_rollout",
     "report_modules": "report_modules",
     "serve_probe_module": "serve_probe_module",
     "gateway_probe_module": "gateway_probe_module",
     "replaynet_probe_module": "replaynet_probe_module",
+    "router_probe_module": "router_probe_module",
+    "canary_probe_module": "canary_probe_module",
 }
 
 

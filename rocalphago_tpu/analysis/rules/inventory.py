@@ -611,6 +611,33 @@ def gateway_probe_drift(ctx):
 
 
 @project_rule(
+    "rollout-probe-drift",
+    "the documented router/canary probe block schemas vs the fields "
+    "RolloutRouter.stats / CanaryController.stats actually emit")
+def rollout_probe_drift(ctx):
+    """Two producers, one rule: the ``"router"`` block the router's
+    ``/healthz`` serves (producer: ``RolloutRouter.stats``,
+    ``config.router_probe_module``) and the ``"canary"`` block the
+    canary controller probes emit (producer:
+    ``CanaryController.stats``, ``config.canary_probe_module``) —
+    both diffed both ways against docs/ROLLOUT.md's fenced JSON
+    examples, like the other probe rules. The router's dynamic
+    per-replica map is documented as ``{}`` (only literal keys
+    count)."""
+    return _probe_drift(
+        ctx, rule="rollout-probe-drift",
+        doc_rel=ctx.config.docs_rollout, block_key="router",
+        module_rel=ctx.config.router_probe_module,
+        class_name="RolloutRouter",
+        consumer="fleet balancers") + _probe_drift(
+        ctx, rule="rollout-probe-drift",
+        doc_rel=ctx.config.docs_rollout, block_key="canary",
+        module_rel=ctx.config.canary_probe_module,
+        class_name="CanaryController",
+        consumer="rollout dashboards")
+
+
+@project_rule(
     "replaynet-probe-drift",
     "the documented replaynet stats-probe block schema vs the fields "
     "ReplayService.stats actually emits")

@@ -176,6 +176,146 @@ def connect_with_retry(host: str, port: int, *, timeout: float = 60.0,
         sleep=sleep)
 
 
+class GameLog:
+    """Enough client-side state to reconstruct a live game on
+    another replica: the admitted board/komi plus every landed move
+    in order. Shared by the router's failover path and
+    :class:`ResilientGatewayClient`."""
+
+    def __init__(self):
+        self.active = False
+        self.board: int | None = None
+        self.komi: float | None = None
+        self.moves: list = []          # (color, vertex) play order
+
+    def start(self, board, komi) -> None:
+        self.active = True
+        self.board = board
+        self.komi = komi
+        self.moves = []
+
+    def play(self, color: str, vertex: str) -> None:
+        self.moves.append((color, vertex))
+
+    def set_komi(self, komi) -> None:
+        self.komi = komi
+
+    def clear(self) -> None:
+        self.active = False
+        self.board = None
+        self.komi = None
+        self.moves = []
+
+    def replay(self, client) -> None:
+        """Re-create the game on ``client`` (a fresh connection to
+        any replica serving the same board)."""
+        client.new_game(board=self.board, komi=self.komi)
+        for color, vertex in self.moves:
+            client.play(color, vertex)
+
+
+class ResilientGatewayClient:
+    """A :class:`GatewayClient` surface that survives replica drains
+    and router spillover transparently.
+
+    Every request runs inside the shared
+    :func:`~rocalphago_tpu.net.client.call_with_backoff` loop: a
+    dropped connection (:class:`GatewayClosed` — a drain nudge, a
+    kill, a router failing over) or a structured refusal
+    (:class:`GatewayRefused`, honoring its ``retry_after_s``)
+    reconnects, replays the live game from the :class:`GameLog`, and
+    retries the in-flight request. Typed game errors
+    (``illegal_move``, ``game_over`` …) propagate unchanged — they
+    are answers, not outages. ``reconnects`` counts recoveries (the
+    mid-game-drain regression test's probe).
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 attempts: int = 6, base_delay: float = 0.25,
+                 max_delay: float = 5.0, seed: int = 0,
+                 sleep=time.sleep):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._retry = dict(attempts=attempts, base_delay=base_delay,
+                           max_delay=max_delay, seed=seed,
+                           sleep=sleep)
+        self.log = GameLog()
+        self.reconnects = 0
+        self._client = connect_with_retry(host, port,
+                                          timeout=timeout,
+                                          **self._retry)
+        self.hello = self._client.hello
+        self.boards = self._client.boards
+        self.default_board = self._client.default_board
+
+    # --------------------------------------------------------- wire
+
+    def _reconnect(self) -> None:
+        self._client = connect_with_retry(self.host, self.port,
+                                          timeout=self.timeout,
+                                          **self._retry)
+        self.reconnects += 1
+        if self.log.active:
+            self.log.replay(self._client)
+
+    def _request(self, msg: dict) -> dict:
+        def attempt():
+            if self._client is None:
+                self._reconnect()
+            try:
+                return self._client.request(dict(msg))
+            except (GatewayRefused, GatewayClosed):
+                # this connection is spent; the next attempt starts
+                # clean (reconnect + replay)
+                client, self._client = self._client, None
+                client.close()
+                raise
+
+        return net_client.call_with_backoff(
+            attempt, key="gateway.reconnect", **self._retry)
+
+    # -------------------------------------------------------- games
+
+    def new_game(self, board: int | None = None,
+                 komi: float | None = None) -> dict:
+        msg: dict = {"type": "new_game"}
+        if board is not None:
+            msg["board"] = int(board)
+        if komi is not None:
+            msg["komi"] = float(komi)
+        reply = self._request(msg)
+        self.log.start(reply.get("board"), reply.get("komi"))
+        return reply
+
+    def play(self, color: str, vertex: str) -> dict:
+        reply = self._request({"type": "play", "color": color,
+                               "move": vertex})
+        self.log.play(color, vertex)
+        return reply
+
+    def genmove(self, color: str) -> dict:
+        reply = self._request({"type": "genmove", "color": color})
+        if reply.get("type") == "move":
+            self.log.play(color, reply.get("move"))
+        return reply
+
+    def set_komi(self, komi: float) -> dict:
+        reply = self._request({"type": "komi", "komi": float(komi)})
+        self.log.set_komi(float(komi))
+        return reply
+
+    def close_game(self) -> dict:
+        reply = self._request({"type": "close"})
+        self.log.clear()
+        return reply
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
 # ------------------------------------------------------ load generator
 
 

@@ -85,10 +85,18 @@ class _Game:
     """One live game on one connection: the pool session plus the
     server-side rules state the session's player searches from."""
 
-    def __init__(self, session, board: int, komi: float):
+    def __init__(self, session, board: int, komi: float,
+                 arm: str | None = None):
         self.session = session
         self.board = board
         self.state = pygo.GameState(size=board, komi=komi)
+        #: canary arm ("candidate"/"incumbent") when a controller is
+        #: routing; None otherwise
+        self.arm = arm
+        #: colors THIS connection genmoved — an outcome only counts
+        #: for the canary when exactly one side was served here
+        self.served: set = set()
+        self.finished = False
 
 
 class GatewayServer:
@@ -103,11 +111,15 @@ class GatewayServer:
     def __init__(self, pool, host: str = "127.0.0.1", port: int = 0,
                  max_conns: int | None = None,
                  slo_ms: float | None = None,
-                 drain_s: float | None = None, metrics=None):
+                 drain_s: float | None = None, metrics=None,
+                 canary=None):
         self.pool = pool
         self.host = host
         self._port_arg = int(port)
         self.metrics = metrics
+        #: optional CanaryController routing a slice of new sessions
+        #: to a staged candidate version (docs/ROLLOUT.md)
+        self.canary = canary
         self.max_conns = (int(_env_float(MAX_CONNS_ENV, 64))
                           if max_conns is None else int(max_conns))
         self.slo_ms = (_env_float(SLO_ENV, None)
@@ -363,7 +375,15 @@ class GatewayServer:
                 session.set_komi(komi)
             eff_komi = komi if komi is not None \
                 else float(session.raw.pool.cfg.komi)
-            game = _Game(session, board, eff_komi)
+            arm = None
+            if self.canary is not None:
+                pin = self.canary.assign()
+                if pin is not None:
+                    session.pin_version(pin)
+                    arm = "candidate"
+                elif self.canary.state == "running":
+                    arm = "incumbent"
+            game = _Game(session, board, eff_komi, arm=arm)
         except BaseException:
             # the admission slot must come back even on a genuine
             # bug — a raise between open and _Game would otherwise
@@ -397,6 +417,8 @@ class GatewayServer:
             self._count_error("illegal_move")
             return protocol.error_frame("illegal_move", str(e),
                                         id=rid)
+        if state.is_end_of_game:
+            self._finish_game(game)
         return {"type": "ok", "id": rid}
 
     def _genmove(self, msg: dict, game) -> dict:
@@ -430,6 +452,9 @@ class GatewayServer:
             raise
         dt = time.monotonic() - t0
         self._wire_h.observe(dt)
+        game.served.add(color)
+        if state.is_end_of_game:
+            self._finish_game(game)
         with self._lock:
             self._genmoves += 1
             self._lat.append(dt)
@@ -442,6 +467,23 @@ class GatewayServer:
                                 and deadline.expired()),
                 "rung": getattr(game.session.player, "last_rung",
                                 None)}
+
+    def _finish_game(self, game) -> None:
+        """Game over: feed the canary ONE decided outcome, once —
+        and only when this connection genmoved exactly one side (a
+        self-play connection has no arm-attributable winner)."""
+        if game.finished:
+            return
+        game.finished = True
+        if self.canary is None or game.arm is None:
+            return
+        if len(game.served) != 1:
+            return
+        winner = game.state.get_winner()
+        if winner == 0:
+            return                     # draw: not a decided game
+        color = next(iter(game.served))
+        self.canary.record(game.arm, won=(winner == color))
 
     # --------------------------------------------------------- stats
 
@@ -525,6 +567,10 @@ def main(argv=None) -> int:
                          "pool (needs FCN heads; docs/MULTISIZE.md)")
     ap.add_argument("--metrics", default=None,
                     help="JSONL path for drain/degradation events")
+    ap.add_argument("--spill", default=None,
+                    help="rollout spill dir to watch (the gate's "
+                         "pool dir): promoted params hot-swap into "
+                         "the live pool, no restart; docs/ROLLOUT.md")
     a = ap.parse_args(argv)
 
     from rocalphago_tpu.gateway.httpapi import GatewayHTTP
@@ -552,6 +598,16 @@ def main(argv=None) -> int:
         pool = ServePool(value, policy, n_sim=a.playouts,
                          metrics=metrics)
     pool.warm()
+    watcher = None
+    if a.spill:
+        from rocalphago_tpu.rollout.hotswap import (
+            HotSwapper,
+            SpillWatcher,
+        )
+
+        watcher = SpillWatcher(
+            a.spill, HotSwapper(pool, metrics=metrics),
+            policy.params, value.params, metrics=metrics).start()
     server = GatewayServer(pool, host=a.host, port=a.port,
                            max_conns=a.max_conns, slo_ms=a.slo_ms,
                            metrics=metrics).start()
@@ -569,6 +625,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         sup.request_drain(reason="keyboard")
     server.drain(reason="sigterm")
+    if watcher is not None:
+        watcher.stop()
     if http is not None:
         http.close()
     pool.close()

@@ -916,17 +916,21 @@ def main(argv=None):
                          "docs/MULTISIZE.md)")
     a = ap.parse_args(argv)
     if a.connect:
-        # the bridge path: no models, no devices — just the wire
+        # the bridge path: no models, no devices — just the wire.
+        # The resilient client follows router spillover and replica
+        # drains transparently (reconnect + replay, backoff honoring
+        # retry_after_s) — a mid-game drain re-lands the game on
+        # another replica instead of ending the GTP session
         from rocalphago_tpu.gateway.client import (
-            GatewayClient,
             GatewayRefused,
+            ResilientGatewayClient,
         )
 
         host, _, port = a.connect.rpartition(":")
         if not host or not port.isdigit():
             ap.error("--connect wants HOST:PORT")
         try:
-            client = GatewayClient(host, int(port))
+            client = ResilientGatewayClient(host, int(port))
         except GatewayRefused as e:
             retry = ("" if e.retry_after_s is None
                      else f" (retry in {e.retry_after_s}s)")

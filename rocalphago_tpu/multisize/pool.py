@@ -143,6 +143,62 @@ class MultiSizePool:
                 f"fleet driver needs one board size, got {sorted(boards)}")
         return self.pool_for(boards.pop()).driver(sessions)
 
+    # -------------------------------------------------------- rollout
+
+    @property
+    def params_version(self) -> int:
+        """The ladder's converged version (the default pool's — every
+        fan-out below applies one version number to all sizes)."""
+        return self.pool_for(self.default_size).params_version
+
+    def _fanout(self, op, version: int | None = None) -> int:
+        # one version number across the whole ladder: the first pool
+        # allocates (when version is None), the rest reuse it
+        v = version
+        for s in self.sizes:
+            v = op(self.pool_for(s), v)
+        return v
+
+    def set_params(self, params_p=None, params_v=None,
+                   version: int | None = None) -> int:
+        """Hot-swap every member pool to ``(params_p, params_v)`` (or
+        promote a staged ``version``) — one checkpoint, one version
+        number, every size; the source nets' params follow so a later
+        :meth:`add_size` facade shares the new weights."""
+        v = self._fanout(
+            lambda pool, ver: pool.set_params(params_p, params_v,
+                                              version=ver),
+            version)
+        pp, pv = self.pool_for(self.default_size) \
+            .evaluator.version_params(v)
+        self.policy.params = pp
+        self.value.params = pv
+        return v
+
+    def stage_params(self, params_p, params_v,
+                     version: int | None = None) -> int:
+        """Stage a candidate on every member pool (canary arm)."""
+        return self._fanout(
+            lambda pool, ver: pool.stage_params(params_p, params_v,
+                                                version=ver),
+            version)
+
+    def promote_version(self, version: int) -> int:
+        """Promote a staged version on every member pool."""
+        v = int(version)
+        for s in self.sizes:
+            self.pool_for(s).promote_version(v)
+        pp, pv = self.pool_for(self.default_size) \
+            .evaluator.version_params(v)
+        self.policy.params = pp
+        self.value.params = pv
+        return v
+
+    def discard_version(self, version: int) -> None:
+        """Retire a staged version on every member pool."""
+        for s in self.sizes:
+            self.pool_for(s).discard_version(version)
+
     # -------------------------------------------------------- warmup
 
     def warm(self, sizes=None) -> None:
@@ -177,6 +233,7 @@ class MultiSizePool:
         return {
             "multisize": True,
             "default_board": self.default_size,
+            "params_version": self.params_version,
             "sessions_live": sum(
                 b["sessions"]["live"] for b in boards.values()),
             "boards": boards,

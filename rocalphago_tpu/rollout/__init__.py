@@ -1,0 +1,36 @@
+"""Live model rollout: hot-swap serving, Wilson-gated canary, and
+the federated gateway router (docs/ROLLOUT.md).
+
+The subsystem that closes the loop from ``ZeroGate.promote`` to a
+player's next move, in three layers:
+
+* :mod:`~rocalphago_tpu.rollout.hotswap` — swap a promoted param
+  pytree under live sessions as a versioned pointer flip (no
+  recompile, no dropped games), fed in-process by a
+  :class:`~rocalphago_tpu.training.actor.ParamsPublisher` or
+  cross-process by the gate's spill file;
+* :mod:`~rocalphago_tpu.rollout.canary` — route a slice of sessions
+  to a candidate version and gate full rollout on the Wilson 95%
+  lower bound, with instant rollback to the incumbent;
+* :mod:`~rocalphago_tpu.rollout.router` — federate N gateway
+  replicas behind one front door: sticky routing, spillover on
+  ``overload``, drain-aware failover, health probing, and
+  convergence checks for a fleet-wide promotion.
+"""
+
+from rocalphago_tpu.rollout.canary import CanaryController
+from rocalphago_tpu.rollout.hotswap import (
+    HotSwapper,
+    PublisherWatcher,
+    SpillWatcher,
+)
+from rocalphago_tpu.rollout.router import Replica, RolloutRouter
+
+__all__ = [
+    "CanaryController",
+    "HotSwapper",
+    "PublisherWatcher",
+    "Replica",
+    "RolloutRouter",
+    "SpillWatcher",
+]

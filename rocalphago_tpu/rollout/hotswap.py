@@ -1,0 +1,195 @@
+"""Hot-swap: promoted params under live sessions, no restart.
+
+The serving side of the rollout path (docs/ROLLOUT.md). Params are
+ARGUMENTS to the compiled serve programs at fixed shapes, so
+installing a new pair is a pointer flip on the pool's
+:class:`~rocalphago_tpu.serve.evaluator.BatchingEvaluator` —
+``jax_compiles_total`` stays flat, live games keep playing, and
+every in-flight genmove finishes on the version it pinned.
+
+Two feeds drive the :class:`HotSwapper`:
+
+* :class:`PublisherWatcher` — in-process: blocks on
+  :meth:`~rocalphago_tpu.training.actor.ParamsPublisher.wait_version`
+  and applies each newly published snapshot (training and serving in
+  one process, e.g. a self-improving bot).
+* :class:`SpillWatcher` — cross-process: polls the gate's
+  ``rollout.json`` spill pointer (written atomically by
+  ``ZeroGate.promote`` / ``ParamsPublisher(spill_dir=...)``), loads
+  the checkpoint pair it names, and applies it. A restarted serving
+  process picks up the latest gated version the same way.
+
+Both watchers are daemon threads with a bounded ``stop``; the poll
+cadence is ``ROCALPHAGO_ROLLOUT_POLL_S`` (default 0.5 s).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from rocalphago_tpu.obs import registry as obs_registry
+
+#: watcher poll cadence in seconds (env override)
+POLL_ENV = "ROCALPHAGO_ROLLOUT_POLL_S"
+
+
+def default_poll_s() -> float:
+    raw = os.environ.get(POLL_ENV, "")
+    return float(raw) if raw else 0.5
+
+
+def load_spill_params(spill_dir: str, spill: dict, policy_template,
+                      value_template) -> tuple:
+    """Deserialize the checkpoint pair a spill pointer names into
+    host pytrees shaped like the given templates (the serving nets'
+    own params — same architecture by construction)."""
+    from flax import serialization
+
+    out = []
+    for key, template in (("policy", policy_template),
+                          ("value", value_template)):
+        path = os.path.join(spill_dir, str(spill[key]))
+        with open(path, "rb") as f:
+            out.append(serialization.from_bytes(template, f.read()))
+    return tuple(out)
+
+
+class HotSwapper:
+    """Applies a params pair to one or more swap targets — anything
+    with a ``set_params(params_p, params_v)`` surface
+    (:class:`~rocalphago_tpu.serve.sessions.ServePool`,
+    :class:`~rocalphago_tpu.multisize.pool.MultiSizePool`, or a bare
+    :class:`~rocalphago_tpu.serve.evaluator.BatchingEvaluator`).
+
+    ``version`` is the ROLLOUT version (the gate iteration /
+    publisher version) — the targets' evaluators allocate their own
+    monotonic params versions internally; :attr:`version` is what
+    fleet convergence checks compare."""
+
+    def __init__(self, *targets, metrics=None):
+        if not targets:
+            raise ValueError("HotSwapper needs at least one target")
+        self.targets = tuple(targets)
+        self.metrics = metrics
+        self.version = -1      # latest applied ROLLOUT version
+        self.swaps = 0
+        self._swap_c = obs_registry.counter("rollout_swaps_total")
+        self._ver_g = obs_registry.gauge("rollout_params_version")
+        self._swap_h = obs_registry.histogram("rollout_swap_seconds")
+
+    def apply(self, params_p, params_v, version: int) -> None:
+        """Swap every target to the pair (pointer flips — bounded by
+        host work, no device compile)."""
+        t0 = time.monotonic()
+        for target in self.targets:
+            target.set_params(params_p, params_v)
+        dt = time.monotonic() - t0
+        self.version = int(version)
+        self.swaps += 1
+        self._swap_c.inc()
+        self._ver_g.set(self.version)
+        self._swap_h.observe(dt)
+        if self.metrics is not None:
+            self.metrics.log("rollout", phase="swap",
+                             version=self.version,
+                             targets=len(self.targets),
+                             elapsed_s=round(dt, 6))
+
+
+class _WatcherThread:
+    """Shared daemon-thread skeleton for the two watchers."""
+
+    def __init__(self, name: str, poll_s: float | None):
+        self.poll_s = default_poll_s() if poll_s is None \
+            else float(poll_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def _loop(self) -> None:  # pragma: no cover — trivial dispatch
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.poll_s)
+
+    def poll_once(self) -> bool:
+        raise NotImplementedError
+
+
+class PublisherWatcher(_WatcherThread):
+    """In-process feed: apply each newly published snapshot."""
+
+    def __init__(self, publisher, swapper: HotSwapper,
+                 poll_s: float | None = None):
+        super().__init__("rollout-publisher-watch", poll_s)
+        self.publisher = publisher
+        self.swapper = swapper
+
+    def poll_once(self) -> bool:
+        got = self.publisher.wait_version(self.swapper.version + 1,
+                                          timeout=self.poll_s)
+        if got is None:
+            return False
+        version, pp, pv = got
+        self.swapper.apply(pp, pv, version)
+        return True
+
+    def _loop(self) -> None:
+        # wait_version already blocks up to poll_s — no extra sleep
+        while not self._stop.is_set():
+            self.poll_once()
+
+
+class SpillWatcher(_WatcherThread):
+    """Cross-process feed: follow the gate's spill pointer.
+
+    ``policy_template`` / ``value_template`` are the serving nets'
+    param pytrees (deserialization shape). A pointer naming files
+    that are mid-replace or already pruned is skipped and retried
+    next poll — the atomic pointer-last write ordering means that
+    window only exists for PRUNED history, never the latest pair."""
+
+    def __init__(self, spill_dir: str, swapper: HotSwapper,
+                 policy_template, value_template,
+                 poll_s: float | None = None, metrics=None):
+        super().__init__("rollout-spill-watch", poll_s)
+        self.spill_dir = spill_dir
+        self.swapper = swapper
+        self.policy_template = policy_template
+        self.value_template = value_template
+        self.metrics = metrics
+
+    def poll_once(self) -> bool:
+        """One poll: apply the spill-pointed version when it is newer
+        than what the swapper already serves. Returns True when a
+        swap happened."""
+        from rocalphago_tpu.training.actor import read_spill
+
+        spill = read_spill(self.spill_dir)
+        if spill is None:
+            return False
+        version = int(spill["version"])
+        if version <= self.swapper.version:
+            return False
+        try:
+            pp, pv = load_spill_params(
+                self.spill_dir, spill, self.policy_template,
+                self.value_template)
+        except (OSError, ValueError) as e:
+            # torn window (pruned file, partial copy): skip, retry
+            if self.metrics is not None:
+                self.metrics.log("rollout", phase="spill_skip",
+                                 version=version, error=str(e))
+            return False
+        self.swapper.apply(pp, pv, version)
+        return True

@@ -44,6 +44,17 @@ Batch sizes default to ``1,8,32,128,256`` (clipped to the admission
 session cap); ``ROCALPHAGO_SERVE_BATCH_SIZES`` overrides with a
 comma list. Each size is one XLA program, compiled on first use (or
 ahead of time via ``ServePool.warm``).
+
+Versioned params (docs/ROLLOUT.md): the evaluator holds a registry
+of ``version -> (params_p, params_v)`` pairs with one CURRENT
+pointer. :meth:`set_params` installs a new pair and flips the
+pointer — params are jit ARGUMENTS at fixed compiled shapes, so a
+swap is O(1) and never recompiles. A session pins one version for
+the whole genmove (:meth:`acquire`/:meth:`release`), so a search
+never mixes two nets; the dispatcher never coalesces requests of
+different versions into one batch (it splits at a version edge), so
+a device batch is single-version by construction. Non-current
+versions retire as soon as the last pin (or queued request) drops.
 """
 
 from __future__ import annotations
@@ -88,13 +99,15 @@ class _Pending:
     ``komi`` is None (the pool's pinned komi) or the request's custom
     komi — a float applied to every row, or a per-row sequence."""
 
-    __slots__ = ("states", "rows", "komi", "t_submit", "_event",
-                 "_result", "_exc")
+    __slots__ = ("states", "rows", "komi", "version", "t_submit",
+                 "_event", "_result", "_exc")
 
-    def __init__(self, states, rows: int, komi=None):
+    def __init__(self, states, rows: int, komi=None,
+                 version: int = 0):
         self.states = states
         self.rows = rows
         self.komi = komi
+        self.version = version
         self.t_submit = time.monotonic()
         self._event = threading.Event()
         self._result = None
@@ -156,8 +169,13 @@ class BatchingEvaluator:
         self._eval_fn = eval_fn
         self._eval_komi_fn = eval_komi_fn
         self.default_komi = float(default_komi)
-        self._params_p = params_p
-        self._params_v = params_v
+        # the versioned-params registry (module docstring): pairs are
+        # jit arguments, the CURRENT pointer is what unversioned
+        # submits resolve to, pins keep a version alive across a swap
+        self._params = {0: (params_p, params_v)}  # guarded-by: _cond
+        self._current = 0                 # guarded-by: self._cond
+        self._pins: dict = {}             # guarded-by: self._cond
+        self.swaps = 0                    # guarded-by: self._cond
         cap = admission.max_sessions if admission is not None else None
         self.batch_sizes = (tuple(sorted(batch_sizes)) if batch_sizes
                             else default_batch_sizes(cap))
@@ -185,6 +203,9 @@ class BatchingEvaluator:
         self._fail_c = obs_registry.counter(
             "serve_eval_failures_total")
         self._depth_g = obs_registry.gauge("serve_queue_depth")
+        self._swap_c = obs_registry.counter("serve_param_swaps_total")
+        self._ver_g = obs_registry.gauge("serve_params_version")
+        self._ver_g.set(0)
         # resurrect-on-death: the loop's state is all on self, so
         # re-entering it after an escaped exception loses nothing; a
         # crash loop parks and fails the queue (no hanging clients)
@@ -194,10 +215,102 @@ class BatchingEvaluator:
         if start:
             self._thread.start()
 
+    # ----------------------------------------------------- versions
+
+    @property
+    def params_version(self) -> int:
+        """The CURRENT version — what an unpinned submit resolves to."""
+        with self._cond:
+            return self._current
+
+    def add_version(self, params_p, params_v,
+                    version: int | None = None) -> int:
+        """Register a pair WITHOUT flipping the current pointer (the
+        canary's staging path). The new version arrives pinned once —
+        :meth:`release` drops the stage pin (retiring the version
+        unless it was promoted current meanwhile)."""
+        with self._cond:
+            v = (max(self._params) + 1 if version is None
+                 else int(version))
+            self._params[v] = (params_p, params_v)
+            self._pins[v] = self._pins.get(v, 0) + 1
+            return v
+
+    def set_params(self, params_p=None, params_v=None,
+                   version: int | None = None) -> int:
+        """The hot swap: install ``(params_p, params_v)`` — or, with
+        params omitted, promote an already-registered ``version`` —
+        as the new current pair. Params are arguments to the compiled
+        programs at fixed shapes, so this is a pointer flip: no
+        recompile, no dropped requests; in-flight pinned searches
+        finish on the version they started. Returns the version."""
+        with self._cond:
+            if params_p is None:
+                v = int(version)
+                if v not in self._params:
+                    raise KeyError(
+                        f"params version {v} is not registered "
+                        f"(have {sorted(self._params)})")
+            else:
+                v = (max(self._params) + 1 if version is None
+                     else int(version))
+                self._params[v] = (params_p, params_v)
+            prev = self._current
+            self._current = v
+            if v != prev:
+                self.swaps += 1
+            # retire every version that is neither current nor pinned
+            # (by a session's genmove, a canary's stage, or a queued
+            # request)
+            for old in [o for o in self._params
+                        if o != v and not self._pins.get(o)]:
+                del self._params[old]
+            self._cond.notify_all()
+        if v != prev:
+            self._swap_c.inc()
+        self._ver_g.set(v)
+        return v
+
+    def acquire(self, version: int | None = None) -> int:
+        """Pin a version (None = current) for a whole search: the
+        session's per-genmove consistency guarantee. Raises KeyError
+        when a requested (e.g. rolled-back canary) version is
+        retired — callers fall back to ``acquire(None)``."""
+        with self._cond:
+            v = self._current if version is None else int(version)
+            if v not in self._params:
+                raise KeyError(
+                    f"params version {v} is retired "
+                    f"(current {self._current})")
+            self._pins[v] = self._pins.get(v, 0) + 1
+            return v
+
+    def release(self, version: int) -> None:
+        """Drop one pin; a non-current version with no pins left
+        retires immediately (its params become collectable)."""
+        with self._cond:
+            n = self._pins.get(version, 0) - 1
+            if n > 0:
+                self._pins[version] = n
+            else:
+                self._pins.pop(version, None)
+            for old in [o for o in self._params
+                        if o != self._current
+                        and not self._pins.get(o)]:
+                del self._params[old]
+
+    def version_params(self, version: int | None = None) -> tuple:
+        """The ``(params_p, params_v)`` pair of ``version`` (None =
+        current) — the promotion path hands these to the facade nets
+        so degraded rungs follow the swap."""
+        with self._cond:
+            v = self._current if version is None else int(version)
+            return self._params[v]
+
     # ------------------------------------------------------- client
 
     def submit(self, states, rows: int | None = None,
-               komi=None) -> _Pending:
+               komi=None, version: int | None = None) -> _Pending:
         """Enqueue a [rows]-batched GoState for evaluation. Raises
         :class:`~rocalphago_tpu.serve.admission.EvaluatorOverload`
         when the bounded queue is full (the shed path) — the caller's
@@ -205,7 +318,10 @@ class BatchingEvaluator:
         a per-row sequence) scores this request's terminal rows under
         that komi instead of the pool's pinned one; it requires
         ``eval_komi_fn`` and only changes which compiled program the
-        containing batch runs, not how it is coalesced."""
+        containing batch runs, not how it is coalesced. ``version``
+        pins the request to a registered params version (None = the
+        current pointer at enqueue time); the queued request holds a
+        pin until it is served, so a swap cannot retire its net."""
         if rows is None:
             rows = int(states.board.shape[0])
         if rows > self.max_batch:
@@ -216,32 +332,40 @@ class BatchingEvaluator:
             raise ValueError(
                 "per-request komi needs an eval_komi_fn "
                 "(search.eval_batch_komi)")
-        req = _Pending(states, rows, komi)
         with self._cond:
             if self._stop:
                 raise RuntimeError("evaluator is closed")
+            v = self._current if version is None else int(version)
+            if v not in self._params:
+                raise KeyError(
+                    f"params version {v} is retired "
+                    f"(current {self._current})")
             if self.admission is not None:
                 self.admission.admit_rows(self._pending_rows, rows)
+            req = _Pending(states, rows, komi, version=v)
+            self._pins[v] = self._pins.get(v, 0) + 1
             self._queue.append(req)
             self._pending_rows += rows
             self._cond.notify_all()
         return req
 
     def evaluate(self, states, rows: int | None = None,
-                 timeout: float | None = None, komi=None):
+                 timeout: float | None = None, komi=None,
+                 version: int | None = None):
         """Blocking submit: ``(priors, values)`` for ``states``."""
-        return self.submit(states, rows, komi=komi).result(timeout)
+        return self.submit(states, rows, komi=komi,
+                           version=version).result(timeout)
 
-    def eval_direct(self, states, komi=None):
+    def eval_direct(self, states, komi=None,
+                    version: int | None = None):
         """Run the compiled eval program directly, bypassing the
         queue — warmup (compile each ladder size ahead of traffic)
         and the degraded paths that must not add queue load. ``komi``
         (f32 [B] array) selects the komi-aware program."""
+        pp, pv = self.version_params(version)
         if komi is None:
-            return self._eval_fn(self._params_p, self._params_v,
-                                 states)
-        return self._eval_komi_fn(self._params_p, self._params_v,
-                                  states, komi)
+            return self._eval_fn(pp, pv, states)
+        return self._eval_komi_fn(pp, pv, states, komi)
 
     # ---------------------------------------------------- dispatcher
 
@@ -282,6 +406,12 @@ class BatchingEvaluator:
                 take, total = [], 0
                 while self._queue and (
                         total + self._queue[0].rows <= self.max_batch):
+                    if take and (self._queue[0].version
+                                 != take[0].version):
+                        # never coalesce across a version edge: one
+                        # device batch = one net (swap consistency);
+                        # the other version's convoy is next round
+                        break
                     req = self._queue.popleft()
                     take.append(req)
                     total += req.rows
@@ -334,7 +464,8 @@ class BatchingEvaluator:
                 if komi is not None:
                     komi = jnp.concatenate(
                         [komi, jnp.broadcast_to(komi[:1], (pad,))])
-            priors, values = self.eval_direct(states, komi=komi)
+            priors, values = self.eval_direct(
+                states, komi=komi, version=take[0].version)
         except Exception as e:  # noqa: BLE001 — fail the batch, not
             #                     the dispatcher (classified by the
             #                     sessions' resilience ladders)
@@ -342,6 +473,7 @@ class BatchingEvaluator:
             self._fail_c.inc()
             for req in take:
                 req._fail(e)
+                self.release(req.version)
             return
         self.rows_total += total
         self.padded_total += size
@@ -354,6 +486,7 @@ class BatchingEvaluator:
             req._finish((priors[offset:offset + req.rows],
                          values[offset:offset + req.rows]))
             offset += req.rows
+            self.release(req.version)
 
     def _fail_pending(self) -> None:
         """Parked-dispatcher cleanup: fail everything queued so no
@@ -367,6 +500,7 @@ class BatchingEvaluator:
             req._fail(RuntimeError(
                 f"evaluator dispatcher parked"
                 f"{f' ({type(err).__name__}: {err})' if err else ''}"))
+            self.release(req.version)
 
     # ------------------------------------------------------ lifecycle
 
@@ -376,6 +510,9 @@ class BatchingEvaluator:
             take, total = [], 0
             while self._queue and (
                     total + self._queue[0].rows <= self.max_batch):
+                if take and (self._queue[0].version
+                             != take[0].version):
+                    break  # single-version batches (see _loop)
                 req = self._queue.popleft()
                 take.append(req)
                 total += req.rows
@@ -393,6 +530,7 @@ class BatchingEvaluator:
             self._cond.notify_all()
         for req in leftovers:
             req._fail(RuntimeError("evaluator closed"))
+            self.release(req.version)
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
 
@@ -402,12 +540,16 @@ class BatchingEvaluator:
         """Probe snapshot (`rocalphago-health`'s ``serve`` block)."""
         with self._cond:
             depth = self._pending_rows
+            version = self._current
+            swaps = self.swaps
         return {
             "batches": self.batches,
             "komi_batches": self.komi_batches,
             "rows": self.rows_total,
             "failures": self.failures,
             "queue_depth": depth,
+            "params_version": version,
+            "swaps": swaps,
             "batch_occupancy": (
                 round(self.rows_total / self.padded_total, 4)
                 if self.padded_total else None),

@@ -87,6 +87,12 @@ class SessionPlayer:
         self.last_deadline_hit = False
         self.genmoves = 0
         self._move_time: float | None = None
+        #: canary arm hook: a session pinned to a STAGED params
+        #: version searches on it every genmove; None follows the
+        #: pool's current pointer. A rolled-back (retired) pin falls
+        #: back to current — the game continues on the incumbent.
+        self.pinned_version: int | None = None
+        self.last_version: int | None = None
         import jax.numpy as jnp
 
         # the free-PUCT root_actions row, built once
@@ -145,23 +151,36 @@ class SessionPlayer:
         deadline = Deadline.after(self._budget_s())
         enforce = not deadline.unlimited and pool.warmed
         komi = self._komi()
-        # root priors through the shared evaluator, like every leaf
-        priors0, _ = pool.evaluator.evaluate(roots, komi=komi)
-        tree = search.assemble_tree(roots, priors0)
-        # steady state is ONE device call per simulation
-        # (advance_sim: apply + next prepare fused); the deadline is
-        # checked between simulations with a one-sim anytime floor
-        ctx = search.prepare_sim(tree, self._free)
-        ran = 0
-        while True:
-            priors, values = pool.evaluator.evaluate(ctx.eval_states,
-                                                     komi=komi)
-            ran += 1
-            if ran >= eff or (enforce and deadline.expired()):
-                tree = search.apply_sim(tree, ctx, priors, values)
-                break
-            tree, ctx = search.advance_sim(tree, ctx, priors, values,
-                                           self._free)
+        # one params version per genmove: pinned for the WHOLE search
+        # so a hot swap mid-search cannot mix nets within one tree; a
+        # retired (rolled-back) pin falls back to the current pointer
+        try:
+            ver = pool.evaluator.acquire(self.pinned_version)
+        except KeyError:
+            self.pinned_version = None
+            ver = pool.evaluator.acquire(None)
+        self.last_version = ver
+        try:
+            # root priors through the shared evaluator, like every leaf
+            priors0, _ = pool.evaluator.evaluate(roots, komi=komi,
+                                                 version=ver)
+            tree = search.assemble_tree(roots, priors0)
+            # steady state is ONE device call per simulation
+            # (advance_sim: apply + next prepare fused); the deadline
+            # is checked between simulations, one-sim anytime floor
+            ctx = search.prepare_sim(tree, self._free)
+            ran = 0
+            while True:
+                priors, values = pool.evaluator.evaluate(
+                    ctx.eval_states, komi=komi, version=ver)
+                ran += 1
+                if ran >= eff or (enforce and deadline.expired()):
+                    tree = search.apply_sim(tree, ctx, priors, values)
+                    break
+                tree, ctx = search.advance_sim(tree, ctx, priors,
+                                               values, self._free)
+        finally:
+            pool.evaluator.release(ver)
         visits, _ = search.root_stats(tree)
         counts = np.asarray(jax.device_get(visits))[0]
         action = int(counts.argmax())
@@ -239,20 +258,28 @@ class FleetDriver:
         deadline = Deadline.after(pool.slo_s)
         enforce = not deadline.unlimited and pool.warmed
         komi = self._komi_rows(n)
-        priors0, _ = pool.evaluator.evaluate(roots, rows=n, komi=komi)
-        tree = search.assemble_tree(roots, priors0)
-        free = jnp.full((n,), -1, jnp.int32)
-        ctx = search.prepare_sim(tree, free)
-        ran = 0
-        while True:
-            priors, values = pool.evaluator.evaluate(
-                ctx.eval_states, rows=n, komi=komi)
-            ran += 1
-            if ran >= pool.n_sim or (enforce and deadline.expired()):
-                tree = search.apply_sim(tree, ctx, priors, values)
-                break
-            tree, ctx = search.advance_sim(tree, ctx, priors, values,
-                                           free)
+        # the whole lockstep round searches ONE pinned version — the
+        # same per-genmove consistency a threaded session gets
+        ver = pool.evaluator.acquire(None)
+        try:
+            priors0, _ = pool.evaluator.evaluate(roots, rows=n,
+                                                 komi=komi, version=ver)
+            tree = search.assemble_tree(roots, priors0)
+            free = jnp.full((n,), -1, jnp.int32)
+            ctx = search.prepare_sim(tree, free)
+            ran = 0
+            while True:
+                priors, values = pool.evaluator.evaluate(
+                    ctx.eval_states, rows=n, komi=komi, version=ver)
+                ran += 1
+                if ran >= pool.n_sim or (enforce
+                                         and deadline.expired()):
+                    tree = search.apply_sim(tree, ctx, priors, values)
+                    break
+                tree, ctx = search.advance_sim(tree, ctx, priors,
+                                               values, free)
+        finally:
+            pool.evaluator.release(ver)
         visits, _ = search.root_stats(tree)
         counts = np.asarray(jax.device_get(visits))
         self.last_n_sim = ran
@@ -317,6 +344,17 @@ class ServeSession:
         komi is data to the evaluator, not part of any compiled
         shape. None restores the pool default."""
         self.raw.komi = None if komi is None else float(komi)
+
+    @property
+    def params_version(self) -> int | None:
+        """The version this session's LAST genmove searched on."""
+        return self.raw.last_version
+
+    def pin_version(self, version: int | None) -> None:
+        """Pin future genmoves to a staged params version (the canary
+        arm assignment); None rejoins the pool's current pointer."""
+        self.raw.pinned_version = (None if version is None
+                                   else int(version))
 
     def close(self) -> None:
         if not self._closed:
@@ -432,6 +470,48 @@ class ServePool:
         :class:`FleetDriver`)."""
         return FleetDriver(self, sessions)
 
+    # -------------------------------------------------------- rollout
+
+    @property
+    def params_version(self) -> int:
+        return self.evaluator.params_version
+
+    def set_params(self, params_p=None, params_v=None,
+                   version: int | None = None) -> int:
+        """Hot-swap the pool's net: install ``(params_p, params_v)``
+        (or promote a staged ``version``) as the current pair — a
+        pointer flip at the evaluator's fixed compiled shapes, live
+        sessions keep playing, in-flight genmoves finish on the
+        version they pinned. The facade nets follow so the degraded
+        rungs (raw policy fallback) serve the same weights."""
+        v = self.evaluator.set_params(params_p, params_v,
+                                      version=version)
+        pp, pv = self.evaluator.version_params(v)
+        self.policy.params = pp
+        self.value.params = pv
+        return v
+
+    def stage_params(self, params_p, params_v,
+                     version: int | None = None) -> int:
+        """Register a candidate pair WITHOUT flipping current (the
+        canary's arm): sessions reach it only via
+        :meth:`ServeSession.pin_version`."""
+        return self.evaluator.add_version(params_p, params_v,
+                                          version=version)
+
+    def promote_version(self, version: int) -> int:
+        """Full rollout of a staged version: flip current to it and
+        drop the stage pin."""
+        v = self.set_params(version=version)
+        self.evaluator.release(v)
+        return v
+
+    def discard_version(self, version: int) -> None:
+        """Roll a staged version back: drop the stage pin so it
+        retires once in-flight pinned searches finish; sessions
+        pinned to it fall back to current on their next genmove."""
+        self.evaluator.release(version)
+
     # --------------------------------------------------------- warmup
 
     def warm(self, sizes=None) -> None:
@@ -504,6 +584,10 @@ class ServePool:
                 "batch_occupancy": ev["batch_occupancy"],
                 "batch_sizes": ev["batch_sizes"],
                 "max_wait_us": ev["max_wait_us"],
+            },
+            "params": {
+                "version": ev["params_version"],
+                "swaps": ev["swaps"],
             },
             "board": self.board,
             "komi_default": float(self.cfg.komi),

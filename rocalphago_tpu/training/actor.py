@@ -52,10 +52,49 @@ from rocalphago_tpu.training.zero import next_keys
 
 POLL_ENV = "ROCALPHAGO_ACTOR_POLL_S"
 
+#: the rollout spill pointer a serving process watches
+#: (docs/ROLLOUT.md): ``{"version", "policy", "value"}`` next to the
+#: checkpoint pair it names, atomically replaced on each publish
+SPILL_NAME = "rollout.json"
+
 
 def default_poll_s() -> float:
     """Wait-slice for params/buffer waits (responsiveness of stop)."""
     return float(os.environ.get(POLL_ENV, "0.5"))
+
+
+def write_spill(dir_path: str, *, version: int, policy_path: str,
+                value_path: str) -> str:
+    """Atomically write ``dir_path/rollout.json`` naming the latest
+    gated checkpoint pair — the cross-process half of the rollout
+    path: a :class:`~rocalphago_tpu.rollout.hotswap.SpillWatcher` (or
+    a restarted serving process) reads it to pick up the promoted
+    version without sharing a process with training."""
+    from rocalphago_tpu.runtime.atomic import atomic_write_json
+
+    path = os.path.join(dir_path, SPILL_NAME)
+    atomic_write_json(path, {
+        "version": int(version),
+        "policy": os.path.basename(policy_path),
+        "value": os.path.basename(value_path),
+    })
+    return path
+
+
+def read_spill(dir_path: str) -> dict | None:
+    """The current spill pointer (None when absent/partial — the
+    atomic replace means a reader never sees a torn file)."""
+    import json
+
+    try:
+        with open(os.path.join(dir_path, SPILL_NAME),
+                  encoding="utf-8") as f:
+            spill = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not all(k in spill for k in ("version", "policy", "value")):
+        return None
+    return spill
 
 
 class DispatchGang:
@@ -99,11 +138,15 @@ class ParamsPublisher:
     reference — publish is O(1), no copies.
     """
 
-    def __init__(self):
+    def __init__(self, spill_dir: str | None = None):
         self._cond = lockcheck.make_condition("ParamsPublisher._cond")
         self._version = -1     # guarded-by: self._cond
         self._policy = None    # guarded-by: self._cond
         self._value = None     # guarded-by: self._cond
+        #: directory to mirror each publish into as an on-disk
+        #: checkpoint pair + rollout.json pointer (None = in-process
+        #: only); lets a serving process in ANOTHER process follow
+        self.spill_dir = spill_dir
 
     def publish(self, policy_params, value_params,
                 version: int | None = None) -> int:
@@ -117,7 +160,38 @@ class ParamsPublisher:
             v = self._version
             self._cond.notify_all()
         registry.gauge("actor_params_version").set(v)
+        if self.spill_dir is not None:
+            self._spill(v, policy_params, value_params)
         return v
+
+    def _spill(self, version: int, policy_params,
+               value_params) -> None:
+        """Mirror one publish to disk: serialize the pair (flax
+        msgpack, host copies), then atomically flip rollout.json at
+        it. Pointer-last ordering means a watcher that reads the
+        pointer always finds both files; older spill pairs are pruned
+        best-effort once the pointer has moved on."""
+        from flax import serialization
+
+        from rocalphago_tpu.runtime.atomic import atomic_write_bytes
+
+        d = self.spill_dir
+        os.makedirs(d, exist_ok=True)
+        ppath = os.path.join(d, f"spill.{version:05d}.policy.msgpack")
+        vpath = os.path.join(d, f"spill.{version:05d}.value.msgpack")
+        atomic_write_bytes(ppath, serialization.to_bytes(
+            jax.device_get(policy_params)))
+        atomic_write_bytes(vpath, serialization.to_bytes(
+            jax.device_get(value_params)))
+        write_spill(d, version=version, policy_path=ppath,
+                    value_path=vpath)
+        for name in sorted(os.listdir(d)):
+            if (name.startswith("spill.") and name.endswith(".msgpack")
+                    and not name.startswith(f"spill.{version:05d}.")):
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass  # a concurrent reader may hold it open
 
     def get(self):
         """Latest ``(version, policy_params, value_params)``;

@@ -619,6 +619,13 @@ class ZeroGate:
                         jax.device_get(params)))
 
         write_pair()
+        # pointer AFTER the pair: a rollout watcher reading the spill
+        # always finds the files it names (docs/ROLLOUT.md)
+        from rocalphago_tpu.training.actor import write_spill
+
+        ppath, vpath = self._paths(iteration)
+        write_spill(self.pool_dir, version=iteration,
+                    policy_path=ppath, value_path=vpath)
 
     def load(self, entry, policy_template, value_template) -> tuple:
         from flax import serialization

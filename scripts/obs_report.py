@@ -469,6 +469,89 @@ def render_replaynet(records, snap: dict) -> str:
     return "\n".join(lines)
 
 
+def _lb_trend(records) -> list:
+    """The candidate's Wilson-lb trajectory across the run — from
+    the ``canary`` record events (one point per decided game; the
+    ``rollout_canary_lb`` gauge in a snapshot only keeps the last)."""
+    return [r["wilson_lb"] for r in records
+            if r.get("event") == "canary"
+            and r.get("phase") == "record"
+            and r.get("wilson_lb") is not None]
+
+
+def render_rollout(records, snap: dict) -> str:
+    """Live rollout health (rollout/; docs/ROLLOUT.md): hot-swap
+    count + latency and the version the fleet serves, the canary's
+    per-arm record with the Wilson-lb trajectory the gate decided
+    on, the promotion/rollback timeline, and each replica's routing
+    share — 'which net is live, how it got there, and who served
+    the traffic' in one block."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    swaps = counters.get("rollout_swaps_total")
+    swap_h = snap.get("histograms", {}).get("rollout_swap_seconds")
+    routed = {k: v for k, v in counters.items()
+              if k.startswith("router_routed_total")}
+    canary_evs = [r for r in records if r.get("event") == "canary"]
+    if not (swaps or routed or canary_evs):
+        return "(no rollout records)"
+    lines = []
+    if swaps is not None:
+        ver = gauges.get("rollout_params_version")
+        ver_s = "" if ver is None else f", serving version {int(ver)}"
+        lat = ""
+        if swap_h and swap_h.get("count"):
+            p99 = quantile_from_buckets(swap_h, 0.99)
+            lat = f" (swap p99≲{p99}s)"
+        lines.append(f"swaps: {int(swaps)} applied{ver_s}{lat}")
+    if canary_evs:
+        arm_games = {
+            arm: counters.get(
+                f'rollout_canary_games_total{{arm="{arm}"}}', 0)
+            for arm in ("candidate", "incumbent")}
+        assigned = {
+            arm: counters.get(
+                f'rollout_canary_assigned_total{{arm="{arm}"}}', 0)
+            for arm in ("candidate", "incumbent")}
+        lines.append(
+            f"canary: assigned candidate={assigned['candidate']} "
+            f"incumbent={assigned['incumbent']}, decided games "
+            f"candidate={arm_games['candidate']} "
+            f"incumbent={arm_games['incumbent']}")
+        trend = _lb_trend(records)
+        if trend:
+            lb = gauges.get("rollout_canary_lb", trend[-1])
+            lines.append(f"wilson lb: {trend[0]:.4f} → {lb:.4f} "
+                         f"over {len(trend)} decided games")
+        for r in canary_evs:
+            ph = r.get("phase")
+            if ph == "promote":
+                lines.append(
+                    f"promoted: version {r.get('candidate')} "
+                    f"(lb={r.get('wilson_lb')})")
+            elif ph == "rollback":
+                lines.append(
+                    f"rolled back: version {r.get('candidate')} "
+                    f"({r.get('reason')}, lb={r.get('wilson_lb')})")
+    if routed:
+        total = sum(routed.values()) or 1
+        parts = []
+        for k, v in sorted(routed.items()):
+            name = k.split("replica=", 1)[-1].strip(chr(34) + "{}")
+            parts.append(f"{name}={v} ({100.0 * v / total:.0f}%)")
+        extra = []
+        for short, key in (("spillovers", "router_spillovers_total"),
+                           ("failovers", "router_failovers_total"),
+                           ("retried genmoves",
+                            "router_retried_genmoves_total")):
+            n = counters.get(key)
+            if n:
+                extra.append(f"{n} {short}")
+        tail = f" — {', '.join(extra)}" if extra else ""
+        lines.append("routing share: " + "  ".join(parts) + tail)
+    return "\n".join(lines)
+
+
 def _aux_trend(records) -> dict:
     """``head -> (first, last)`` aux-loss gauge values across the
     run's registry snapshots (gauges only keep the latest value, so
@@ -602,6 +685,8 @@ def report(records, top: int | None = None) -> str:
              "", render_gateway(records, reg or {}), "",
              "## replaynet (ingest / dup acks / spool / drain)",
              "", render_replaynet(records, reg or {}), "",
+             "## rollout (swaps / canary verdict / routing share)",
+             "", render_rollout(records, reg or {}), "",
              "## self-play economics (cap split / sims saved / aux)",
              "", render_selfplay_econ(records, reg or {}), "",
              "## curriculum (per-stage ladder / transfer verdict)", "",
@@ -683,6 +768,18 @@ FIXTURE = [
      "time": 112.1},
     {"event": "drain", "phase": "replaynet_drained", "live_conns": 0,
      "time": 112.4},
+    # a canary run (rollout/canary.py): staged, three decided games,
+    # then the Wilson gate rolls the weak candidate back
+    {"event": "canary", "phase": "stage", "candidate": 8,
+     "incumbent": 7, "fraction": 0.25, "min_games": 3, "time": 120.0},
+    {"event": "canary", "phase": "record", "arm": "candidate",
+     "won": True, "wilson_lb": 0.2065, "decided": 1, "time": 121.0},
+    {"event": "canary", "phase": "record", "arm": "candidate",
+     "won": False, "wilson_lb": 0.0949, "decided": 2, "time": 122.0},
+    {"event": "canary", "phase": "record", "arm": "candidate",
+     "won": False, "wilson_lb": 0.0617, "decided": 3, "time": 123.0},
+    {"event": "canary", "phase": "rollback", "candidate": 8,
+     "reason": "wilson_lb", "wilson_lb": 0.0617, "time": 123.1},
     # an EARLY snapshot (iteration 0): only its aux_loss gauges matter
     # — the econ section walks every snapshot to render the trend;
     # every other section reads the last snapshot only
@@ -721,7 +818,21 @@ FIXTURE = [
                      "replaynet_dedup_hits_total": 4,
                      "replaynet_batches_out_total": 26,
                      "replaynet_shipped_games_total": 56,
-                     "replaynet_reconnects_total": 5},
+                     "replaynet_reconnects_total": 5,
+                     "rollout_swaps_total": 2,
+                     'rollout_canary_assigned_total{arm="candidate"}':
+                         1,
+                     'rollout_canary_assigned_total{arm="incumbent"}':
+                         3,
+                     'rollout_canary_games_total{arm="candidate"}': 3,
+                     'rollout_canary_games_total{arm="incumbent"}': 2,
+                     "rollout_canary_rollbacks_total": 1,
+                     'router_routed_total{replica="r0"}': 6,
+                     'router_routed_total{replica="r1"}': 3,
+                     'router_connections_total{result="accepted"}': 9,
+                     "router_spillovers_total": 1,
+                     "router_failovers_total": 1,
+                     "router_retried_genmoves_total": 1},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
                    'device_occupancy{runner="device_mcts"}': 0.983,
                    "replay_fill_games": 6,
@@ -733,7 +844,9 @@ FIXTURE = [
                    'aux_loss{head="score"}': 18.5,
                    "gateway_conns_live": 0,
                    "replaynet_conns_live": 0,
-                   "replaynet_spool_depth": 3},
+                   "replaynet_spool_depth": 3,
+                   "rollout_params_version": 7,
+                   "rollout_canary_lb": 0.0617},
         "histograms": {"gtp_genmove_seconds": {
             "count": 42, "sum": 33.6,
             "buckets": {"0.5": 17, "1": 40, "2.5": 42,
@@ -757,7 +870,10 @@ FIXTURE = [
             "gateway_wire_seconds": {
                 "count": 40, "sum": 3.0,
                 "buckets": {"0.05": 10, "0.1": 38, "0.25": 40,
-                            "+Inf": 40}}}}},
+                            "+Inf": 40}},
+            "rollout_swap_seconds": {
+                "count": 2, "sum": 0.012,
+                "buckets": {"0.01": 1, "0.025": 2, "+Inf": 2}}}}},
 ]
 
 
@@ -803,6 +919,14 @@ def selftest() -> int:
               "drain: replaynet_requested (sigterm) → "
               "replaynet_accept_stopped +0.1s → "
               "replaynet_drained (0 live) +0.4s",
+              "rollout (swaps / canary verdict / routing share)",
+              "swaps: 2 applied, serving version 7 (swap p99≲0.025s)",
+              "canary: assigned candidate=1 incumbent=3, "
+              "decided games candidate=3 incumbent=2",
+              "wilson lb: 0.2065 → 0.0617 over 3 decided games",
+              "rolled back: version 8 (wilson_lb, lb=0.0617)",
+              "routing share: r0=6 (67%)  r1=3 (33%) — "
+              "1 spillovers, 1 failovers, 1 retried genmoves",
               "self-play economics (cap split / sims saved / aux)",
               "searches: 25.0% full / 75.0% cheap",
               "sims: mean 14.0/move over 64 moves, "

@@ -1,0 +1,474 @@
+"""Rollout soak: the zero-downtime proof for live model rollout.
+
+Runs N in-process :class:`~rocalphago_tpu.gateway.server.
+GatewayServer` replicas (tiny nets; every pool shares ONE compiled
+searcher) behind a :class:`~rocalphago_tpu.rollout.router.
+RolloutRouter` and proves the subsystem's headline claims
+(docs/ROLLOUT.md) under storm traffic:
+
+* **promotions land mid-storm with zero downtime** — every round a
+  new version goes through the REAL promotion pipe
+  (``ParamsPublisher`` spill → ``SpillWatcher`` → ``HotSwapper`` →
+  every replica pool) while games are in flight; live games keep
+  playing and ``jax_compiles_total`` stays FLAT across every swap
+  (params are jit arguments at fixed shapes — a swap is a pointer
+  flip, never a compile);
+* **kills stay inside the fault wall** — a ``kill@gateway.conn``
+  plan aborts backend connections mid-conversation; every abort is
+  a typed error, ``requests.unhandled`` stays ZERO fleet-wide;
+* **drain-aware failover is transparent** — each round one replica
+  is drained and restarted UNDER LOAD; its routed games fail over
+  (reconnect, game-log replay, ≤ 1 retried genmove per failover)
+  and the fleet converges back to one params version;
+* **the Wilson gate rejects a weak canary** — a deliberately weak
+  candidate is staged on the canary pool, loses its decided games,
+  and is auto-rolled-back (lb < 0.5) with the incumbent's pointer
+  untouched;
+* **sheds reconcile exactly** — router-cap refusals counted
+  client-side == ``router.stats()`` == the
+  ``router_connections_total{result="shed"}`` delta scraped off the
+  router's ``/metrics``;
+* **after the storm a fault-free GATE round runs clean**, and
+  **SIGTERM drains the whole federation** (router + every replica +
+  every pool) to zero live conns, exit 0.
+
+Kill rounds and bounce rounds alternate: kills make a replica's
+typed fault wall observable, bounces make failover deterministic
+(no fault plan racing the failover replay).
+
+Tier-1 smoke: ``tests/test_rollout.py`` runs this with
+``--min-kills 1 --swaps 1``; the @slow soak runs the defaults.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/rollout_soak.py --out /tmp/soak
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default=None,
+                    help="run dir for metrics.jsonl + spill + "
+                    "summary.json (default: a fresh temp dir)")
+    ap.add_argument("--board", type=int, default=5)
+    ap.add_argument("--sims", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="federated gateway replicas (>= 2 so a "
+                    "bounce always has a failover destination)")
+    ap.add_argument("--conns", type=int, default=6,
+                    help="concurrent connections per storm round "
+                    "(keep it above --max-conns so rounds shed)")
+    ap.add_argument("--max-conns", type=int, default=3,
+                    help="the ROUTER's connection cap (each replica "
+                    "gets ample headroom above it)")
+    ap.add_argument("--moves", type=int, default=4,
+                    help="genmoves per connection per round")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="kill-schedule seed (per-barrier draws)")
+    ap.add_argument("--p-kill", type=float, default=0.15,
+                    help="per-request kill probability at the "
+                    "gateway.conn barrier (kill rounds only)")
+    ap.add_argument("--plan", default=None,
+                    help="override the kill-round fault plan")
+    ap.add_argument("--min-kills", type=int, default=3,
+                    help="soak until at least this many backend "
+                    "connections were kill-aborted")
+    ap.add_argument("--swaps", type=int, default=2,
+                    help="minimum mid-storm promotions to land")
+    ap.add_argument("--canary-games", type=int, default=6,
+                    help="decided games before the Wilson gate "
+                    "decides the weak canary")
+    ap.add_argument("--deadline-s", type=float, default=240.0,
+                    help="hard wall-clock bound on the whole soak")
+    return ap
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+    if args.replicas < 2:
+        print("rollout_soak: --replicas must be >= 2",
+              file=sys.stderr)
+        return 2
+    out_dir = args.out or tempfile.mkdtemp(prefix="rollout_soak_")
+    os.makedirs(out_dir, exist_ok=True)
+    spill_dir = os.path.join(out_dir, "spill")
+    os.makedirs(spill_dir, exist_ok=True)
+
+    import threading
+    import time
+    import urllib.request
+
+    import jax
+
+    from rocalphago_tpu.gateway.client import run_load
+    from rocalphago_tpu.gateway.server import GatewayServer
+    from rocalphago_tpu.io.metrics import MetricsLogger
+    from rocalphago_tpu.models import CNNPolicy, CNNValue
+    from rocalphago_tpu.obs import registry as obs_registry
+    from rocalphago_tpu.rollout.canary import CanaryController
+    from rocalphago_tpu.rollout.hotswap import HotSwapper, SpillWatcher
+    from rocalphago_tpu.rollout.router import (
+        Replica,
+        RolloutRouter,
+        RouterHTTP,
+    )
+    from rocalphago_tpu.runtime import faults
+    from rocalphago_tpu.runtime.supervisor import Supervisor
+    from rocalphago_tpu.serve.sessions import ServePool
+    from rocalphago_tpu.training.actor import ParamsPublisher
+
+    plan = (args.plan if args.plan is not None else
+            f"kill@gateway.conn:p={args.p_kill},seed={args.seed}")
+    metrics = MetricsLogger(os.path.join(out_dir, "metrics.jsonl"),
+                            echo=False)
+    metrics.log("rollout_soak", phase="start", plan=plan,
+                replicas=args.replicas, conns=args.conns,
+                max_conns=args.max_conns, min_kills=args.min_kills,
+                swaps=args.swaps, seed=args.seed)
+    # compile events into metrics.jsonl: a red compiles_flat check
+    # then NAMES the entry that compiled mid-storm
+    from rocalphago_tpu.obs import trace
+    trace.configure(metrics)
+
+    def compiles() -> int:
+        return sum(v for k, v in obs_registry.REGISTRY.snapshot()
+                   ["counters"].items()
+                   if k.startswith("jax_compiles_total"))
+
+    def shed_counter() -> int:
+        return int(obs_registry.REGISTRY.snapshot()["counters"].get(
+            'router_connections_total{result="shed"}', 0))
+
+    def scale(params, s):
+        return jax.tree.map(lambda x: x * s, params)
+
+    # ------------------------------------------------- the tiny rig
+    feats = ("board", "ones")
+    pol = CNNPolicy(feats, board=args.board, layers=1,
+                    filters_per_layer=2)
+    val = CNNValue(feats + ("color",), board=args.board, layers=1,
+                   filters_per_layer=2)
+    backend_cap = max(args.conns, args.max_conns) + 2
+    pools = [ServePool(val, pol, n_sim=args.sims,
+                       max_sessions=backend_cap,
+                       batch_sizes=(1, 2), max_wait_us=2000.0,
+                       metrics=metrics)]
+    pools[0].warm()
+    for _ in range(1, args.replicas):
+        pools.append(ServePool(val, pol, n_sim=args.sims,
+                               max_sessions=backend_cap,
+                               batch_sizes=(1, 2),
+                               max_wait_us=2000.0,
+                               searcher=pools[0].search))
+    canary = CanaryController(pools[0], fraction=0.5,
+                              min_games=args.canary_games,
+                              metrics=metrics)
+    servers = [GatewayServer(pools[0], max_conns=backend_cap,
+                             metrics=metrics, canary=canary).start()]
+    for p in pools[1:]:
+        servers.append(GatewayServer(p, max_conns=backend_cap,
+                                     metrics=metrics).start())
+    reps = [Replica("127.0.0.1", s.port, gateway=s, name=f"r{i}")
+            for i, s in enumerate(servers)]
+    router = RolloutRouter(reps, max_conns=args.max_conns,
+                           metrics=metrics).start()
+    http = RouterHTTP(router).start()
+    sup = Supervisor(metrics=metrics)
+    sigterm_installed = sup.install_sigterm()
+
+    # the real promotion pipe: publisher spill -> watcher -> swapper
+    swapper = HotSwapper(*pools, metrics=metrics)
+    publisher = ParamsPublisher(spill_dir=spill_dir)
+    watcher = SpillWatcher(spill_dir, swapper, pol.params,
+                           val.params, metrics=metrics)
+
+    # stats lost when a bounced server instance is replaced
+    retired = {"kills": 0, "unhandled": 0}
+
+    def fleet(key_a: str, key_b: str) -> int:
+        live = sum(s.stats()[key_a][key_b] for s in servers)
+        return live + retired.get(key_b, 0)
+
+    def settle(timeout_s: float = 10.0) -> None:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if (router.stats()["conns"]["live"] == 0
+                    and all(s.stats()["conns"]["live"] == 0
+                            for s in servers)):
+                return
+            time.sleep(0.05)
+
+    def load(conns: int) -> dict:
+        return run_load("127.0.0.1", router.port, conns=conns,
+                        moves=args.moves, timeout=60.0)
+
+    def bounce(box: dict) -> None:
+        """Drain + restart the LAST replica under load: its routed
+        games must fail over; the restarted instance rejoins."""
+        idx = len(servers) - 1
+        old = servers[idx]
+        port = old.port
+        old.drain(reason="soak_bounce", timeout=2.0)
+        st = old.stats()
+        retired["kills"] += st["faults"]["kills"]
+        retired["unhandled"] += st["requests"]["unhandled"]
+        old.close()
+        new = GatewayServer(pools[idx], port=port,
+                            max_conns=backend_cap,
+                            metrics=metrics).start()
+        servers[idx] = new
+        reps[idx].gateway = new
+        router.poll_health_once()
+        box["bounces"] = box.get("bounces", 0) + 1
+
+    # --------------------------------------------------- the storm
+    # priming round (fault-free, at the router cap — nothing sheds)
+    # PLUS one warm-up trip through the whole promotion pipe, so
+    # every code path — serving AND the eager param-perturbation
+    # multiply — is compiled before the flatness baseline
+    faults.install("")
+    load(args.max_conns)
+    settle()
+    publisher.publish(scale(pol.params, 1.001),
+                      scale(val.params, 1.001))
+    watcher.poll_once()
+    load(args.max_conns)
+    settle()
+    warm_swaps = swapper.swaps
+    compiles_base = compiles()
+    shed_base = shed_counter()
+
+    totals = {"moves": 0, "sheds": 0, "disconnects": 0, "errors": 0}
+    box: dict = {}
+    rounds = 0
+    t0 = time.monotonic()
+    rc = 0
+    gate = None
+    convergence_ok = False
+    canary_incumbent = None
+    try:
+        while time.monotonic() - t0 < args.deadline_s:
+            if (totals["moves"] > 0 and totals["sheds"] > 0
+                    and fleet("faults", "kills") >= args.min_kills
+                    and swapper.swaps - warm_swaps >= args.swaps
+                    and router.stats()["failovers"] >= 1):
+                break
+            # kill round: the typed fault wall, no bounce racing it.
+            # install() re-parses the spec (hit count resets), so the
+            # seed varies per round — otherwise every round would
+            # replay the same dozen draws and a low p might never
+            # fire no matter how long the soak runs
+            round_plan = (args.plan if args.plan is not None else
+                          f"kill@gateway.conn:p={args.p_kill},"
+                          f"seed={args.seed + rounds}")
+            faults.install(round_plan)
+            out = run_load("127.0.0.1", router.port,
+                           conns=args.conns, moves=args.moves,
+                           timeout=60.0)
+            for k in totals:
+                totals[k] += out[k]
+            faults.install("")
+            settle()
+            # bounce round: promotion + drain/restart UNDER load —
+            # games long enough that the drain lands mid-flight
+            result: dict = {}
+            bounce_moves = max(args.moves, 12)
+
+            def run(res=result):
+                res.update(run_load("127.0.0.1", router.port,
+                                    conns=args.conns,
+                                    moves=bounce_moves,
+                                    timeout=60.0))
+
+            t = threading.Thread(target=run, name="soak-load")
+            t.start()
+            time.sleep(0.05)         # let games get in flight
+            publisher.publish(
+                scale(pol.params, 1.0 + 0.002 * (rounds + 1)),
+                scale(val.params, 1.0 + 0.002 * (rounds + 1)))
+            if not watcher.poll_once():
+                metrics.log("rollout_soak", phase="swap_miss",
+                            round=rounds)
+            bounce(box)
+            t.join(timeout=90.0)
+            for k in totals:
+                totals[k] += result.get(k, 0)
+            rounds += 1
+            settle()
+    finally:
+        faults.install("")
+        settle()
+        # fleet convergence: every replica serves the same version
+        router.poll_health_once()
+        versions = [r.params_version for r in reps]
+        target = max((v for v in versions if v is not None),
+                     default=None)
+        convergence_ok = (target is not None
+                          and router.await_convergence(target,
+                                                       timeout=10.0))
+
+        # ------------------------------- the weak canary, rejected
+        canary_incumbent = pools[0].params_version
+        try:
+            canary.stage(scale(pol.params, 0.5),
+                         scale(val.params, 0.5))
+            metrics.log("rollout_soak", phase="canary_staged")
+        except Exception as e:  # noqa: BLE001 — a red check, not a
+            #                     harness crash
+            metrics.log("rollout_soak", phase="canary_error",
+                        error=f"{type(e).__name__}: {e}")
+
+        # ------------------------------------------- the clean gate
+        metrics.log("rollout_soak", phase="gate")
+        try:
+            gate = load(args.max_conns)
+        except Exception as e:  # noqa: BLE001 — a red gate is a
+            #                     verdict, not a harness crash
+            metrics.log("rollout_soak", phase="gate_error",
+                        error=f"{type(e).__name__}: {e}")
+        settle()
+        # the weak candidate loses its decided games -> the Wilson
+        # gate must roll it back on its own (no manual rollback)
+        if canary.stats()["state"] == "running":
+            for i in range(args.canary_games):
+                canary.record("candidate", won=(i == 0))
+        canary_final = canary.stats()
+        compiles_after = compiles()
+
+        # -------------------------- scrape the sheds off /metrics
+        metrics_shed = None
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/metrics",
+                timeout=10.0).read().decode()
+            for line in body.splitlines():
+                if line.startswith(
+                        'router_connections_total{result="shed"}'):
+                    metrics_shed = int(float(line.split()[-1])) \
+                        - shed_base
+        except Exception as e:  # noqa: BLE001 — counted as a miss
+            metrics.log("rollout_soak", phase="scrape_error",
+                        error=f"{type(e).__name__}: {e}")
+
+        # ------------------------------------- the SIGTERM drain
+        if sigterm_installed:
+            os.kill(os.getpid(), signal.SIGTERM)
+            drain_t0 = time.monotonic()
+            while (not sup.draining
+                   and time.monotonic() - drain_t0 < 10.0):
+                time.sleep(0.02)
+        else:                  # not the main thread (test harness)
+            sup.request_drain(reason="sigterm")
+        router.drain(reason="sigterm")
+        for s in servers:
+            s.drain(reason="sigterm")
+        router_final = router.stats()
+        fleet_live = sum(s.stats()["conns"]["live"] for s in servers)
+        fleet_unhandled = fleet("requests", "unhandled")
+        kills = fleet("faults", "kills")
+        pool_live = sum(p.stats()["sessions"]["live"] for p in pools)
+        http.close()
+        router.close()
+        for s in servers:
+            s.close()
+        for p in pools:
+            p.close()
+        sup.restore_sigterm()
+        faults.install(None)
+
+    # ------------------------------------------------- the verdict
+    failovers = router_final["failovers"]
+    retried = router_final["retried_genmoves"]
+    summary = {
+        "plan": plan,
+        "rounds": rounds,
+        "replicas": args.replicas,
+        "bounces": box.get("bounces", 0),
+        "moves": totals["moves"],
+        "sheds_client": totals["sheds"],
+        "sheds_router": router_final["conns"]["shed"],
+        "sheds_metrics": metrics_shed,
+        "disconnects": totals["disconnects"],
+        "client_errors": totals["errors"],
+        "kills": kills,
+        "unhandled": fleet_unhandled,
+        "swaps": swapper.swaps,
+        "storm_swaps": swapper.swaps - warm_swaps,
+        "rollout_version": swapper.version,
+        "converged": convergence_ok,
+        "failovers": failovers,
+        "spillovers": router_final["spillovers"],
+        "retried_genmoves": retried,
+        "compiles_base": compiles_base,
+        "compiles_delta": compiles_after - compiles_base,
+        "canary": canary_final,
+        "canary_incumbent": canary_incumbent,
+        "gate": gate,
+        "drained": router_final["draining"],
+        "live_conns_after_drain": router_final["conns"]["live"],
+        "fleet_conns_after_drain": fleet_live,
+        "pool_sessions_after_drain": pool_live,
+        "sigterm_installed": sigterm_installed,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    checks = {
+        "moves_landed": totals["moves"] > 0,
+        "sheds_observed": totals["sheds"] > 0,
+        "sheds_reconciled": (metrics_shed is not None
+                             and totals["sheds"]
+                             == router_final["conns"]["shed"]
+                             == metrics_shed > 0),
+        "min_kills": kills >= args.min_kills,
+        "no_unhandled": fleet_unhandled == 0,
+        "swaps_applied": swapper.swaps - warm_swaps >= args.swaps,
+        "compiles_flat": compiles_after == compiles_base,
+        "fleet_converged": convergence_ok,
+        "failover_exercised": failovers >= 1,
+        "retried_genmoves_bounded": retried <= failovers,
+        "canary_rolled_back": (
+            canary_final["state"] == "rolled_back"
+            and canary_final["rollbacks"] == 1
+            and canary_final["incumbent_version"]
+            == canary_incumbent),
+        "gate_green": (gate is not None and gate["sheds"] == 0
+                       and gate["disconnects"] == 0
+                       and gate["errors"] == 0
+                       and gate["moves"]
+                       == args.max_conns * args.moves),
+        "drain_clean": (router_final["draining"]
+                        and router_final["conns"]["live"] == 0
+                        and fleet_live == 0
+                        and pool_live == 0),
+    }
+    summary["checks"] = checks
+    metrics.log("rollout_soak", phase="done", **{
+        k: v for k, v in summary.items()
+        if k not in ("checks", "canary", "gate")})
+    metrics.close()
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2))
+    if rc == 0 and not all(checks.values()):
+        rc = 1
+    if rc:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"rollout_soak: FAILED checks: {failed}",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
