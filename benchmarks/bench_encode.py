@@ -129,12 +129,15 @@ def _trajectory_ab(cfg, args) -> None:
             out = enc(st)
         return jax.device_get(out)
 
+    last = {}
+
     def run_incr():
         # cache cold at the tail start each rep (honest: the warmup
         # ply is in the average, amortized over the tail)
         cache, out = cache0, None
         for st in states_seq:
             out, cache = step_fn(st, cache)
+        last["stats"] = jax.device_get(cache.stats)
         return jax.device_get(out)
 
     dt_s = timed(run_scratch, reps=args.reps)
@@ -146,6 +149,21 @@ def _trajectory_ab(cfg, args) -> None:
     report("encode_incr", plies / dt_i, "positions/s",
            baseline=rate_s, board=args.board, plies=plies,
            us_per_pos=round(1e6 * dt_i / plies, 1))
+    # the invalidation cascade behind the incr number (one rep's
+    # device-side stat vector): how many footprint hits the coarse
+    # region keys let through, how many survived the cell test as
+    # real invalidations, and how many chases a flipped dormant
+    # verdict forced — the tentpole's tightening, as a recorded row
+    s = {f: int(v) for f, v in zip(incr.STAT_FIELDS, last["stats"])}
+    report("encode_incr_cascade",
+           s["entries_invalidated"] / plies, "invalidations/ply",
+           board=args.board, plies=plies,
+           foot_hits=s["foot_hits"],
+           verdict_flips=s["verdict_flips"],
+           entries_revived=s["entries_revived"],
+           chases_run=s["chases_run"],
+           verdicts_reused=s["verdicts_reused"],
+           lanes_refreshed=s["lanes_refreshed"])
 
     if not args.traj_batch:
         return
@@ -285,6 +303,27 @@ def main() -> None:
         report("encode_noladder", batch / dt, "positions/s",
                batch=batch, board=args.board,
                us_per_pos=round(1e6 * dt / batch, 1))
+        # the same floor reached the way an operator reaches it: the
+        # ROCALPHAGO_LADDER_PLANES=off feature-spec path (the
+        # ladder-free self-play configuration). Must land within 1.5×
+        # of the raw no-ladder row above — the knob path adds no
+        # hidden tax, it just drops the planes from the spec.
+        from rocalphago_tpu.features.pyfeatures import active_features
+
+        prev = os.environ.get("ROCALPHAGO_LADDER_PLANES")
+        os.environ["ROCALPHAGO_LADDER_PLANES"] = "off"
+        try:
+            lf = active_features(DEFAULT_FEATURES)
+            dt = measure(lf)
+            report("encode_noladder_net", batch / dt, "positions/s",
+                   batch=batch, board=args.board,
+                   ladder_planes="off", planes=len(lf),
+                   us_per_pos=round(1e6 * dt / batch, 1))
+        finally:
+            if prev is None:
+                os.environ.pop("ROCALPHAGO_LADDER_PLANES", None)
+            else:
+                os.environ["ROCALPHAGO_LADDER_PLANES"] = prev
 
     impl_env = {"xla": "", "pallas": "1", "interpret": "interpret"}
     for impl in args.impl.split(","):

@@ -14,6 +14,11 @@ from rocalphago_tpu.features.api import Preprocess  # noqa: F401
 from rocalphago_tpu.features.pyfeatures import (  # noqa: F401
     DEFAULT_FEATURES,
     FEATURE_PLANES,
+    LADDER_FEATURES,
     VALUE_FEATURES,
+    active_features,
+    default_features,
+    ladder_planes_enabled,
     output_planes,
+    value_features,
 )

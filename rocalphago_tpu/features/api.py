@@ -34,6 +34,7 @@ from rocalphago_tpu.features.planes import encode
 from rocalphago_tpu.features.pyfeatures import (
     DEFAULT_FEATURES,
     FEATURE_PLANES,
+    LADDER_FEATURES,
     output_planes,
 )
 from rocalphago_tpu.obs import jaxobs, trace
@@ -149,6 +150,15 @@ class Preprocess:
         self._positions = obs_registry.counter(
             "encode_positions_total", board=board)
         self._full = obs_registry.counter("encode_full_total")
+        # which plane family this encoder pays for — the ladder-free
+        # configuration's footprint in a run's metrics (serve pools,
+        # trainers and actors all build their encoders here, so the
+        # counter says whether ANY live encoder still carries the
+        # handcrafted ladder planes)
+        ladder = any(f in LADDER_FEATURES for f in self.feature_list)
+        obs_registry.counter(
+            "encode_encoders_total",
+            planes="ladder" if ladder else "noladder").inc()
         # incremental (delta) encode state — see :meth:`advance`:
         # the jitted encode_step program (built on first use), the
         # carried EncodeCache, and the last snapshot of its on-device

@@ -22,10 +22,20 @@ recomputes only what a move can change:
   decided directly) and, when a pooled chase ran, its chase VERDICT
   are recorded together with one read FOOTPRINT (the chase's
   accumulated core expanded once by
-  :func:`ladders._chase_read_region`). A cached outcome stays valid
-  exactly while no cell of its footprint has changed — a stone only
-  flips a distant ladder if it lands on or adjacent to that ladder's
-  recorded chase path (the footprint rule).
+  :func:`ladders._chase_read_region`) AND the record-time board. A
+  cached outcome is consulted exactly while the CURRENT board matches
+  the entry's recorded board on every footprint cell — a stone only
+  flips a distant ladder if it lands on that ladder's recorded read
+  region (the footprint rule). Unrelated stone churn therefore never
+  KILLS an entry: the per-ply test is two-tier — a coarse per-board-
+  region bitmask key (``REGION_BLOCK``² cell blocks packed into one
+  uint32) cheaply clears entries whose footprint regions saw no churn
+  at all, and only region-suspect entries pay the cell-exact
+  comparison against their recorded board. An entry that fails the
+  cell test goes DORMANT rather than dying — it revives the ply the
+  board drifts back to its recorded footprint state (common around
+  short capture/recapture exchanges), because the comparison is
+  absolute, not a one-ply delta.
 
 On the single-state sequential path (GTP root advance, ``Preprocess``
 ``advance``, ``bench_encode --trajectory``) the expensive blocks sit
@@ -86,6 +96,7 @@ from rocalphago_tpu.engine.jaxgo import (
 )
 from rocalphago_tpu.features.ladders import (
     _candidate_lanes,
+    _compact_indices,
     _capture_opening,
     _chase,
     _chase_read_regions,
@@ -117,13 +128,52 @@ VERDICT_SLOTS = 128
 #: that the per-ply record/expansion work stops paying for idle lanes.
 REFRESH_SLOTS = (8, 4)
 
-# stats vector layout (int32 [6], accumulated on device; host
-# boundaries snapshot it into the obs registry — see features/api.py)
+# stats vector layout (int32 [9], accumulated on device; host
+# boundaries snapshot it into the obs registry — see features/api.py,
+# which iterates STAT_FIELDS generically, so new fields flow straight
+# to ``encode_incr_<field>_total`` counters). The last three are the
+# invalidation-cascade view: ``foot_hits`` counts region-coarse key
+# hits (entries whose footprint REGIONS saw churn and paid the
+# cell-exact test), ``entries_invalidated`` the subset that actually
+# failed it and went dormant, ``verdict_flips`` the chases forced by
+# a dormant entry's cached verdict (re-chases of known ladders — the
+# cascade's cost), and ``entries_revived`` dormant entries whose
+# footprint drifted back to its recorded state.
 (STAT_ENCODES, STAT_REFRESHED, STAT_CHASES, STAT_REUSED,
- STAT_INVALIDATED, STAT_FALLBACKS) = range(6)
+ STAT_INVALIDATED, STAT_FALLBACKS, STAT_FOOT_HITS, STAT_FLIPS,
+ STAT_REVIVED) = range(9)
 STAT_FIELDS = ("encodes", "lanes_refreshed", "chases_run",
                "verdicts_reused", "entries_invalidated",
-               "refresh_fallbacks")
+               "refresh_fallbacks", "foot_hits", "verdict_flips",
+               "entries_revived")
+
+#: side length of the square cell blocks the coarse footprint keys
+#: quantize the board into. One uint32 bit per block: 4 → 25 regions
+#: at 19×19 (the bitmask folds mod 32 on boards that would exceed 32
+#: regions — still sound, just coarser).
+REGION_BLOCK = 4
+
+
+def _region_ids(cfg: GoConfig):
+    """int32 [N]: each cell's coarse-region bit position (< 32)."""
+    size = cfg.size
+    per_row = -(-size // REGION_BLOCK)
+    flat = jnp.arange(cfg.num_points)
+    rid = ((flat // size) // REGION_BLOCK) * per_row \
+        + (flat % size) // REGION_BLOCK
+    return rid % 32
+
+
+def _region_bits(cfg: GoConfig, cells):
+    """Pack a cell mask (bool [..., N]) into its coarse-region
+    bitmask (uint32 [...]): bit r set iff any cell of region r is
+    set. Two footprints can interact only if their bitmasks AND —
+    the cheap first tier of the invalidation test."""
+    onehot = _region_ids(cfg)[:, None] == jnp.arange(32)[None, :]
+    hit = (cells[..., :, None] & onehot).any(axis=-2)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    # regions are distinct bits, so the sum IS the bitwise OR
+    return (hit * weights).sum(axis=-1, dtype=jnp.uint32)
 
 
 def enabled(default: bool) -> bool:
@@ -152,7 +202,12 @@ class EncodeCache(NamedTuple):
     lane identity ``(move, prey root, prey color, lane kind)`` and
     holds the opening outcome (``need``/``direct``), the pooled-chase
     verdict when one ran (``verdict`` valid iff ``has_verdict``), and
-    the read footprint that guards it all."""
+    the dependency guard: the read footprint, its coarse-region
+    bitmask key, and the record-time board the footprint cells are
+    revalidated against (an entry is CONSULTED while the current
+    board matches ``entry_board`` on every ``entry_foot`` cell — a
+    mismatched entry is dormant, not dead, and revives if the board
+    drifts back)."""
 
     board: jax.Array            # int8 [N]  board at the last encode
     entry_key: jax.Array        # int32 [V] packed lane key: move |
@@ -162,10 +217,19 @@ class EncodeCache(NamedTuple):
     entry_direct: jax.Array     # bool [V]  opening → decided directly
     entry_verdict: jax.Array    # bool [V]  chase verdict (captured)
     entry_has_verdict: jax.Array  # bool [V]
-    entry_valid: jax.Array      # bool [V]
+    entry_valid: jax.Array      # bool [V]  slot written & not superseded
     entry_foot: jax.Array       # bool [V, N] recorded read footprint
+    entry_board: jax.Array      # int8 [V, N] board at record time —
+    #   only its entry_foot cells are ever consulted
+    entry_footmask: jax.Array   # uint32 [V] coarse-region key of foot
+    entry_clean: jax.Array      # bool [V] footprint regions unchurned
+    #   since the last passing cell test (clean ⇒ board matches
+    #   entry_board on entry_foot — the cell test is skipped)
+    entry_live: jax.Array       # bool [V] last ply's consult verdict
+    #   (valid & cell-test pass) — transition bookkeeping for the
+    #   invalidated/revived stats
     ptr: jax.Array              # int32 []  ring write pointer
-    stats: jax.Array            # int32 [6] see STAT_FIELDS
+    stats: jax.Array            # int32 [9] see STAT_FIELDS
 
 
 def init_cache(cfg: GoConfig,
@@ -183,6 +247,10 @@ def init_cache(cfg: GoConfig,
         entry_has_verdict=jnp.zeros((v,), jnp.bool_),
         entry_valid=jnp.zeros((v,), jnp.bool_),
         entry_foot=jnp.zeros((v, n), jnp.bool_),
+        entry_board=jnp.zeros((v, n), jnp.int8),
+        entry_footmask=jnp.zeros((v,), jnp.uint32),
+        entry_clean=jnp.zeros((v,), jnp.bool_),
+        entry_live=jnp.zeros((v,), jnp.bool_),
         ptr=jnp.int32(0),
         stats=jnp.zeros((len(STAT_FIELDS),), jnp.int32),
     )
@@ -222,11 +290,24 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
     right trace under ``vmap``, where ``lax.switch`` would execute
     every branch anyway.
 
-    Invalidation: ``changed = board != cache.board`` (the one-ply
-    delta — played point, captured strings, and through the
-    footprint's group-halo construction any liberty-frontier change
-    of a string the read depended on); an entry dies the ply any
-    footprint cell changes.
+    Invalidation is two-tier and ABSOLUTE (not a one-ply delta):
+
+    1. coarse-region keys — the one-ply churn ``board !=
+       cache.board`` is packed into a per-region uint32 bitmask
+       (:func:`_region_bits`); entries whose footprint-region key
+       doesn't intersect it provably still match their recorded
+       board (the carried ``entry_clean`` invariant) and skip tier 2;
+    2. cell-exact revalidation — region-suspect entries compare the
+       CURRENT board against their RECORD-TIME board on their exact
+       footprint cells. A match means every re-run of the recorded
+       read would see identical cells (the memoization induction), so
+       the entry is consulted as if untouched — churn in the region's
+       slop cells, or churn that has since reverted (capture /
+       recapture), costs nothing. Only a genuine footprint mismatch
+       makes the entry DORMANT: unmatched by lookups, so its lane
+       re-opens (and re-chases if still live) and re-records — but
+       the entry itself persists until superseded and revives if the
+       board drifts back to its recorded footprint state.
     """
     n = cfg.num_points
     v = cache.entry_key.shape[0]
@@ -260,20 +341,49 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
                 | ((prey_color.astype(jnp.int32) + 1) << 20)
                 | (kind.astype(jnp.int32) << 22))
 
-    # --- 2. invalidate + look up ---
+    # --- 2. invalidate + look up: tier 1, the coarse-region keys —
+    # one uint32 AND against the ply's churn bitmask clears entries
+    # whose footprint regions saw nothing (entry_clean invariant:
+    # clean ⇒ board still matches entry_board on entry_foot) ---
     changed = state.board != cache.board
-    still = cache.entry_valid & ~(
-        cache.entry_foot & changed[None, :]).any(axis=-1)
-    invalidated = (cache.entry_valid & ~still).sum(dtype=jnp.int32)
+    churn_bits = _region_bits(cfg, changed)
+    region_hit = (cache.entry_footmask & churn_bits) != 0
+    clean = cache.entry_clean & ~region_hit
+    suspect = cache.entry_valid & ~clean
+    foot_hits = (cache.entry_valid & region_hit).sum(dtype=jnp.int32)
 
-    match = still[None, :] & (
-        cache.entry_key[None, :] == lane_key[:, None])         # [K, V]
+    # tier 2, cell-exact revalidation of the suspects: absolute
+    # comparison against the RECORD-TIME board restricted to the
+    # recorded footprint — region slop and reverted churn pass and
+    # cost nothing; a genuine mismatch makes the entry dormant (it
+    # revives if the board drifts back). Skipped entirely on plies
+    # with no suspects (the common warm ply).
+    def cell_test(_):
+        return ((state.board[None, :] != cache.entry_board)
+                & cache.entry_foot).any(axis=-1)
+
+    cellbad = suspect & lax.cond(
+        suspect.any(), cell_test,
+        lambda _: jnp.zeros((v,), jnp.bool_), None)
+    live = cache.entry_valid & ~cellbad
+    entry_clean = cache.entry_valid & ~cellbad
+    invalidated = (cache.entry_live & ~live).sum(dtype=jnp.int32)
+    revived = (live & cache.entry_valid
+               & ~cache.entry_live).sum(dtype=jnp.int32)
+
+    keymatch = cache.entry_key[None, :] == lane_key[:, None]   # [K, V]
+    match = live[None, :] & keymatch
     hit = match.any(axis=-1) & ok
     ent = jnp.argmax(match, axis=-1)
     c_need = cache.entry_need[ent] & hit
     c_direct = cache.entry_direct[ent] & hit
     c_has = cache.entry_has_verdict[ent] & hit
     c_verdict = cache.entry_verdict[ent]
+    # a lane whose key matches only a DORMANT verdict entry is a
+    # verdict flip when it actually re-chases (the cascade stat)
+    dormant_verdict = ((cache.entry_valid & ~live
+                        & cache.entry_has_verdict)[None, :]
+                       & keymatch).any(axis=-1)
 
     # --- 3. refresh set: unknown opening, or a verdict gap (a hit
     # lane that needs a chase but has no recorded verdict must re-open
@@ -300,7 +410,7 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
         fallback that keeps compaction a pure optimization), skipped
         when clean. Returns full-width rows + the compact index."""
         nk = kref.sum(dtype=jnp.int32)
-        (idx,) = jnp.nonzero(kref, size=w, fill_value=lanes)
+        idx = _compact_indices(kref, w, lanes)
         valid = idx < lanes
         safe = jnp.where(valid, idx, 0)
         zb = jnp.broadcast_to(state.board, (lanes, n))
@@ -350,7 +460,7 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
 
     # --- 4. slot assignment over ALL need-lanes (coverage parity with
     # the from-scratch shared pool: hit lanes consume slots too) ---
-    (slot_idx,) = jnp.nonzero(need, size=chase_slots, fill_value=k)
+    slot_idx = _compact_indices(need, chase_slots, k)
     svalid = slot_idx < k
     ssafe = jnp.where(svalid, slot_idx, 0)
     covered = zero_f.at[slot_idx].set(svalid, mode="drop")
@@ -379,8 +489,7 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
         zero_core = jnp.zeros((chase_slots, n), jnp.bool_)
 
         def narrow(_):
-            (widx,) = jnp.nonzero(run, size=2,
-                                  fill_value=chase_slots)
+            widx = _compact_indices(run, 2, chase_slots)
             capt, core = zero_cap, zero_core
             for j in range(2):
                 live = widx[j] < chase_slots
@@ -400,8 +509,8 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
                     collect_core=True, core0=c0))(
                     boards_s, labels_s, prey, run, open_core)
             if depth > d1:
-                (deep_idx,) = jnp.nonzero(unres, size=chase_slots,
-                                          fill_value=chase_slots)
+                deep_idx = _compact_indices(unres, chase_slots,
+                                            chase_slots)
                 for s in range(chase_slots):
                     idx = deep_idx[s]
                     live = idx < chase_slots
@@ -454,17 +563,22 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
                        | (iota[None, :] == mv[rsafe][:, None])
                        | (boards_f[rsafe] != state.board[None, :]))
         core_w = (open_core_w | chase_core[rsafe]) & rvalid[:, None]
-        return _chase_read_regions(cfg, state.board, gd.labels,
+        foot = _chase_read_regions(cfg, state.board, gd.labels,
                                    core_w)
+        return foot, _region_bits(cfg, foot)
 
-    foot_w = lax.cond(
+    foot_w, footbits_w = lax.cond(
         any_rec, expand_block,
-        lambda _: jnp.zeros((rec, n), jnp.bool_), None)
+        lambda _: (jnp.zeros((rec, n), jnp.bool_),
+                   jnp.zeros((rec,), jnp.uint32)), None)
 
-    # entries superseded by a refreshed lane die before the ring write
-    # (else a stale twin of the key could shadow the new entry)
-    superseded = (match & refresh[:, None]).any(axis=0)
-    still = still & ~superseded
+    # entries superseded by a re-recorded lane die before the ring
+    # write — dormant twins included, else a later revival could
+    # shadow the fresher entry (either would be correct — each entry
+    # is a self-contained memoization — but one canonical entry per
+    # key keeps the ring honest)
+    rec_lane = zero_f.at[ridx].set(True, mode="drop")
+    superseded = (keymatch & rec_lane[:, None]).any(axis=0)
 
     dest = jnp.where(rvalid, (cache.ptr + jnp.arange(rec)) % v, v)
     n_new = rvalid.sum(dtype=jnp.int32)
@@ -480,11 +594,20 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
             chased[rsafe], mode="drop"),
         entry_has_verdict=cache.entry_has_verdict.at[dest].set(
             ran[rsafe], mode="drop"),
-        entry_valid=still.at[dest].set(rvalid, mode="drop"),
+        entry_valid=(cache.entry_valid & ~superseded).at[dest].set(
+            rvalid, mode="drop"),
         entry_foot=cache.entry_foot.at[dest].set(
             foot_w, mode="drop"),
+        entry_board=cache.entry_board.at[dest].set(
+            jnp.broadcast_to(state.board, (rec, n)), mode="drop"),
+        entry_footmask=cache.entry_footmask.at[dest].set(
+            footbits_w, mode="drop"),
+        entry_clean=(entry_clean & ~superseded).at[dest].set(
+            rvalid, mode="drop"),
+        entry_live=(live & ~superseded).at[dest].set(
+            rvalid, mode="drop"),
         ptr=(cache.ptr + n_new) % v,
-        # one vector add, not five scalar scatters — the warm path is
+        # one vector add, not nine scalar scatters — the warm path is
         # op-dispatch-bound on CPU (STAT_* layout)
         stats=cache.stats + jnp.stack(
             [jnp.int32(0),
@@ -492,7 +615,10 @@ def ladder_planes_cached(cfg: GoConfig, state: GoState, gd, legal,
              run.sum(dtype=jnp.int32),
              (svalid & (hit & c_has)[ssafe]).sum(dtype=jnp.int32),
              invalidated,
-             fellback.astype(jnp.int32)]),
+             fellback.astype(jnp.int32),
+             foot_hits,
+             (run & dormant_verdict[ssafe]).sum(dtype=jnp.int32),
+             revived]),
     )
     return plane_cap, plane_esc, new_cache
 

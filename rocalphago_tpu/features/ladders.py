@@ -313,6 +313,22 @@ def _escaper_response_fast(cfg: GoConfig, b1, prey_pt, prey_color,
     return preyL1, respL, b2
 
 
+def _foot_mode() -> str:
+    """Which footprint expansion :func:`_chase_read_regions` traces:
+    ``"tight"`` (default) derives the region from the actual reads of
+    the 2-ply algebra (see that function's derivation), ``"wide"``
+    keeps the pre-tightening blanket (``dilate²`` of everything plus a
+    second group pass over it) as the A/B baseline and safety valve.
+    Both are sound over-approximations; wide is strictly larger, so it
+    only costs reuse. Read from ``$ROCALPHAGO_LADDER_FOOT`` at trace
+    time (same policy as the other ladder knobs). MEASURED: tight cuts
+    the footprint-churn re-chase cascade that capped incremental
+    encode at ~2.1–2.3× — see BENCH_RESULTS.md "Incremental encode"
+    and the ``encode_cascade`` row of ``bench_encode.py``."""
+    v = os.environ.get("ROCALPHAGO_LADDER_FOOT", "tight")
+    return "wide" if v in ("wide", "0", "off") else "tight"
+
+
 def _chase_read_region(cfg: GoConfig, board, labels, core):
     """Sound over-approximation of the board cells a chase's (or an
     opening's) analysis can read, radiating from the accumulated
@@ -332,24 +348,37 @@ def _chase_read_region(cfg: GoConfig, board, labels, core):
     touching X on the simulated board" is covered by "groups touching
     ``dilate(X ∪ core)`` on the real board" plus ``core`` itself.
 
-    The reads fall into three rings, each covered by derivation (the
-    2-ply response algebra of :func:`_escaper_response_full` reads at
-    most 2 steps from the prey/played cells, whole adjacent groups'
-    liberty counts, and the counter-capture ring around those groups —
-    see docs/PERFORMANCE.md "Incremental encode"):
+    Derivation of the TIGHT region (default; every read of
+    :func:`_place` / :func:`_escaper_response_full` / the rung body is
+    accounted for — the wide pre-tightening blanket is kept behind
+    ``$ROCALPHAGO_LADDER_FOOT=wide``):
 
-    * ``dilate²(core)`` — liberty points (1 step), both chaser
-      options' neighborhoods (2 steps); simulated-merge bridging needs
-      no extra step because the bridging played cells are themselves
-      in ``core``, putting every bridged group a single step away;
-    * WHOLE groups with a stone in that region plus their own halo
-      (liberty counts are group-global: a far merge or liberty change
-      flips them);
-    * the counter-capture machinery can play at a liberty of any such
-      group (1 step) and read around it (2 steps) — one more
-      group-and-halo pass over ``dilate²`` of the first ring.
+    * ``D2 = dilate²(core)`` — the prey's liberty points are 1 step
+      from ``core``, both chaser options and the extension response
+      read their own 4-neighborhoods at those points (2 steps), and
+      simulated-merge bridging needs no extra step because the
+      bridging played cells are themselves in ``core``;
+    * ``grp1`` — WHOLE groups with a stone in ``D2``: every group
+      whose liberty count, membership or capture the algebra consults
+      at the first level (chaser groups at the options, merge
+      partners, atari/counter-capture targets) touches the prey or a
+      played/option point, i.e. has a stone within ``D2``. Liberty
+      counts are group-global, so the whole extent matters, and their
+      liberties live in ``dilate(grp1)``;
+    * ``R2 = dilate²(grp1)`` — the counter-capture response plays at
+      a liberty of a ``grp1`` target (1 step off it) and reads that
+      point's own neighborhood (1 more step);
+    * ``grp2`` — whole groups with a stone in ``R2 ∪ D2``: the groups
+      the counter-capture's legality/merge/capture checks consult
+      around its response point, plus (re-)covering the first level;
+      their liberty reads live in ``dilate(grp2)``.
 
-    Over-approximation only costs reuse, never correctness."""
+    The wide blanket additionally dilates the ENTIRE first ring by two
+    (``dilate⁴(core)``) before the second group pass — for a long
+    chase path that near-doubles the band around the whole path, which
+    is exactly the footprint-churn cascade the incremental encoder
+    measured as its limiter. Over-approximation only costs reuse,
+    never correctness; tight ⊂ wide by construction."""
     return _chase_read_regions(cfg, board, labels, core[None, :])[0]
 
 
@@ -391,9 +420,13 @@ def _chase_read_regions(cfg: GoConfig, board, labels, cores):
 
     region = dilate(cores, 2)
     grp1 = groups_touching(region)
-    ring = dilate(region | grp1, 2)
-    grp2 = groups_touching(ring)
-    return ring | grp2 | dilate(grp2, 1)
+    if _foot_mode() == "wide":
+        ring = dilate(region | grp1, 2)
+        grp2 = groups_touching(ring)
+        return ring | grp2 | dilate(grp2, 1)
+    ring = dilate(grp1, 2)                  # counter-capture ring
+    grp2 = groups_touching(ring | region)
+    return region | grp1 | ring | grp2 | dilate(grp2, 1)
 
 
 def _chase(cfg: GoConfig, board0, labels0, prey_pt, depth: int,
@@ -576,7 +609,7 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
     covered [K])`` where ``covered`` marks lanes whose chase actually
     ran."""
     k = need_chase.shape[0]
-    (slot_idx,) = jnp.nonzero(need_chase, size=slots, fill_value=k)
+    slot_idx = _compact_indices(need_chase, slots, k)
     valid = slot_idx < k
     safe = jnp.where(valid, slot_idx, 0)
     if os.environ.get("ROCALPHAGO_DEBUG_LADDER_OVERFLOW") == "1":
@@ -618,8 +651,7 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
                                       return_state=True))(
                 boards[safe], labels[safe], prey, valid)
         if depth > d1:
-            (deep_idx,) = jnp.nonzero(unres, size=slots,
-                                      fill_value=slots)
+            deep_idx = _compact_indices(unres, slots, slots)
             for s in range(slots):
                 idx = deep_idx[s]
                 live = idx < slots
@@ -639,6 +671,25 @@ def _compacted_chase(cfg: GoConfig, boards, labels, prey_pts,
     scatter = jnp.zeros((k,), jnp.bool_)
     return (scatter.at[slot_idx].set(captured & valid, mode="drop"),
             scatter.at[slot_idx].set(valid, mode="drop"))
+
+
+def _compact_indices(mask, size: int, fill_value):
+    """First ``size`` set indices of a 1-D bool mask, ascending,
+    padded with ``fill_value`` — the shared compaction primitive of
+    the candidate/slot machinery (here and the incremental refresh
+    scheduler).
+
+    Kept as ``jnp.nonzero(size=..., fill_value=...)`` BY MEASUREMENT:
+    a scatter-free rewrite (log-depth ``associative_scan`` ranks +
+    per-slot argmax gather over the ``[size, N]`` rank-match matrix)
+    looked faster in profiler traces of the warm no-churn floor, but
+    regressed the real 19x19 trajectory benchmark from ~2.5 ms to
+    ~4.3 ms per position — XLA:CPU's sized-nonzero lowering beats the
+    dense comparison matrix once chases actually run. Trace spans
+    overweight the serial while-loops; trust the wall-clock bench
+    (docs/PERFORMANCE.md "Incremental encode")."""
+    return jnp.nonzero(mask, size=size,
+                       fill_value=fill_value)[0].astype(jnp.int32)
 
 
 def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
@@ -666,8 +717,7 @@ def _candidate_lanes(cfg: GoConfig, state: GoState, gd: GroupData,
     want = -state.turn if prey_is_opp else state.turn
     cand = (legal[:, None] & uniq & (nbr_color == want)
             & (gd.lib_counts[nbr_root] == prey_libs))   # [N, 4]
-    (flat_idx,) = jnp.nonzero(cand.reshape(-1), size=lanes,
-                              fill_value=4 * n)
+    flat_idx = _compact_indices(cand.reshape(-1), lanes, 4 * n)
     valid = flat_idx < 4 * n
     safe = jnp.where(valid, flat_idx, 0)
     move_pt = (safe // 4).astype(jnp.int32)

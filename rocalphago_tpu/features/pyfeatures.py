@@ -38,6 +38,8 @@ differ by 2·komi). ``VALUE_FEATURES`` is the 49-plane value-net set.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from rocalphago_tpu.engine import pygo
@@ -50,6 +52,42 @@ DEFAULT_FEATURES = (
 
 # the value net's 49-plane input: the 48 policy planes + player color
 VALUE_FEATURES = DEFAULT_FEATURES + ("color",)
+
+#: the two handcrafted ladder plane groups — ~88% of encode cost
+#: (bench_encode.py no-ladder row), the target of the ladder-free
+#: self-play configuration (docs/PERFORMANCE.md "Ladder-free encode")
+LADDER_FEATURES = ("ladder_capture", "ladder_escape")
+
+
+def ladder_planes_enabled() -> bool:
+    """ROCALPHAGO_LADDER_PLANES: ``off``/``0`` drops both handcrafted
+    ladder planes from NEW feature specs (the KataGo route: the net
+    recovers the signal via global pooling + aux heads instead of the
+    encoder paying for it every position). Default on — the shipped
+    48/49-plane encoding. Read where specs are BORN (models/specs.py
+    CLI, fresh-net defaults); nets loaded from a saved spec keep the
+    feature list they were trained with regardless of this knob."""
+    return os.environ.get("ROCALPHAGO_LADDER_PLANES", "on") \
+        not in ("off", "0")
+
+
+def active_features(features) -> tuple:
+    """``features`` minus the ladder plane groups when
+    ``ROCALPHAGO_LADDER_PLANES=off`` — unchanged (same tuple) when the
+    knob is on, so the defaults-on path is bit-identical."""
+    if ladder_planes_enabled():
+        return tuple(features)
+    return tuple(f for f in features if f not in LADDER_FEATURES)
+
+
+def default_features() -> tuple:
+    """Knob-aware policy feature set (48 planes, 46 ladder-free)."""
+    return active_features(DEFAULT_FEATURES)
+
+
+def value_features() -> tuple:
+    """Knob-aware value feature set (49 planes, 47 ladder-free)."""
+    return active_features(VALUE_FEATURES)
 
 FEATURE_PLANES = {
     "board": 3, "ones": 1, "turns_since": 8, "liberties": 8,

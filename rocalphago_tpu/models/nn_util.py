@@ -48,26 +48,68 @@ NEURALNETS: dict[str, type] = {}
 SPEC_FORMAT = 2
 
 
+class GlobalPoolBias(nn.Module):
+    """KataGo-style global-pooling bias block ("Accelerating Self-Play
+    Learning in Go", PAPERS.md): a 1×1 conv projects the trunk to
+    ``pool_filters`` channels, their board-wide mean and max are
+    concatenated (``2·pool_filters`` scalars — no spatial shape, so
+    the block is size-generic like :class:`PointHead`), and a dense
+    layer maps them back to one bias per trunk channel, broadcast over
+    the board and added to the activations. This is what lets a net
+    WITHOUT the handcrafted ladder planes see whole-board state (a
+    running ladder is a global pattern a local conv stack cannot
+    summarize) — the ladder-free configuration's architectural half."""
+
+    pool_filters: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        g = nn.Conv(self.pool_filters, (1, 1), padding="SAME",
+                    dtype=self.dtype, name="pool_conv")(x)
+        g = nn.relu(g)
+        pooled = jnp.concatenate(
+            [g.mean(axis=(1, 2)), g.max(axis=(1, 2))], axis=-1)
+        bias = nn.Dense(x.shape[-1], dtype=self.dtype,
+                        name="pool_dense")(pooled)
+        return x + bias[:, None, None, :]
+
+
 class ConvTrunk(nn.Module):
     """The AlphaGo conv trunk shared by policy and value nets: a
     width-``filter_width_1`` first layer then ``layers-2`` more of
     width ``filter_width_K``, ReLU, SAME padding (reference
-    ``create_network`` trunk)."""
+    ``create_network`` trunk).
+
+    ``global_pool=g > 0`` interleaves ``g`` :class:`GlobalPoolBias`
+    blocks at evenly spaced depths (named ``gpool1..gpoolG``) — the
+    ladder-free configuration's trunk. ``global_pool=0`` (default) is
+    the exact pre-existing trunk: no extra modules, same param tree,
+    bit-identical output."""
 
     layers: int = 12
     filters_per_layer: int = 128
     filter_width_1: int = 5
     filter_width_K: int = 3
+    global_pool: int = 0
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         x = x.astype(self.dtype)
-        for i in range(self.layers - 1):
+        convs = self.layers - 1
+        # conv index (1-based) -> pooling block ordinal after it
+        pool_after = {(j + 1) * convs // (self.global_pool + 1): j + 1
+                      for j in range(self.global_pool)}
+        for i in range(convs):
             w = self.filter_width_1 if i == 0 else self.filter_width_K
             x = nn.Conv(self.filters_per_layer, (w, w), padding="SAME",
                         dtype=self.dtype, name=f"conv{i + 1}")(x)
             x = nn.relu(x)
+            j = pool_after.get(i + 1)
+            if j is not None:
+                x = GlobalPoolBias(dtype=self.dtype,
+                                   name=f"gpool{j}")(x)
         return x
 
 

@@ -43,6 +43,7 @@ class PolicyNet(nn.Module):
     filter_width_1: int = 5
     filter_width_K: int = 3
     head: str = "fcn"
+    trunk_pool: int = 0
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -51,6 +52,7 @@ class PolicyNet(nn.Module):
                       filters_per_layer=self.filters_per_layer,
                       filter_width_1=self.filter_width_1,
                       filter_width_K=self.filter_width_K,
+                      global_pool=self.trunk_pool,
                       dtype=self.dtype, name="trunk")(x)
         return PointHead(head=self.head, dtype=self.dtype,
                          name="head")(x)
@@ -72,12 +74,14 @@ class CNNPolicy(PointPolicyEval, NeuralNetBase):
                        layers: int = 12, filters_per_layer: int = 128,
                        filter_width_1: int = 5,
                        filter_width_K: int = 3,
-                       head: str = "fcn") -> PolicyNet:
+                       head: str = "fcn",
+                       trunk_pool: int = 0) -> PolicyNet:
         return PolicyNet(board=board, input_planes=input_planes,
                          layers=layers,
                          filters_per_layer=filters_per_layer,
                          filter_width_1=filter_width_1,
-                         filter_width_K=filter_width_K, head=head)
+                         filter_width_K=filter_width_K, head=head,
+                         trunk_pool=trunk_pool)
 
     @classmethod
     def migrate_spec(cls, spec: dict) -> dict:

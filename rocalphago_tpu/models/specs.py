@@ -14,7 +14,12 @@ from __future__ import annotations
 import argparse
 import sys
 
-from rocalphago_tpu.features import DEFAULT_FEATURES, VALUE_FEATURES
+from rocalphago_tpu.features import (
+    DEFAULT_FEATURES,
+    VALUE_FEATURES,
+    default_features,
+    value_features,
+)
 from rocalphago_tpu.models.policy import CNNPolicy
 from rocalphago_tpu.models.rollout import ROLLOUT_FEATURES, CNNRollout
 from rocalphago_tpu.models.value import CNNValue
@@ -36,7 +41,10 @@ def main(argv=None):
                     help=f"feature names (policy default: the AlphaGo "
                          f"48-plane set {', '.join(DEFAULT_FEATURES)}; "
                          f"value default adds the 'color' plane (49); "
-                         f"rollout default: {', '.join(ROLLOUT_FEATURES)})")
+                         f"rollout default: {', '.join(ROLLOUT_FEATURES)}. "
+                         f"ROCALPHAGO_LADDER_PLANES=off drops the two "
+                         f"ladder planes from the policy/value defaults "
+                         f"— the ladder-free configuration)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--head", default=None,
                     help="head variant: 'fcn' (size-generic params — "
@@ -45,18 +53,30 @@ def main(argv=None):
                          "size-locked head ('dense' for value, 'bias' "
                          "for policy/rollout). The value default also "
                          "honors ROCALPHAGO_VALUE_HEAD")
+    ap.add_argument("--trunk-pool", type=int, default=0,
+                    help="number of KataGo-style global-pooling bias "
+                         "blocks interleaved in the conv trunk "
+                         "(policy/value only; default 0 = the plain "
+                         "AlphaGo trunk). Pair with "
+                         "ROCALPHAGO_LADDER_PLANES=off so the net can "
+                         "see whole-board ladder state without the "
+                         "handcrafted planes")
     a = ap.parse_args(argv)
 
     if a.kind == "policy":
-        features = tuple(a.features) if a.features else DEFAULT_FEATURES
+        features = tuple(a.features) if a.features else default_features()
         net = CNNPolicy(features, board=a.board, layers=a.layers,
                         filters_per_layer=a.filters or 128, seed=a.seed,
-                        **({"head": a.head} if a.head else {}))
+                        **({"head": a.head} if a.head else {}),
+                        **({"trunk_pool": a.trunk_pool}
+                           if a.trunk_pool else {}))
     elif a.kind == "value":
-        features = tuple(a.features) if a.features else VALUE_FEATURES
+        features = tuple(a.features) if a.features else value_features()
         net = CNNValue(features, board=a.board, layers=a.layers,
                        filters_per_layer=a.filters or 128, seed=a.seed,
-                       **({"head": a.head} if a.head else {}))
+                       **({"head": a.head} if a.head else {}),
+                       **({"trunk_pool": a.trunk_pool}
+                          if a.trunk_pool else {}))
     else:
         features = tuple(a.features) if a.features else ROLLOUT_FEATURES
         net = CNNRollout(features, board=a.board,

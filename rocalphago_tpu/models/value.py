@@ -81,6 +81,7 @@ class ValueNet(nn.Module):
     head: str = "fcn"
     head_filters: int = 32
     aux_heads: tuple = ()
+    trunk_pool: int = 0
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -89,6 +90,7 @@ class ValueNet(nn.Module):
                       filters_per_layer=self.filters_per_layer,
                       filter_width_1=self.filter_width_1,
                       filter_width_K=self.filter_width_K,
+                      global_pool=self.trunk_pool,
                       dtype=self.dtype, name="trunk")(x)
         aux = {}
         if "ownership" in self.aux_heads:
@@ -149,7 +151,7 @@ class CNNValue(NeuralNetBase):
                        filter_width_1: int = 5, filter_width_K: int = 3,
                        dense_units: int = 256, head: str = "fcn",
                        head_filters: int = 32,
-                       aux_heads=()) -> ValueNet:
+                       aux_heads=(), trunk_pool: int = 0) -> ValueNet:
         allowed = {"ownership", "score"}
         if not set(aux_heads) <= allowed:
             raise ValueError(
@@ -162,7 +164,8 @@ class CNNValue(NeuralNetBase):
                         filter_width_K=filter_width_K,
                         dense_units=dense_units, head=head,
                         head_filters=head_filters,
-                        aux_heads=tuple(aux_heads))
+                        aux_heads=tuple(aux_heads),
+                        trunk_pool=trunk_pool)
 
     @classmethod
     def migrate_spec(cls, spec: dict) -> dict:

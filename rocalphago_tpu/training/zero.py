@@ -45,6 +45,7 @@ from jax import lax
 
 from rocalphago_tpu.data.replay import ZeroGames
 from rocalphago_tpu.engine import jaxgo
+from rocalphago_tpu.features import pyfeatures
 from rocalphago_tpu.features.planes import batched_encoder, needs_member
 from rocalphago_tpu.features.pyfeatures import output_planes
 from rocalphago_tpu.io.checkpoint import pack_rng, unpack_rng
@@ -845,6 +846,20 @@ def run_training(argv=None) -> dict:
             f"policy is {policy.board}x{policy.board} but value is "
             f"{value.board}x{value.board} — the nets must share a "
             "board size")
+    # ladder-free configuration (docs/PERFORMANCE.md "Ladder-free
+    # encode"): the feature list lives in the NET SPECS — the env knob
+    # shapes new specs at models/specs.py, not a trained net's input
+    # layer. Surface the mismatch loudly instead of silently paying
+    # the ladder tax the operator thought they turned off.
+    ladder_free = not any(f in pyfeatures.LADDER_FEATURES
+                          for f in (policy.feature_list
+                                    + value.feature_list))
+    if not pyfeatures.ladder_planes_enabled() and not ladder_free:
+        print("zero: ROCALPHAGO_LADDER_PLANES=off has no effect on "
+              "nets whose saved specs include the ladder planes — "
+              "rebuild the specs under the knob "
+              "(python -m rocalphago_tpu.models.specs ...) to get "
+              "the ladder-free encode", file=sys.stderr)
     # scoring komi: per-board-size default (VERDICT r4 weak #2 — the
     # nets' own GoConfig carries the 19x19 value whatever the board)
     game_cfg = dataclasses.replace(
@@ -910,7 +925,8 @@ def run_training(argv=None) -> dict:
     jaxobs.maybe_start_profiler(a.profile_dir)
     meta = MetadataWriter(
         os.path.join(a.out_dir, "metadata.json"),
-        header={"cmd": " ".join(sys.argv), "config": vars(a)},
+        header={"cmd": " ".join(sys.argv), "config": vars(a),
+                "ladder_free": ladder_free},
         enabled=coord)
     start = 0
     restored, _ = ckpt.restore(jax.device_get(state))

@@ -210,12 +210,39 @@ def render_encode(stats: dict, snap: dict) -> str:
             f"incremental encode: {delta} delta / {full} full "
             f"({share:.0f}% delta); chase verdicts reused "
             f"{reused}/{reused + ran} ({hit:.0f}% hit)")
+        # the invalidation cascade (features/incremental.py): how much
+        # per-ply churn the coarse region keys let through, and how
+        # often a dormant entry's verdict flip forced a re-chase
+        inval = counters.get(
+            "encode_incr_entries_invalidated_total", 0)
+        foot = counters.get("encode_incr_foot_hits_total", 0)
+        flips = counters.get("encode_incr_verdict_flips_total", 0)
+        revived = counters.get("encode_incr_entries_revived_total", 0)
+        if foot or inval:
+            lines.append(
+                f"invalidation cascade: {inval / delta:.2f} "
+                f"invalidations/ply ({foot} footprint hits → "
+                f"{inval} cell-verified stale, {flips} verdict "
+                f"flips re-chased, {revived} revived)")
         resets = {k: v for k, v in counters.items()
                   if k.startswith("encode_cache_resets_total")}
         if resets:
             lines.append("cache resets: " + "  ".join(
                 f"{k.split('reason=', 1)[-1].strip(chr(34) + '{}')}"
                 f"={v}" for k, v in sorted(resets.items())))
+    # ladder-free configuration (ROCALPHAGO_LADDER_PLANES): which
+    # plane family the run's encoders were built with
+    encs = {k: v for k, v in counters.items()
+            if k.startswith("encode_encoders_total")}
+    if encs:
+        def fam(k):
+            return k.split("planes=", 1)[-1].strip(chr(34) + "{}")
+
+        no = sum(v for k, v in encs.items() if fam(k) == "noladder")
+        lad = sum(v for k, v in encs.items() if fam(k) == "ladder")
+        tag = (" — ladder-free" if no and not lad else
+               " — MIXED plane families" if no and lad else "")
+        lines.append(f"encoders: ladder={lad} noladder={no}{tag}")
     spans = {p: s for p, s in stats.items()
              if p.rsplit("/", 1)[-1] == "encode"}
     if spans:
@@ -796,6 +823,12 @@ FIXTURE = [
                      "encode_full_total": 32,
                      "encode_incr_verdicts_reused_total": 57,
                      "encode_incr_chases_run_total": 19,
+                     "encode_incr_foot_hits_total": 31,
+                     "encode_incr_entries_invalidated_total": 12,
+                     "encode_incr_verdict_flips_total": 3,
+                     "encode_incr_entries_revived_total": 5,
+                     'encode_encoders_total{planes="ladder"}': 2,
+                     'encode_encoders_total{planes="noladder"}': 1,
                      'encode_cache_resets_total{reason="new_game"}': 2,
                      "replay_ingest_games_total": 64,
                      "replay_evicted_games_total": 8,
@@ -887,6 +920,10 @@ def selftest() -> int:
               'jax_compiles_total{entry="encode.batch"}=1',
               "incremental encode: 96 delta / 32 full (75% delta)",
               "reused 57/76 (75% hit)", "new_game=2",
+              "invalidation cascade: 0.12 invalidations/ply "
+              "(31 footprint hits → 12 cell-verified stale, "
+              "3 verdict flips re-chased, 5 revived)",
+              "encoders: ladder=2 noladder=1 — MIXED plane families",
               "actor/learner",
               "ingest: 64 games @ 480.0/min, buffer fill 6, "
               "8 evicted",

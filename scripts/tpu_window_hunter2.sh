@@ -118,6 +118,7 @@ STEPS="train64 train256 train1024 engine_dense engine_scatter rollout \
 preprocess chase_xla chase_pls encode_base encode_shared4 \
 encode_shared1 encode_shared2 encode_shared8 encode_split4 \
 encode_pallas encode_incr_seq encode_incr_batch encode_incr_selfplay \
+encode_incr_tight encode_noladder_net \
 devmcts9 devmcts_gumbel serve_small serve_fleet multisize_serve \
 zero_actor_learner zero_econ \
 selfplay16 \
@@ -171,6 +172,14 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             encode_incr_seq)   run encode_incr_seq   python benchmarks/bench_encode.py --trajectory --traj-plies 100 --traj-skip 60 --reps 2 ;;
             encode_incr_batch) run encode_incr_batch python benchmarks/bench_encode.py --trajectory --traj-plies 30 --traj-skip 60 --traj-batch 256 --reps 2 ;;
             encode_incr_selfplay) run encode_incr_selfplay env ROCALPHAGO_ENCODE_INCR=1 python benchmarks/bench_selfplay.py --batch-sweep 64 --reps 2 ;;
+            # encode_incr_tight: the tightened-invalidation A/B —
+            # tight footprints + region keys (the default) vs the
+            # legacy wide-blanket footprint, same sequential tail;
+            # the encode_incr_cascade rows carry the per-ply
+            # invalidation/flip counts each side. encode_noladder_net:
+            # the ladder-free feature-spec path's floor on chip.
+            encode_incr_tight) run encode_incr_tight sh -c 'python benchmarks/bench_encode.py --trajectory --traj-plies 100 --traj-skip 60 --reps 2 && ROCALPHAGO_LADDER_FOOT=wide python benchmarks/bench_encode.py --trajectory --traj-plies 100 --traj-skip 60 --reps 2' ;;
+            encode_noladder_net) run encode_noladder_net python benchmarks/bench_encode.py --gating shared --phase1 4 --reps 2 ;;
             devmcts9)    run devmcts9    python benchmarks/bench_device_mcts.py --board 9 --sims 32 --reps 2 ;;
             devmcts_gumbel) run devmcts_gumbel python benchmarks/bench_device_mcts.py --board 9 --sims 32 --gumbel --reps 2 ;;
             # serve_*: the cross-game serving sweep (bench_serve.py;

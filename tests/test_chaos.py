@@ -163,8 +163,11 @@ def crash_and_resume(specs, out_dir, plan):
     return proc
 
 
+@pytest.mark.slow
 def test_chaos_smoke_single_kill_resume(specs, tmp_path):
-    """Tier-1 smoke: one injected kill right after the first
+    """Full-tier smoke (suite wall-time; the faster lockstep-kill
+    rig keeps a chaos subprocess in the fast tier): one injected
+    kill right after the first
     checkpoint commit, resume, and the run is indistinguishable from
     one that never crashed."""
     baseline = tmp_path / "baseline"

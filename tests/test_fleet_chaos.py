@@ -417,6 +417,7 @@ def test_lockstep_kill_parks_and_fails_loudly(specs, tmp_path):
                 if r.get("event") == "worker_restart"]
 
 
+@pytest.mark.slow
 def test_sigterm_drain_resume_bit_identical(specs, tmp_path):
     """The preemption-drain proof: SIGTERM → stop at the iteration
     boundary, commit a checkpoint, exit 0 — and the rerun converges
@@ -496,8 +497,9 @@ def check_soak(proc, out_dir, min_kills):
     return summary
 
 
+@pytest.mark.slow
 def test_chaos_soak_smoke(tmp_path):
-    """Tier-1: a short randomized storm — at least 2 kills across
+    """Full tier (suite wall-time): a short randomized storm — at least 2 kills across
     the fleet, supervised progress to 3 learner steps, clean gate."""
     out = tmp_path / "soak"
     proc = run_soak(out, "--steps", "3", "--min-kills", "2",

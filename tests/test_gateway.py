@@ -757,8 +757,9 @@ def check_soak(proc, out):
     return summary
 
 
+@pytest.mark.slow
 def test_gateway_soak_smoke(tmp_path):
-    """The chaos soak, sized for the fast tier: kills at the
+    """The chaos soak, sized for the full tier (suite wall-time): kills at the
     connection barrier, sheds counted in /metrics, a green gate
     after the storm, and a clean SIGTERM drain (exit 0)."""
     proc, out = run_soak(tmp_path, ["--conns", "3", "--max-conns", "2",

@@ -332,6 +332,176 @@ class TestPreprocessAdvance:
         assert pre._delta_step.compiles == 1
         assert pre._delta_step.calls == 4
 
+def _ladder_board_9x9():
+    """A 9×9 position with a live working ladder (black chasing the
+    white stone at (2,2) toward the far corner) AND a white group in
+    atari at (4,3)-(4,4) — sitting inside the chase's read region, so
+    capturing it churns exactly the cells the ladder verdicts read."""
+    st = pygo.GameState(size=9, komi=5.5)
+    st.do_move((1, 2), pygo.BLACK)
+    st.do_move((2, 2), pygo.WHITE)      # the ladder prey
+    st.do_move((2, 1), pygo.BLACK)
+    st.do_move((8, 8), pygo.WHITE)
+    st.do_move((3, 1), pygo.BLACK)
+    # the sacrificial white group on the chase diagonal, one liberty
+    # at (4, 5)
+    st.do_move((4, 3), pygo.WHITE)
+    st.do_move((3, 3), pygo.BLACK)
+    st.do_move((4, 4), pygo.WHITE)
+    st.do_move((3, 4), pygo.BLACK)
+    st.do_move((8, 0), pygo.WHITE)
+    st.do_move((5, 3), pygo.BLACK)
+    st.do_move((0, 8), pygo.WHITE)
+    st.do_move((5, 4), pygo.BLACK)
+    st.do_move((8, 4), pygo.WHITE)
+    st.do_move((4, 2), pygo.BLACK)
+    st.current_player = pygo.BLACK
+    return st
+
+
+class TestInvalidationCascade:
+    """The coarsened-key / record-board invalidation model
+    (features/incremental.py "How invalidation works"): adversarial
+    churn inside an active chase's read region, and the exactness of
+    WHAT a footprint hit re-chases."""
+
+    def test_ladder_heavy_adversarial_game(self):
+        """Captures INSIDE the live chase's read region — the churn
+        pattern the coarse region keys must not mis-classify: the
+        capture at (4,5) deletes the two-stone white group the ladder
+        verdicts read right past. Bit-identity at every ply is the
+        wall; the stats prove the cascade actually fired (region hits
+        that survived the cell test and invalidated entries)."""
+        st = _ladder_board_9x9()
+        cfg = GoConfig(size=9, komi=5.5)
+        step_fn, full_fn = programs(cfg, None)
+        cache = incr.init_cache(cfg)
+        jst = jaxgo.from_pygo(cfg, st)
+        got, cache = step_fn(jst, cache)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(full_fn(jst)))
+        assert np.asarray(cache.stats)[incr.STAT_CHASES] > 0
+        # the adversarial sequence: capture the in-region group, have
+        # white replay into the hole (self-atari — legal), then GROW
+        # the prey string itself, keeping the ladder alive throughout
+        for mv, color in (((4, 5), pygo.BLACK), ((4, 4), pygo.WHITE),
+                          ((6, 3), pygo.BLACK), ((3, 2), pygo.WHITE),
+                          ((6, 5), pygo.BLACK)):
+            st.do_move(mv, color)
+            st.current_player = pygo.BLACK
+            jst = jaxgo.from_pygo(cfg, st)
+            got, cache = step_fn(jst, cache)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(full_fn(jst)),
+                err_msg=f"delta diverged after adversarial {mv}")
+        stats = np.asarray(cache.stats)
+        assert stats[incr.STAT_FOOT_HITS] > 0
+        assert stats[incr.STAT_INVALIDATED] > 0
+        # then fuzz forward from the wreckage — the general wall
+        fuzz_trajectory(9, seed=29, plies=12, start=st)
+
+    def test_far_churn_does_not_invalidate(self):
+        """The tightening's contract: stone churn OUTSIDE every
+        recorded footprint must invalidate nothing — verdicts keep
+        being reused, no entry dies, no chase re-runs beyond the new
+        position's own fresh candidates.
+
+        The churn points are chosen OUTSIDE the union of the recorded
+        footprints, which is most of the board here: the lone W(8,8)
+        corner stone is itself a two-liberty prey, and its chase
+        footprints sweep diagonally corner to corner — so the
+        far-CORNER cells a human would call "nowhere near the ladder"
+        are exactly the cells the footprint guard must watch. The top
+        edge away from both preys' ladder fans is genuinely outside."""
+        cfg = GoConfig(size=9, komi=5.5)
+        step_fn, full_fn = programs(cfg, None)
+        cache = incr.init_cache(cfg)
+        st = pygo.GameState(size=9, komi=5.5)
+        st.do_move((1, 2), pygo.BLACK)
+        st.do_move((2, 2), pygo.WHITE)
+        st.do_move((2, 1), pygo.BLACK)
+        st.do_move((8, 8), pygo.WHITE)
+        st.do_move((3, 1), pygo.BLACK)
+        st.current_player = pygo.BLACK
+        jst = jaxgo.from_pygo(cfg, st)
+        got, cache = step_fn(jst, cache)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(full_fn(jst)))
+        before = np.asarray(cache.stats).copy()
+        assert before[incr.STAT_CHASES] > 0
+        # a top-edge exchange outside every recorded footprint (three
+        # liberties each — neither stone spawns a chaseable lane).
+        # (0,5) still shares a COARSE region with recorded footprint
+        # cells, so this also exercises the two-tier path: region hit
+        # -> exact cell test -> pass -> nothing invalidated.
+        for mv, color in (((0, 5), pygo.WHITE), ((0, 7), pygo.BLACK)):
+            st.do_move(mv, color)
+            st.current_player = pygo.BLACK
+            jst = jaxgo.from_pygo(cfg, st)
+            got, cache = step_fn(jst, cache)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(full_fn(jst)))
+        delta = np.asarray(cache.stats) - before
+        assert delta[incr.STAT_INVALIDATED] == 0
+        assert delta[incr.STAT_REUSED] > 0
+
+    def test_verdict_flip_rechases_exactly_the_flipped_lanes(self):
+        """A ladder-breaker INSIDE the chase footprint flips the
+        recorded verdict: that lane must re-chase (the flip counter),
+        and ONLY affected entries die — the far corner of the cache
+        stays live and reused."""
+        cfg = GoConfig(size=9, komi=5.5)
+        step_fn, full_fn = programs(cfg, None)
+        cache = incr.init_cache(cfg)
+        st = pygo.GameState(size=9, komi=5.5)
+        st.do_move((1, 2), pygo.BLACK)
+        st.do_move((2, 2), pygo.WHITE)
+        st.do_move((2, 1), pygo.BLACK)
+        st.do_move((8, 8), pygo.WHITE)
+        st.do_move((3, 1), pygo.BLACK)
+        st.current_player = pygo.BLACK
+        jst = jaxgo.from_pygo(cfg, st)
+        got, cache = step_fn(jst, cache)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(full_fn(jst)))
+        before = np.asarray(cache.stats).copy()
+        # the breaker: a white stone on the escape diagonal turns the
+        # working ladder into a failing one — the verdict FLIPS
+        st.do_move((5, 5), pygo.WHITE)
+        st.current_player = pygo.BLACK
+        jst = jaxgo.from_pygo(cfg, st)
+        got, cache = step_fn(jst, cache)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(full_fn(jst)))
+        delta = np.asarray(cache.stats) - before
+        assert delta[incr.STAT_FOOT_HITS] > 0
+        assert delta[incr.STAT_INVALIDATED] > 0
+        assert delta[incr.STAT_FLIPS] > 0
+        assert delta[incr.STAT_CHASES] >= delta[incr.STAT_FLIPS]
+
+    def test_wide_footprint_fallback_bit_identical(self, monkeypatch):
+        """ROCALPHAGO_LADDER_FOOT=wide (the legacy dilate⁴ blanket)
+        stays available as the A/B lever — and stays bit-identical."""
+        monkeypatch.setenv("ROCALPHAGO_LADDER_FOOT", "wide")
+        cfg = GoConfig(size=7, komi=5.5)
+        # fresh programs: the knob is read at trace time
+        step_fn = jax.jit(lambda s, c: incr.encode_step(cfg, s, c))
+        full_fn = jax.jit(lambda s: jplanes.encode(cfg, s))
+        cache = incr.init_cache(cfg)
+        pst = pygo.GameState(size=7, komi=5.5)
+        rng = np.random.default_rng(31)
+        for i in range(14):
+            moves = pst.get_legal_moves()
+            if not moves:
+                break
+            pst.do_move(moves[rng.integers(len(moves))])
+            jst = jaxgo.from_pygo(cfg, pst)
+            got, cache = step_fn(jst, cache)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(full_fn(jst)),
+                err_msg=f"wide-footprint delta diverged at ply {i}")
+
+
 @pytest.mark.slow
 def test_long_fuzz_9x9_bit_identity():
     """Longer 9×9 trajectory (the ladder-rich board size) with passes

@@ -1,0 +1,139 @@
+"""Ladder-free self-play configuration (docs/PERFORMANCE.md
+"Ladder-free encode"): the ``ROCALPHAGO_LADDER_PLANES`` feature-spec
+knob that drops both handcrafted ladder planes from new specs, and
+the KataGo-style global-pooling trunk graft (``trunk_pool``) that
+lets the net recover whole-board ladder state itself.
+
+The defaults-OFF contract is the load-bearing test here: with the
+knob unset and ``trunk_pool=0`` the feature tuples, the param trees
+and the net outputs are exactly the pre-PR ones.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import serialization
+
+from rocalphago_tpu.features import pyfeatures
+from rocalphago_tpu.models.nn_util import NeuralNetBase
+from rocalphago_tpu.models.policy import CNNPolicy
+from rocalphago_tpu.models.value import CNNValue, with_aux_heads
+
+
+def _keys(params) -> set:
+    out = set()
+
+    def walk(d, prefix):
+        for k, v in d.items():
+            p = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                walk(v, p)
+            else:
+                out.add(p)
+
+    walk(serialization.to_state_dict(params), "")
+    return out
+
+
+class TestLadderPlanesKnob:
+    def test_default_on_is_bit_identical(self, monkeypatch):
+        monkeypatch.delenv("ROCALPHAGO_LADDER_PLANES", raising=False)
+        assert pyfeatures.ladder_planes_enabled()
+        assert pyfeatures.default_features() \
+            == pyfeatures.DEFAULT_FEATURES
+        assert pyfeatures.value_features() == pyfeatures.VALUE_FEATURES
+
+    def test_off_drops_exactly_the_ladder_planes(self, monkeypatch):
+        monkeypatch.setenv("ROCALPHAGO_LADDER_PLANES", "off")
+        feats = pyfeatures.default_features()
+        assert set(pyfeatures.DEFAULT_FEATURES) - set(feats) \
+            == set(pyfeatures.LADDER_FEATURES)
+        # order of the surviving features is preserved
+        assert feats == tuple(f for f in pyfeatures.DEFAULT_FEATURES
+                              if f not in pyfeatures.LADDER_FEATURES)
+        assert pyfeatures.output_planes(feats) == 46
+        assert pyfeatures.output_planes(
+            pyfeatures.value_features()) == 47
+
+    def test_specs_cli_builds_ladder_free_net(self, tmp_path,
+                                              monkeypatch):
+        from rocalphago_tpu.models import specs
+
+        monkeypatch.setenv("ROCALPHAGO_LADDER_PLANES", "off")
+        out = tmp_path / "p5.json"
+        net = specs.main(["policy", "--out", str(out), "--board", "5",
+                          "--layers", "2", "--filters", "4"])
+        assert net.preprocess.output_dim == 46
+        assert not any(f in pyfeatures.LADDER_FEATURES
+                       for f in net.feature_list)
+        # the spec records the ladder-free list — and WINS over the
+        # knob on reload (a trained net's input layer is baked)
+        monkeypatch.delenv("ROCALPHAGO_LADDER_PLANES")
+        loaded = NeuralNetBase.load_model(str(out))
+        assert loaded.feature_list == net.feature_list
+        assert loaded.preprocess.output_dim == 46
+
+
+class TestGlobalPoolTrunk:
+    def test_default_param_tree_unchanged(self):
+        plain = CNNPolicy(board=5, layers=3, filters_per_layer=4)
+        explicit = CNNPolicy(board=5, layers=3, filters_per_layer=4,
+                             trunk_pool=0)
+        assert _keys(plain.params) == _keys(explicit.params)
+        assert not any("gpool" in k for k in _keys(plain.params))
+        x = jnp.zeros((2, 5, 5, 48), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(plain.forward(x)),
+            np.asarray(explicit.forward(x)))
+
+    def test_trunk_pool_adds_gpool_blocks(self):
+        net = CNNPolicy(board=5, layers=5, filters_per_layer=4,
+                        trunk_pool=2)
+        keys = _keys(net.params)
+        gpool = {k for k in keys if "gpool" in k}
+        # 2 blocks × (pool_conv kernel+bias, pool_dense kernel+bias)
+        assert len(gpool) == 8
+        assert any("gpool1/pool_conv" in k for k in gpool)
+        assert any("gpool2/pool_dense" in k for k in gpool)
+        x = jnp.ones((2, 5, 5, 48), jnp.float32)
+        out = net.forward(x)
+        assert out.shape == (2, 25)
+
+    def test_trunk_pool_is_size_generic(self):
+        """The pooled channels are board-wide reductions — no param
+        shape depends on H×W, so the FCN multi-size contract
+        survives the graft."""
+        net = CNNValue(board=5, layers=3, filters_per_layer=4,
+                       trunk_pool=1)
+        assert net.size_generic()
+        clone = net.at_board(7)
+        x7 = jnp.ones((2, 7, 7, 49), jnp.float32)
+        out = clone.forward(x7)
+        assert out.shape == (2,)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_spec_roundtrip_keeps_trunk_pool(self, tmp_path):
+        net = CNNValue(board=5, layers=3, filters_per_layer=4,
+                       trunk_pool=1)
+        path = tmp_path / "v5.json"
+        net.save_model(str(path))
+        loaded = NeuralNetBase.load_model(str(path))
+        assert loaded.module.trunk_pool == 1
+        x = jnp.ones((1, 5, 5, 49), jnp.float32)
+        np.testing.assert_array_equal(np.asarray(net.forward(x)),
+                                      np.asarray(loaded.forward(x)))
+
+    def test_trunk_pool_composes_with_aux_heads(self):
+        """The A/B arm's actual configuration: global pooling + the
+        PR-13 aux heads, grafted — value output bit-identical to the
+        pre-graft net, gpool params carried over."""
+        net = CNNValue(board=5, layers=3, filters_per_layer=4,
+                       trunk_pool=1)
+        grown = with_aux_heads(net)
+        assert grown.module.trunk_pool == 1
+        x = jnp.ones((2, 5, 5, 49), jnp.float32)
+        np.testing.assert_array_equal(np.asarray(net.forward(x)),
+                                      np.asarray(grown.forward(x)))
+        v, aux = grown.forward_aux(x)
+        assert set(aux) == {"ownership", "score"}

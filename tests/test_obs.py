@@ -413,6 +413,7 @@ def test_gtp_stats_probe_returns_live_registry():
 
 # ------------------------------------------------ zero-trainer smoke
 
+@pytest.mark.slow
 def test_zero_smoke_emits_phase_spans_with_low_overhead(tmp_path):
     """Acceptance: a tier-1 zero run writes nested span records for
     every iteration phase (data/step/eval/checkpoint), logs its

@@ -403,8 +403,9 @@ def check_soak(proc, out):
     return summary
 
 
+@pytest.mark.slow
 def test_replay_soak_smoke(tmp_path):
-    """The kill storm, sized for the fast tier: kills at all three
+    """The kill storm, sized for the full tier (suite wall-time): kills at all three
     wire barriers, one whole-actor SIGKILL + resume, one SIGTERM
     service restart with spill recovery, and the exact-set
     produced == taken green gate."""

@@ -602,8 +602,9 @@ def run_soak(tmp_path, extra):
     return summary
 
 
+@pytest.mark.slow
 def test_rollout_soak_smoke(tmp_path):
-    """The zero-downtime proof, sized for the fast tier: one
+    """The zero-downtime proof, sized for the full tier (suite wall-time): one
     mid-storm promotion through the spill pipe, one replica bounce
     with transparent failover, kills inside the fault wall, the weak
     canary rolled back, compiles flat, SIGTERM drain exit 0."""
