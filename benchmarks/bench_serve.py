@@ -28,11 +28,26 @@ FLOPs (~64 sessions here; 256 measured flat within noise, which is
 why the default sweep stops at 64 — the accelerator continuation is
 the ``serve_small``/``serve_fleet`` hunter steps).
 
+``--cache-ab`` replaces the batched/unbatched sweep with the
+transposition-cache A/B (docs/SERVING.md "Evaluation cache"): the
+same fleet drive run twice — ``eval_cache=False`` vs an attached
+:class:`~rocalphago_tpu.serve.evalcache.EvalCache` — over an
+opening-replay workload shaped like real fleet traffic: K
+deterministic opening lines shared round-robin by the sessions
+(in-batch dedup inside one rep) and replayed identically across reps
+(cross-rep cache hits). Both arms share one compiled searcher, both
+records carry the measured hit rate, the arms' move lists are
+asserted identical (cache hits are bit-identical by construction)
+and ``jax_compiles_total`` is asserted flat across both measured
+phases.
+
 Usage::
 
     python benchmarks/bench_serve.py [--sessions 1,8,64]
         [--board 9] [--layers 6] [--filters 96] [--sims 8]
         [--moves 2] [--max-wait-us 50000] [--reps 3]
+    python benchmarks/bench_serve.py --cache-ab --sessions 16
+        [--opening-lines 4] [--opening-moves 6]
 """
 
 from __future__ import annotations
@@ -106,6 +121,15 @@ def main():
                     help="skip the thread-per-session latency-mode "
                          "arm (the batched driver and unbatched A/B "
                          "still run)")
+    ap.add_argument("--cache-ab", action="store_true",
+                    help="run the transposition-cache A/B (opening-"
+                         "replay fleet workload, cache off vs on) "
+                         "instead of the batched/unbatched sweep")
+    ap.add_argument("--opening-lines", type=int, default=4,
+                    help="[cache-ab] distinct deterministic opening "
+                         "lines shared round-robin by the sessions")
+    ap.add_argument("--opening-moves", type=int, default=6,
+                    help="[cache-ab] plies per opening line")
     ap.set_defaults(board=9)   # serving default (std_parser's 19 is
     #                            the training benches' default)
     a = ap.parse_args()
@@ -139,6 +163,123 @@ def main():
 
     def fresh_game():
         return pygo.GameState(size=a.board, komi=7.5)
+
+    # ---------------- transposition-cache A/B (module docstring) ----
+    if a.cache_ab:
+        import random
+
+        from rocalphago_tpu.obs.registry import REGISTRY
+        from rocalphago_tpu.serve.evalcache import EvalCache
+
+        # K deterministic opening lines: each a fixed pseudo-random
+        # legal sequence — sessions share them round-robin (in-batch
+        # dedup) and every rep replays them (cross-rep cache hits),
+        # the shape of real fleet traffic (shared openings/joseki)
+        lines = []
+        for k in range(a.opening_lines):
+            rng = random.Random(1000 + k)
+            st = fresh_game()
+            line: list = []
+            for _ in range(a.opening_moves):
+                legal = st.get_legal_moves(include_eyes=False)
+                if not legal:
+                    break
+                mv = legal[rng.randrange(len(legal))]
+                line.append(mv)
+                st.do_move(mv)
+            lines.append(line)
+
+        def games_for(n_sessions):
+            games = []
+            for i in range(n_sessions):
+                g = fresh_game()
+                for mv in lines[i % len(lines)]:
+                    g.do_move(mv)
+                games.append(g)
+            return games
+
+        def compiles():
+            return {k: v
+                    for k, v in REGISTRY.snapshot()["counters"].items()
+                    if k.startswith("jax_compiles_total")}
+
+        for n_sessions in session_counts:
+            sizes = default_batch_sizes(cap=n_sessions)
+            results = {}
+            for arm in ("off", "on"):
+                # False force-disables even under the env switch —
+                # both arms share the one compiled searcher
+                cache = EvalCache() if arm == "on" else False
+                pool = ServePool(val, pol, n_sim=a.sims,
+                                 max_sessions=n_sessions,
+                                 queue_rows=4 * max(sizes),
+                                 batch_sizes=sizes,
+                                 max_wait_us=a.max_wait_us,
+                                 searcher=searcher, eval_cache=cache)
+                pool.warm()
+                sessions = [pool.open_session(resilient=False)
+                            for _ in range(n_sessions)]
+                driver = pool.driver(sessions)
+                driver.warm()
+                snap0 = compiles()
+                played: list = []
+                t0 = time.monotonic()
+                for _ in range(a.reps):
+                    games = games_for(n_sessions)
+                    for _ in range(a.moves):
+                        mvs = driver.genmove_all(games)
+                        played.append(list(mvs))
+                        for game, mv in zip(games, mvs):
+                            game.do_move(mv)
+                wall = time.monotonic() - t0
+                if compiles() != snap0:
+                    raise AssertionError(
+                        "jax_compiles_total moved during the measured "
+                        f"cache-ab phase (arm={arm}) — warmup gap")
+                ev = pool.evaluator.stats()
+                if arm == "on":
+                    # hit bit-identity probe: a warm cached evaluate
+                    # against the direct device eval of the same row
+                    import numpy as _np
+                    root = jax.tree.map(lambda x: x[None],
+                                        jaxgo.from_pygo(cfg, games[0]))
+                    d_p, d_v = jax.device_get(
+                        pool.evaluator.eval_direct(root))
+                    c_p, c_v = pool.evaluator.evaluate(root, rows=1)
+                    c_p, c_v = pool.evaluator.evaluate(root, rows=1)
+                    if not (_np.array_equal(_np.asarray(c_p),
+                                            _np.asarray(d_p))
+                            and _np.array_equal(_np.asarray(c_v),
+                                                _np.asarray(d_v))):
+                        raise AssertionError(
+                            "cached eval not bit-identical to direct")
+                for s in sessions:
+                    s.close()
+                pool.close()
+                rate = n_sessions * a.moves * a.reps / wall
+                results[arm] = (rate, played, ev)
+                report("serve_moves_per_s", rate, "moves/s",
+                       sessions=n_sessions, mode="batched", cache=arm,
+                       hit_rate=ev["cache"]["hit_rate"],
+                       dedup_saved=ev["dedup_saved"],
+                       occupancy=ev["batch_occupancy"],
+                       batch_sizes=",".join(str(s) for s in sizes),
+                       max_wait_us=a.max_wait_us, board=a.board,
+                       layers=a.layers, filters=a.filters,
+                       sims=a.sims, moves=a.moves, reps=a.reps,
+                       opening_lines=a.opening_lines)
+            if results["off"][1] != results["on"][1]:
+                raise AssertionError(
+                    "cache on/off move divergence — cache hits must "
+                    "be bit-identical to device evals")
+            report("serve_cache_speedup",
+                   results["on"][0] / results["off"][0], "x",
+                   sessions=n_sessions,
+                   hit_rate=results["on"][2]["cache"]["hit_rate"],
+                   board=a.board, layers=a.layers, filters=a.filters,
+                   sims=a.sims, moves=a.moves, reps=a.reps,
+                   opening_lines=a.opening_lines)
+        return
 
     def unbatched_move(state):
         """The per-session fused path: one init + one k-sim program."""

@@ -17,6 +17,8 @@ import re
 import string
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from rocalphago_tpu.engine import pygo
 
 _LETTERS = string.ascii_lowercase
@@ -156,7 +158,17 @@ def replay(game: SGFGame, enforce_superko: bool = False):
         for p in game.setup_white:
             st.board[p] = pygo.WHITE
             st.stone_ages[p] = 0
-        st._position_history = dict.fromkeys([st.board.tobytes()])
+        # re-derive the carried hash from the raw setup edits, then
+        # restart the superko history at the setup position
+        from rocalphago_tpu.engine.zobrist import position_table
+        zob = position_table(st.size)
+        h = np.zeros(2, np.uint32)
+        for p in game.setup_black:
+            h = h ^ zob[p[0] * st.size + p[1], 0]
+        for p in game.setup_white:
+            h = h ^ zob[p[0] * st.size + p[1], 1]
+        st.zobrist_hash = h
+        st._hash_history = dict.fromkeys([h.tobytes()])
     if game.moves:
         # the record's first move decides whose turn it is after setup
         st.current_player = game.moves[0][0]

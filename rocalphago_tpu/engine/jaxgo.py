@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from rocalphago_tpu.engine import zobrist as zobrist_tables
+
 BLACK = 1
 WHITE = -1
 
@@ -129,7 +131,9 @@ def _tables(size: int):
 
     Neighbor/diagonal entries are ``N`` (sentinel) when off-board.
     Zobrist keys: ``zobrist[p, color_idx, 2xuint32]`` with color_idx
-    0=black, 1=white; fixed seed → reproducible hashes across processes.
+    0=black, 1=white; shared with the python oracle via
+    :mod:`rocalphago_tpu.engine.zobrist` (fixed seed → identical
+    hashes across engines and processes).
     """
     n = size * size
     neighbors = np.full((n, 4), n, dtype=np.int32)
@@ -145,9 +149,7 @@ def _tables(size: int):
                 nx, ny = x + dx, y + dy
                 if 0 <= nx < size and 0 <= ny < size:
                     diagonals[p, k] = nx * size + ny
-    rng = np.random.default_rng(20260729)
-    zobrist = rng.integers(0, 2**32, size=(n, 2, 2), dtype=np.uint32)
-    return neighbors, diagonals, zobrist
+    return neighbors, diagonals, zobrist_tables.position_table(size)
 
 
 def neighbors_for(size: int) -> jax.Array:
@@ -240,38 +242,29 @@ def from_pygo(cfg: GoConfig, st, *, with_history: bool = True,
     """Bridge a host-side :class:`pygo.GameState` into engine state.
 
     Used at the GTP/SGF boundary where positions are built move-by-move
-    on the host. The position hash is recomputed from the board; the
-    superko history carries the positions pygo recorded (up to
-    ``cfg.max_history``, most recent kept). ``with_history=False``
-    skips the history hashing (correct whenever
-    ``cfg.enforce_superko`` is off — e.g. the MCTS device-rollout
-    path, which converts whole leaf waves per call).
+    on the host. Both engines share one Zobrist scheme
+    (:mod:`rocalphago_tpu.engine.zobrist`), so the position hash and
+    the superko history are carried over verbatim from the hashes pygo
+    maintained incrementally (up to ``cfg.max_history``, most recent
+    kept) — no host rehash. ``with_history=False`` skips the history
+    transfer (correct whenever ``cfg.enforce_superko`` is off — e.g.
+    the MCTS device-rollout path, which converts whole leaf waves per
+    call).
     """
-    zob = _tables(cfg.size)[2]
     board = np.asarray(st.board, dtype=np.int8).reshape(-1)
-
-    def pos_hash(flat_board):
-        h = np.zeros(2, np.uint32)
-        black_keys = zob[flat_board == BLACK, 0]
-        white_keys = zob[flat_board == WHITE, 1]
-        for keys in (black_keys, white_keys):
-            if len(keys):
-                h ^= np.bitwise_xor.reduce(keys, axis=0)
-        return h
 
     # Place historical hashes so that the engine's future writes (at
     # slot ``step_count % H``, then ``step_count+1 % H``, ...) evict the
     # *oldest* entries first: newest-seen position sits at slot
-    # ``(step_count - 1) % H``. ``_position_history`` is insertion-
-    # ordered (dict), so the suffix really is the most recent positions.
+    # ``(step_count - 1) % H``. ``_hash_history`` is insertion-ordered
+    # (dict), so the suffix really is the most recent positions.
     hist = np.zeros((cfg.max_history, 2), np.uint32)
     if with_history:
-        seen = [np.frombuffer(b, dtype=np.int8)
-                for b in st._position_history.keys()]
+        seen = [np.frombuffer(b, dtype=np.uint32)
+                for b in st._hash_history.keys()]
         recent = seen[-cfg.max_history:]
-        for i, flat in enumerate(reversed(recent)):
-            hist[(st.turns_played - 1 - i) % cfg.max_history] = \
-                pos_hash(flat)
+        for i, h in enumerate(reversed(recent)):
+            hist[(st.turns_played - 1 - i) % cfg.max_history] = h
 
     ko = -1 if st.ko is None else st.ko[0] * cfg.size + st.ko[1]
     passes = 0
@@ -304,7 +297,7 @@ def from_pygo(cfg: GoConfig, st, *, with_history: bool = True,
         pass_count=jnp.int8(passes),
         done=jnp.bool_(st.is_end_of_game),
         step_count=jnp.int32(st.turns_played),
-        hash=jnp.asarray(pos_hash(board)),
+        hash=jnp.asarray(np.asarray(st.zobrist_hash, np.uint32)),
         hash_history=jnp.asarray(hist),
         stone_ages=jnp.asarray(
             np.asarray(st.stone_ages, np.int32).reshape(-1)),
@@ -617,6 +610,47 @@ def legal_mask(cfg: GoConfig, state: GoState,
 
     live = ~state.done
     return jnp.concatenate([ok & live, jnp.ones((1,), jnp.bool_) & live])
+
+
+# --------------------------------------------------------------------------
+# eval signature (transposition key for the NN evaluation cache)
+# --------------------------------------------------------------------------
+
+
+def eval_signature(cfg: GoConfig, state: GoState) -> jax.Array:
+    """uint32 [2] key under which the NN evaluation of ``state`` may be
+    cached: equal signatures ⇒ identical feature planes ⇒ identical
+    device outputs (bar a 64-bit hash collision).
+
+    The planes (``features/planes.py``) are a function of the board,
+    the player to move, the simple-ko point, the done flag, and the
+    per-stone age *bucket* ``clip(step_count - 1 - stone_age, 0, 7)``
+    (the ``turns_since`` one-hots saturate at 8 — absolute move number
+    never appears); the terminal-value komi rescore reads only
+    ``done`` and the score, both covered. So the signature is the
+    carried position hash XOR one age-bucket key per stone XOR
+    ko/turn/done keys — keys from an independent fixed-seed family
+    (:func:`rocalphago_tpu.engine.zobrist.signature_tables`).
+
+    NOT valid under ``cfg.enforce_superko``: there the sensible-move
+    mask depends on the hash *history*, which is not part of the
+    signature — the serve pool refuses to cache in that mode.
+    """
+    n = cfg.num_points
+    tabs = zobrist_tables.signature_tables(cfg.size)
+    age_t = jnp.asarray(tabs.age)
+    bucket = jnp.clip(state.step_count - 1 - state.stone_ages, 0,
+                      zobrist_tables.AGE_BUCKETS - 1)
+    keys = age_t[jnp.arange(n), bucket]                       # [N, 2]
+    occupied = (state.board != 0) & (state.stone_ages >= 0)
+    sig = state.hash ^ _xor_reduce_masked(keys, occupied)
+    sig = sig ^ jnp.asarray(tabs.ko)[state.ko + 1]
+    turn_t = jnp.asarray(tabs.turn)
+    sig = sig ^ jnp.where(state.turn == WHITE, turn_t,
+                          jnp.zeros_like(turn_t))
+    done_t = jnp.asarray(tabs.done)
+    sig = sig ^ jnp.where(state.done, done_t, jnp.zeros_like(done_t))
+    return sig
 
 
 # --------------------------------------------------------------------------

@@ -10,11 +10,20 @@ the bookkeeping engine behind SGF replay and the GTP adapter.
 
 Rules: positional superko (optional, simple-ko always), suicide illegal,
 two consecutive passes end the game, area (Chinese) scoring with komi.
+
+Positions are identified by the same incremental uint32[2] Zobrist
+hash the device engine carries (shared tables in
+:mod:`rocalphago_tpu.engine.zobrist`, fixed seed): superko is hash
+membership, and the hash crosses the ``jaxgo.from_pygo`` bridge
+verbatim instead of being recomputed — pinned by the cross-engine
+parity test in ``tests/test_pygo.py``.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from rocalphago_tpu.engine import zobrist as zobrist_tables
 
 BLACK = 1
 WHITE = -1
@@ -62,8 +71,12 @@ class GameState:
         # (-1 for empty); backs the turns-since feature plane.
         self.stone_ages = np.full((size, size), -1, dtype=np.int32)
         self.turns_played = 0
-        # byte-serialized board positions seen so far (for superko)
-        self._position_history = dict.fromkeys([self.board.tobytes()])
+        # incremental position hash (uint32[2], shared Zobrist scheme
+        # with the device engine) and the insertion-ordered set of
+        # hashes seen so far (for superko); the empty board hashes to
+        # zeros in both engines.
+        self.zobrist_hash = np.zeros(2, dtype=np.uint32)
+        self._hash_history = dict.fromkeys([self.zobrist_hash.tobytes()])
         self.handicaps: list = []
 
     # ---------------------------------------------------------------- basics
@@ -81,7 +94,8 @@ class GameState:
         other.passes_white = self.passes_white
         other.stone_ages = self.stone_ages.copy()
         other.turns_played = self.turns_played
-        other._position_history = dict(self._position_history)
+        other.zobrist_hash = self.zobrist_hash.copy()
+        other._hash_history = dict(self._hash_history)
         other.handicaps = list(self.handicaps)
         return other
 
@@ -134,6 +148,17 @@ class GameState:
             raise Suicide(f"suicide at {action}")
         return board, captured
 
+    def _hash_after(self, action, color, captured) -> np.ndarray:
+        """Position hash after ``color`` plays ``action`` capturing the
+        ``captured`` stones — incremental XOR off the carried hash."""
+        zob = zobrist_tables.position_table(self.size)
+        ci = 0 if color == BLACK else 1
+        x, y = action
+        h = self.zobrist_hash ^ zob[x * self.size + y, ci]
+        for px, py in captured:
+            h = h ^ zob[px * self.size + py, 1 - ci]
+        return h
+
     def is_suicide(self, action) -> bool:
         if not self._on_board(action):
             return False
@@ -150,10 +175,11 @@ class GameState:
         if not self._on_board(action):
             return False
         try:
-            board, _ = self._simulate(action, self.current_player)
+            _, captured = self._simulate(action, self.current_player)
         except IllegalMove:
             return False
-        return board.tobytes() in self._position_history
+        h = self._hash_after(action, self.current_player, captured)
+        return h.tobytes() in self._hash_history
 
     def is_legal(self, action) -> bool:
         if self.is_end_of_game:
@@ -167,11 +193,13 @@ class GameState:
         if self.ko is not None and action == self.ko:
             return False
         try:
-            board, _ = self._simulate(action, self.current_player)
+            _, captured = self._simulate(action, self.current_player)
         except IllegalMove:
             return False
-        if self.enforce_superko and board.tobytes() in self._position_history:
-            return False
+        if self.enforce_superko:
+            h = self._hash_after(action, self.current_player, captured)
+            if h.tobytes() in self._hash_history:
+                return False
         return True
 
     # Eye heuristics follow the reference (``AlphaGo/go.py::is_eyeish`` /
@@ -227,7 +255,8 @@ class GameState:
         if self.ko is not None and action == self.ko:
             raise IllegalMove(f"ko violation at {action}")
         board, captured = self._simulate(action, color)
-        if self.enforce_superko and board.tobytes() in self._position_history:
+        new_hash = self._hash_after(action, color, captured)
+        if self.enforce_superko and new_hash.tobytes() in self._hash_history:
             raise IllegalMove(f"superko violation at {action}")
 
         # simple ko: single capture by a lone stone that itself has exactly
@@ -248,7 +277,8 @@ class GameState:
         self.stone_ages[action] = self.turns_played
         self.turns_played += 1
         self.history.append(action)
-        self._position_history[board.tobytes()] = None
+        self.zobrist_hash = new_hash
+        self._hash_history[new_hash.tobytes()] = None
         self.current_player = -color
         return False
 
@@ -259,13 +289,16 @@ class GameState:
             raise IllegalMove("handicaps only before the first move")
         if not positions:
             return
+        zob = zobrist_tables.position_table(self.size)
         for p in positions:
             if self.board[p] != EMPTY:
                 raise IllegalMove(f"occupied handicap point {p}")
             self.board[p] = BLACK
             self.stone_ages[p] = 0
             self.handicaps.append(p)
-        self._position_history[self.board.tobytes()] = None
+            self.zobrist_hash = self.zobrist_hash ^ \
+                zob[p[0] * self.size + p[1], 0]
+        self._hash_history[self.zobrist_hash.tobytes()] = None
         self.current_player = WHITE
 
     # --------------------------------------------------------------- scoring

@@ -1,0 +1,91 @@
+"""Shared Zobrist tables: one hashing scheme for BOTH engines.
+
+The device engine (:mod:`rocalphago_tpu.engine.jaxgo`) maintains an
+exact incremental uint32[2] Zobrist hash per position (vectorized
+superko); the Python oracle (:mod:`rocalphago_tpu.engine.pygo`)
+maintains the SAME hash move-by-move on the host. Both read their
+per-point keys from :func:`position_table` here — one fixed seed, one
+``integers()`` call, so a position's hash is identical across engines
+and across processes (pinned by the cross-engine parity test in
+``tests/test_pygo.py``). That identity is what lets the serving
+stack's transposition-keyed evaluation cache
+(:mod:`rocalphago_tpu.serve.evalcache`) use the engine's carried hash
+as a cache key instead of rehashing boards on the host.
+
+This module is NUMPY-ONLY by design: pygo must stay importable
+without jax (it is the correctness oracle), so the tables live below
+both engines.
+
+Two key families:
+
+* :func:`position_table` — the POSITION keys (``[N, 2, 2]``:
+  per-point, per-color, 2×uint32). ``position_table(size)`` MUST
+  reproduce the exact draw the device engine has always made
+  (seed ``POSITION_SEED``, one ``integers`` call) — every persisted
+  hash, superko history and differential test depends on it.
+* :func:`signature_tables` — the EVAL-SIGNATURE keys (a second,
+  independent fixed seed). The NN evaluation of a state is a function
+  of more than stone placement: the feature planes read the player to
+  move, the simple-ko point, the done flag and the per-stone age
+  BUCKET (``turns_since`` one-hots ``clip(step_count - 1 -
+  stone_age, 0, 7)`` — ``features/planes.py``). The eval signature
+  XORs keys for each of those onto the position hash, so two states
+  share a signature only when every plane the nets read (and the
+  terminal-value rescore) is identical — which is what makes a cache
+  hit bit-identical to a device eval by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+
+#: the device engine's historical seed — DO NOT change: every carried
+#: hash, superko ring buffer and differential test pins these values
+POSITION_SEED = 20260729
+
+#: the eval-signature family's own seed (independent of the position
+#: keys so signature terms never cancel against stone keys)
+SIGNATURE_SEED = 20260806
+
+#: number of stone-age buckets the ``turns_since`` planes one-hot
+#: (``features/planes.py::_one_hot8`` — ages clip into ``0..7``)
+AGE_BUCKETS = 8
+
+
+@functools.lru_cache(maxsize=None)
+def position_table(size: int) -> np.ndarray:
+    """Per-point position keys ``uint32 [N, 2, 2]``.
+
+    ``table[p, color_idx]`` is the 2×uint32 key of a stone at flat
+    point ``p``; ``color_idx`` 0 = black, 1 = white. Fixed seed →
+    identical hashes across engines and processes.
+    """
+    n = size * size
+    rng = np.random.default_rng(POSITION_SEED)
+    return rng.integers(0, 2**32, size=(n, 2, 2), dtype=np.uint32)
+
+
+class SignatureTables(NamedTuple):
+    """The eval-signature key families (all uint32, trailing dim 2)."""
+
+    age: np.ndarray   # [N, AGE_BUCKETS, 2]  per-point per-age-bucket
+    ko: np.ndarray    # [N + 1, 2]           indexed ``ko + 1`` (0 = none)
+    turn: np.ndarray  # [2]                  XORed when white to move
+    done: np.ndarray  # [2]                  XORed when the game is over
+
+
+@functools.lru_cache(maxsize=None)
+def signature_tables(size: int) -> SignatureTables:
+    """Keys for the non-positional terms of the eval signature."""
+    n = size * size
+    rng = np.random.default_rng(SIGNATURE_SEED)
+    return SignatureTables(
+        age=rng.integers(0, 2**32, size=(n, AGE_BUCKETS, 2),
+                         dtype=np.uint32),
+        ko=rng.integers(0, 2**32, size=(n + 1, 2), dtype=np.uint32),
+        turn=rng.integers(0, 2**32, size=(2,), dtype=np.uint32),
+        done=rng.integers(0, 2**32, size=(2,), dtype=np.uint32),
+    )

@@ -169,6 +169,7 @@ class Preprocess:
         self._delta_step = None
         self._cache = None
         self._cache_stats = None
+        self._sig = None        # jitted eval-signature program (lazy)
 
     def _timed(self, fn, arg, batch: int) -> jax.Array:
         with trace.span("encode", board=self.cfg.size, batch=batch):
@@ -183,6 +184,24 @@ class Preprocess:
         """One state → ``[1, size, size, F]`` float32."""
         self._full.inc()
         return self._timed(self._one, state, 1)[None]
+
+    def state_signature(self, states: GoState) -> jax.Array:
+        """Eval signatures (uint32 ``[B, 2]``) of batched states — the
+        transposition key under which this encoder's planes (and so
+        any NN eval of them) may be reused, carried off the engine's
+        incremental hash instead of rehashed on the host
+        (:func:`rocalphago_tpu.engine.jaxgo.eval_signature`). Host
+        boundaries that submit to a cache-enabled
+        :class:`~rocalphago_tpu.serve.evaluator.BatchingEvaluator`
+        pass this as ``keys=``."""
+        if self._sig is None:
+            from rocalphago_tpu.engine.jaxgo import eval_signature
+
+            self._sig = jaxobs.track(
+                "encode.signature",
+                jax.jit(jax.vmap(functools.partial(eval_signature,
+                                                   self.cfg))))
+        return self._sig(states)
 
     def states_to_tensor(self, states: GoState) -> jax.Array:
         """Batched states (leading axis) → ``[B, size, size, F]``."""

@@ -66,6 +66,15 @@ class MultiSizePool:
         self.value = value_net
         self._pool_kwargs = dict(pool_kwargs)
         self._pool_kwargs["label_board"] = True
+        # ONE transposition cache across the whole ladder (cache keys
+        # carry the board size, so members cannot cross-hit): built
+        # here when the env switch is on so every member shares it
+        # rather than each building its own
+        from rocalphago_tpu.serve import evalcache
+        if self._pool_kwargs.get("eval_cache") is None \
+                and evalcache.cache_enabled():
+            self._pool_kwargs["eval_cache"] = evalcache.EvalCache()
+        self.eval_cache = self._pool_kwargs.get("eval_cache")
         self.warmed = False
         self._lock = lockcheck.make_lock("MultiSizePool._lock")
         self._pools: dict = {}            # guarded-by: self._lock

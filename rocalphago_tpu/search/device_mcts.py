@@ -54,6 +54,7 @@ from rocalphago_tpu.engine.jaxgo import (
     GoConfig,
     GoState,
     area_scores,
+    eval_signature,
     group_data,
     new_states,
     step,
@@ -93,6 +94,11 @@ class SimStep(NamedTuple):
     #   score. Where ``expanding`` these ARE the stepped children
     #   (the only rows the apply half writes), so one materialized
     #   GoState serves both the evaluator and the node write.
+    eval_keys: jax.Array    # u32 [B, 2] eval signature of each
+    #   ``eval_states`` row (``jaxgo.eval_signature``): the external
+    #   evaluator's transposition-cache key, computed on device where
+    #   the carried hash already lives. Unused by ``apply_sim`` and
+    #   dead-code-eliminated out of the fused in-search path.
 
 
 class DeviceTree(NamedTuple):
@@ -407,8 +413,14 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
             lambda a, b: jnp.where(
                 expanding.reshape((-1,) + (1,) * (a.ndim - 1)), a, b),
             new_states_b, parent_states)
+        # transposition key per eval row — a handful of XOR lanes off
+        # the carried hash; dead-code-eliminated in the fused
+        # ``simulate`` path (where no external evaluator reads it)
+        eval_keys = jax.vmap(functools.partial(eval_signature, cfg))(
+            eval_states)
         return SimStep(node=node, safe_action=safe_action,
-                       expanding=expanding, eval_states=eval_states)
+                       expanding=expanding, eval_states=eval_states,
+                       eval_keys=eval_keys)
 
     def apply_sim(tree: DeviceTree, ctx: SimStep, priors,
                   values) -> DeviceTree:
@@ -768,6 +780,13 @@ def make_device_mcts(cfg: GoConfig, policy_features: tuple,
     # bit. Compiled lazily, once per batch size, for ALL komi values.
     search.eval_batch_komi = jaxobs.track(
         "device_mcts.eval_batch_komi", jax.jit(eval_batch_komi))
+    # transposition key of a batch of states (uint32 [B, 2]) — the
+    # serving evaluator's cache key program for rows that don't come
+    # through prepare_sim (root evals); SimStep.eval_keys covers the
+    # in-search rows without a second dispatch.
+    search.eval_key = jaxobs.track(
+        "device_mcts.eval_key",
+        jax.jit(jax.vmap(functools.partial(eval_signature, cfg))))
     search.advance_root = advance_root  # subtree reuse across moves
     search.max_nodes = max_nodes        # the slab size actually built
     search.last_ran = None              # sims the last chunked run ran

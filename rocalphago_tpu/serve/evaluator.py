@@ -55,6 +55,22 @@ never mixes two nets; the dispatcher never coalesces requests of
 different versions into one batch (it splits at a version edge), so
 a device batch is single-version by construction. Non-current
 versions retire as soon as the last pin (or queued request) drops.
+
+Transposition cache (docs/SERVING.md "Evaluation cache"): with an
+:class:`~rocalphago_tpu.serve.evalcache.EvalCache` attached, the
+dispatcher keys every coalesced row by its eval signature (device
+arrays riding each request via ``keys=``, or computed by ``key_fn``
+for requests without them), serves hits from the cache, collapses
+duplicate-key misses to ONE device row (in-batch dedup — convoyed
+fleets walking shared openings stop paying per-session evals), pads
+only the UNIQUE rows to a compiled size, and fans results back out.
+Hits and dedup fan-outs are host copies of exact device outputs, so
+the cached path is bit-identical to the uncached one (pinned by
+``tests/test_serve.py``); a batch of pure hits skips the device
+entirely. Version retirement evicts that version's entries — the
+registry reuses version numbers, so this is correctness, not
+hygiene. The gather/pad work on the cached path is EAGER jax (no
+tracked jit entry), so ``jax_compiles_total`` stays flat.
 """
 
 from __future__ import annotations
@@ -97,17 +113,21 @@ def default_batch_sizes(cap: int | None = None) -> tuple:
 class _Pending:
     """A submitted evaluation request: rows in, a future out.
     ``komi`` is None (the pool's pinned komi) or the request's custom
-    komi — a float applied to every row, or a per-row sequence."""
+    komi — a float applied to every row, or a per-row sequence.
+    ``keys`` is None or the rows' eval signatures (uint32 [rows, 2],
+    device or host) — the transposition-cache keys the searcher
+    already computed on device (``SimStep.eval_keys``)."""
 
-    __slots__ = ("states", "rows", "komi", "version", "t_submit",
-                 "_event", "_result", "_exc")
+    __slots__ = ("states", "rows", "komi", "version", "keys",
+                 "t_submit", "_event", "_result", "_exc")
 
     def __init__(self, states, rows: int, komi=None,
-                 version: int = 0):
+                 version: int = 0, keys=None):
         self.states = states
         self.rows = rows
         self.komi = komi
         self.version = version
+        self.keys = keys
         self.t_submit = time.monotonic()
         self._event = threading.Event()
         self._result = None
@@ -159,16 +179,34 @@ class BatchingEvaluator:
         construction.
     default_komi : the pool's pinned komi (``cfg.komi``) — the fill
         value for non-custom rows in a mixed batch.
+    cache : optional :class:`~rocalphago_tpu.serve.evalcache.
+        EvalCache` — enables the transposition-cache + in-batch-dedup
+        dispatch path (module docstring). None keeps the plain path
+        byte-for-byte.
+    key_fn : ``(states[B]) -> uint32 [B, 2]`` (``search.eval_key``) —
+        computes eval signatures for requests that arrive without
+        ``keys``. Required with a non-symmetry ``cache``.
+    board : the pool's board size — part of every cache key, so one
+        cache is shareable across a ``MultiSizePool``'s members.
     """
 
     def __init__(self, eval_fn, params_p, params_v,
                  batch_sizes=None, max_wait_us: float | None = None,
                  admission=None, start: bool = True,
                  eval_komi_fn=None, default_komi: float = 0.0,
-                 metrics=None, restart_policy=None):
+                 metrics=None, restart_policy=None, cache=None,
+                 key_fn=None, board: int = 0):
         self._eval_fn = eval_fn
         self._eval_komi_fn = eval_komi_fn
         self.default_komi = float(default_komi)
+        self.cache = cache
+        self._key_fn = key_fn
+        self.board = int(board)
+        if cache is not None and key_fn is None \
+                and not cache.symmetry:
+            raise ValueError(
+                "an EvalCache needs key_fn (search.eval_key) to key "
+                "requests that arrive without precomputed keys")
         # the versioned-params registry (module docstring): pairs are
         # jit arguments, the CURRENT pointer is what unversioned
         # submits resolve to, pins keep a version alive across a swap
@@ -194,7 +232,17 @@ class BatchingEvaluator:
         self.komi_batches = 0
         self.failures = 0
         self.rows_total = 0
+        # occupancy honesty under dedup: rows_total counts LOGICAL
+        # rows served, unique_rows_total the rows that actually hit
+        # the device (equal on the plain path), dedup_rows_saved the
+        # duplicate miss rows collapsed away; batch_occupancy = unique
+        # / padded, so dedup cannot inflate it past 1
+        self.unique_rows_total = 0
+        self.dedup_rows_saved_total = 0
         self.padded_total = 0
+        self._uniq_c = obs_registry.counter("serve_unique_rows_total")
+        self._dedup_c = obs_registry.counter(
+            "serve_dedup_rows_saved_total")
         self._occ_h = obs_registry.histogram("serve_batch_occupancy",
                                              edges=OCC_EDGES)
         self._wait_h = obs_registry.histogram(
@@ -262,10 +310,14 @@ class BatchingEvaluator:
             # retire every version that is neither current nor pinned
             # (by a session's genmove, a canary's stage, or a queued
             # request)
-            for old in [o for o in self._params
-                        if o != v and not self._pins.get(o)]:
+            dead = [o for o in self._params
+                    if o != v and not self._pins.get(o)]
+            for old in dead:
                 del self._params[old]
             self._cond.notify_all()
+        # cache eviction AFTER dropping _cond: shard locks must never
+        # nest under the dispatcher condition (lock-order graph)
+        self._evict_retired(dead)
         if v != prev:
             self._swap_c.inc()
         self._ver_g.set(v)
@@ -287,17 +339,27 @@ class BatchingEvaluator:
 
     def release(self, version: int) -> None:
         """Drop one pin; a non-current version with no pins left
-        retires immediately (its params become collectable)."""
+        retires immediately (its params become collectable, its cache
+        entries evict — version numbers are REUSED, so a recycled
+        number must never see a stale entry)."""
         with self._cond:
             n = self._pins.get(version, 0) - 1
             if n > 0:
                 self._pins[version] = n
             else:
                 self._pins.pop(version, None)
-            for old in [o for o in self._params
-                        if o != self._current
-                        and not self._pins.get(o)]:
+            dead = [o for o in self._params
+                    if o != self._current
+                    and not self._pins.get(o)]
+            for old in dead:
                 del self._params[old]
+        self._evict_retired(dead)
+
+    def _evict_retired(self, versions) -> None:
+        """Cache-side half of retirement — called with NO lock held."""
+        if self.cache is not None:
+            for v in versions:
+                self.cache.evict_version(v)
 
     def version_params(self, version: int | None = None) -> tuple:
         """The ``(params_p, params_v)`` pair of ``version`` (None =
@@ -310,7 +372,8 @@ class BatchingEvaluator:
     # ------------------------------------------------------- client
 
     def submit(self, states, rows: int | None = None,
-               komi=None, version: int | None = None) -> _Pending:
+               komi=None, version: int | None = None,
+               keys=None) -> _Pending:
         """Enqueue a [rows]-batched GoState for evaluation. Raises
         :class:`~rocalphago_tpu.serve.admission.EvaluatorOverload`
         when the bounded queue is full (the shed path) — the caller's
@@ -321,7 +384,9 @@ class BatchingEvaluator:
         containing batch runs, not how it is coalesced. ``version``
         pins the request to a registered params version (None = the
         current pointer at enqueue time); the queued request holds a
-        pin until it is served, so a swap cannot retire its net."""
+        pin until it is served, so a swap cannot retire its net.
+        ``keys`` rides the rows' precomputed eval signatures to the
+        transposition cache (ignored without one attached)."""
         if rows is None:
             rows = int(states.board.shape[0])
         if rows > self.max_batch:
@@ -342,7 +407,7 @@ class BatchingEvaluator:
                     f"(current {self._current})")
             if self.admission is not None:
                 self.admission.admit_rows(self._pending_rows, rows)
-            req = _Pending(states, rows, komi, version=v)
+            req = _Pending(states, rows, komi, version=v, keys=keys)
             self._pins[v] = self._pins.get(v, 0) + 1
             self._queue.append(req)
             self._pending_rows += rows
@@ -351,10 +416,10 @@ class BatchingEvaluator:
 
     def evaluate(self, states, rows: int | None = None,
                  timeout: float | None = None, komi=None,
-                 version: int | None = None):
+                 version: int | None = None, keys=None):
         """Blocking submit: ``(priors, values)`` for ``states``."""
-        return self.submit(states, rows, komi=komi,
-                           version=version).result(timeout)
+        return self.submit(states, rows, komi=komi, version=version,
+                           keys=keys).result(timeout)
 
     def eval_direct(self, states, komi=None,
                     version: int | None = None):
@@ -451,21 +516,28 @@ class BatchingEvaluator:
                     else jnp.broadcast_to(
                         jnp.asarray(r.komi, jnp.float32), (r.rows,))
                     for r in take])
-            if size > total:
-                # pad rows replicate row 0 (valid states, no NaN
-                # hazards) and are sliced off below — per-row
-                # programs make real rows independent of them
-                pad = size - total
-                states = jax.tree.map(
-                    lambda x: jnp.concatenate(
-                        [x, jnp.broadcast_to(
-                            x[:1], (pad,) + x.shape[1:])], axis=0),
-                    states)
-                if komi is not None:
-                    komi = jnp.concatenate(
-                        [komi, jnp.broadcast_to(komi[:1], (pad,))])
-            priors, values = self.eval_direct(
-                states, komi=komi, version=take[0].version)
+            if self.cache is not None:
+                priors, values, devrows, size = self._eval_cached(
+                    states, komi, take, total)
+            else:
+                if size > total:
+                    # pad rows replicate row 0 (valid states, no NaN
+                    # hazards) and are sliced off below — per-row
+                    # programs make real rows independent of them
+                    pad = size - total
+                    states = jax.tree.map(
+                        lambda x: jnp.concatenate(
+                            [x, jnp.broadcast_to(
+                                x[:1], (pad,) + x.shape[1:])],
+                            axis=0),
+                        states)
+                    if komi is not None:
+                        komi = jnp.concatenate(
+                            [komi, jnp.broadcast_to(komi[:1],
+                                                    (pad,))])
+                priors, values = self.eval_direct(
+                    states, komi=komi, version=take[0].version)
+                devrows = total
         except Exception as e:  # noqa: BLE001 — fail the batch, not
             #                     the dispatcher (classified by the
             #                     sessions' resilience ladders)
@@ -476,17 +548,177 @@ class BatchingEvaluator:
                 self.release(req.version)
             return
         self.rows_total += total
+        self.unique_rows_total += devrows
         self.padded_total += size
         self._rows_c.inc(total)
-        self._occ_h.observe(total / size)
-        obs_registry.counter("serve_eval_batches_total",
-                             size=str(size)).inc()
+        if devrows:
+            self._uniq_c.inc(devrows)
+        if size:
+            self._occ_h.observe(devrows / size)
+            obs_registry.counter("serve_eval_batches_total",
+                                 size=str(size)).inc()
         offset = 0
         for req in take:
             req._finish((priors[offset:offset + req.rows],
                          values[offset:offset + req.rows]))
             offset += req.rows
             self.release(req.version)
+
+    # ------------------------------------------------- cached dispatch
+
+    def _row_keys(self, states, take: list, total: int,
+                  komi_rows: list, version: int):
+        """Cache key + (symmetry) orientation per coalesced row.
+
+        Zobrist mode: signatures come from the requests' precomputed
+        device keys (one host transfer) or ``key_fn`` on the
+        coalesced states; key = ``(sig_hi, sig_lo, board, komi,
+        version)``. Symmetry mode: exact canonical byte keys from the
+        host copies of the rows' plane-relevant fields.
+        """
+        import jax
+        import numpy as np
+
+        from rocalphago_tpu.serve import evalcache
+
+        if not self.cache.symmetry:
+            if all(r.keys is not None for r in take):
+                sig = np.concatenate(
+                    [np.asarray(jax.device_get(r.keys)).reshape(
+                        r.rows, 2) for r in take], axis=0)
+            else:
+                sig = np.asarray(jax.device_get(
+                    self._key_fn(states))).reshape(total, 2)
+            keys = [(int(s[0]), int(s[1]), self.board, komi_rows[i],
+                     version) for i, s in enumerate(sig)]
+            return keys, None
+        board_h, ages_h, steps_h, ko_h, turn_h, done_h = \
+            jax.device_get((states.board, states.stone_ages,
+                            states.step_count, states.ko, states.turn,
+                            states.done))
+        board_h = np.asarray(board_h)
+        # the same age BUCKET the turns_since planes one-hot; -1
+        # marks empty points so the byte key covers exactly what the
+        # nets can see
+        buckets = np.clip(
+            np.asarray(steps_h).reshape(-1, 1) - 1
+            - np.asarray(ages_h), 0, 7).astype(np.int8)
+        buckets[board_h == 0] = -1
+        keys, perms = [], []
+        for i in range(total):
+            core, t = evalcache.canonical_key(
+                self.board, board_h[i], buckets[i], int(ko_h[i]),
+                int(turn_h[i]), bool(done_h[i]))
+            keys.append(core + (self.board, komi_rows[i], version))
+            perms.append(t)
+        return keys, perms
+
+    def _eval_cached(self, states, komi, take: list, total: int):
+        """The transposition-cache dispatch path: lookup → in-batch
+        dedup of the misses → one padded device eval of the UNIQUE
+        rows (skipped entirely when everything hits) → fan-out +
+        insert. Returns ``(priors [total, A], values [total], unique
+        device rows, padded size)`` with outputs as host arrays —
+        bit-identical to the plain path because every returned row IS
+        a device output row (fresh or cached). The gather/pad of the
+        missed rows happens on HOST (one ``device_get`` of the
+        coalesced states, then numpy takes) — eager per-shape device
+        gathers would compile a throwaway kernel per (leaf, miss
+        count) pair and make the cold path pay seconds of XLA; the
+        host path costs nothing to warm, and the only device program
+        is ``eval_direct`` at an already-compiled ladder size, so
+        ``jax_compiles_total`` stays flat.
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from rocalphago_tpu.serve import evalcache
+
+        cache = self.cache
+        # the cache path's fault barrier (soak: io_error@serve.cache
+        # must fail only this batch, never the dispatcher)
+        faults.barrier("serve.cache", iteration=self.batches)
+        version = take[0].version
+        if komi is None:
+            komi_rows = [self.default_komi] * total
+        else:
+            komi_rows = [float(k) for k in
+                         np.asarray(jax.device_get(komi))]
+        keys, perms = self._row_keys(states, take, total, komi_rows,
+                                     version)
+        boards_b = None
+        if cache.verify:
+            bh = np.asarray(jax.device_get(states.board))
+            boards_b = [bh[i].tobytes() for i in range(total)]
+        out_p: list = [None] * total
+        out_v = np.zeros(total, np.float32)
+        miss_idx: list = []        # first occurrence of each missed key
+        dup_of: list = [None] * total
+        first_miss: dict = {}
+        for i, key in enumerate(keys):
+            hit = cache.lookup(
+                key, board_bytes=boards_b[i] if boards_b else None)
+            if hit is not None:
+                p, v = hit
+                if perms is not None:
+                    p = evalcache.orient_priors(p, perms[i],
+                                                self.board)
+                out_p[i] = p
+                out_v[i] = v
+                continue
+            j = first_miss.get(key)
+            if j is None:
+                first_miss[key] = i
+                miss_idx.append(i)
+            else:
+                dup_of[i] = j
+        unique = len(miss_idx)
+        padded = 0
+        if unique:
+            padded = self._padded_size(unique)
+            # combined gather+pad in one numpy take per leaf: the
+            # index vector is pre-padded to the compiled size with
+            # the first missed row (the sliced-off replicate rows the
+            # plain path also pads with)
+            idx = np.full(padded, miss_idx[0], np.int32)
+            idx[:unique] = miss_idx
+            states_h = jax.device_get(states)
+            # the re-asarray matters: the jit signature cache keys on
+            # Python input types, so numpy leaves would grow
+            # eval_batch's cache (a counted "compile") even though
+            # XLA reuses the executable — one transfer keeps
+            # jax_compiles_total honest AND flat
+            ustates = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x)[idx]), states_h)
+            ukomi = (jnp.asarray(
+                np.asarray(komi_rows, np.float32)[idx])
+                if komi is not None else None)
+            priors_d, values_d = self.eval_direct(
+                ustates, komi=ukomi, version=version)
+            pr, va = jax.device_get((priors_d, values_d))
+            pr = np.asarray(pr)[:unique]
+            va = np.asarray(va, np.float32)[:unique]
+            for r, i in enumerate(miss_idx):
+                out_p[i] = pr[r]
+                out_v[i] = va[r]
+                store = pr[r]
+                if perms is not None:
+                    store = evalcache.canonicalize_priors(
+                        store, perms[i], self.board)
+                cache.insert(
+                    keys[i], (store, va[r]),
+                    board_bytes=boards_b[i] if boards_b else None)
+        saved = 0
+        for i, j in enumerate(dup_of):
+            if j is not None:
+                out_p[i] = out_p[j]
+                out_v[i] = out_v[j]
+                saved += 1
+        if saved:
+            self.dedup_rows_saved_total += saved
+            self._dedup_c.inc(saved)
+        return np.stack(out_p), out_v, unique, padded
 
     def _fail_pending(self) -> None:
         """Parked-dispatcher cleanup: fail everything queued so no
@@ -542,17 +774,24 @@ class BatchingEvaluator:
             depth = self._pending_rows
             version = self._current
             swaps = self.swaps
+        from rocalphago_tpu.serve import evalcache
         return {
             "batches": self.batches,
             "komi_batches": self.komi_batches,
             "rows": self.rows_total,
+            "unique_rows": self.unique_rows_total,
+            "dedup_saved": self.dedup_rows_saved_total,
             "failures": self.failures,
             "queue_depth": depth,
             "params_version": version,
             "swaps": swaps,
+            # unique device rows / padded rows: dedup cannot inflate
+            # occupancy past 1 (the plain path has unique == rows)
             "batch_occupancy": (
-                round(self.rows_total / self.padded_total, 4)
+                round(self.unique_rows_total / self.padded_total, 4)
                 if self.padded_total else None),
             "batch_sizes": list(self.batch_sizes),
             "max_wait_us": round(self.max_wait_s * 1e6, 1),
+            "cache": (self.cache.stats() if self.cache is not None
+                      else evalcache.disabled_stats()),
         }

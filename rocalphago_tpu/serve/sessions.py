@@ -161,9 +161,15 @@ class SessionPlayer:
             ver = pool.evaluator.acquire(None)
         self.last_version = ver
         try:
-            # root priors through the shared evaluator, like every leaf
+            # root priors through the shared evaluator, like every
+            # leaf; with a transposition cache attached, the root's
+            # eval signature rides along (leaf rows carry theirs via
+            # SimStep.eval_keys — computed on device either way)
+            keys0 = (search.eval_key(roots)
+                     if pool.evaluator.cache is not None else None)
             priors0, _ = pool.evaluator.evaluate(roots, komi=komi,
-                                                 version=ver)
+                                                 version=ver,
+                                                 keys=keys0)
             tree = search.assemble_tree(roots, priors0)
             # steady state is ONE device call per simulation
             # (advance_sim: apply + next prepare fused); the deadline
@@ -172,7 +178,8 @@ class SessionPlayer:
             ran = 0
             while True:
                 priors, values = pool.evaluator.evaluate(
-                    ctx.eval_states, komi=komi, version=ver)
+                    ctx.eval_states, komi=komi, version=ver,
+                    keys=ctx.eval_keys)
                 ran += 1
                 if ran >= eff or (enforce and deadline.expired()):
                     tree = search.apply_sim(tree, ctx, priors, values)
@@ -262,15 +269,20 @@ class FleetDriver:
         # same per-genmove consistency a threaded session gets
         ver = pool.evaluator.acquire(None)
         try:
+            keys0 = (search.eval_key(roots)
+                     if pool.evaluator.cache is not None else None)
             priors0, _ = pool.evaluator.evaluate(roots, rows=n,
-                                                 komi=komi, version=ver)
+                                                 komi=komi,
+                                                 version=ver,
+                                                 keys=keys0)
             tree = search.assemble_tree(roots, priors0)
             free = jnp.full((n,), -1, jnp.int32)
             ctx = search.prepare_sim(tree, free)
             ran = 0
             while True:
                 priors, values = pool.evaluator.evaluate(
-                    ctx.eval_states, rows=n, komi=komi, version=ver)
+                    ctx.eval_states, rows=n, komi=komi, version=ver,
+                    keys=ctx.eval_keys)
                 ran += 1
                 if ran >= pool.n_sim or (enforce
                                          and deadline.expired()):
@@ -377,7 +389,12 @@ class ServePool:
     (admission), ``batch_sizes`` / ``max_wait_us`` (dispatch),
     ``slo_s`` (per-genmove deadline; env ``ROCALPHAGO_SERVE_SLO_MS``),
     ``hang_timeout_s`` + ``metrics`` (threaded into each session's
-    resilience ladder).
+    resilience ladder); ``eval_cache`` (an
+    :class:`~rocalphago_tpu.serve.evalcache.EvalCache` to share, None
+    to follow ``ROCALPHAGO_EVAL_CACHE``, ``False`` to force-disable
+    regardless of the env — refused either way under
+    ``enforce_superko``, where NN output is not a pure function of
+    the eval signature).
     """
 
     def __init__(self, value_net, policy_net, n_sim: int = 64,
@@ -387,8 +404,10 @@ class ServePool:
                  batch_sizes=None, max_wait_us: float | None = None,
                  slo_s: float | None = None,
                  hang_timeout_s: float | None = None, metrics=None,
-                 searcher=None, label_board: bool = False):
+                 searcher=None, label_board: bool = False,
+                 eval_cache=None):
         from rocalphago_tpu.search.device_mcts import make_device_mcts
+        from rocalphago_tpu.serve import evalcache
 
         self.policy = policy_net
         self.value = value_net
@@ -413,12 +432,27 @@ class ServePool:
         self.admission = AdmissionController(
             max_sessions, queue_rows,
             board=self.board if label_board else None)
+        # transposition cache: explicit instance, or built from the
+        # env master switch. Under enforce_superko the NN output is
+        # NOT a pure function of the eval signature (the sensible-
+        # move mask reads the hash HISTORY), so caching is refused —
+        # stats()["cache"]["enabled"] shows the outcome either way.
+        cache = eval_cache
+        if cache is None and evalcache.cache_enabled():
+            cache = evalcache.EvalCache()
+        if cache is False:      # explicit opt-out, overrides the env
+            cache = None        # switch (the bench A/B's OFF arm)
+        if self.cfg.enforce_superko:
+            cache = None
+        self.eval_cache = cache
         self.evaluator = BatchingEvaluator(
             self.search.eval_batch, policy_net.params, value_net.params,
             batch_sizes=batch_sizes, max_wait_us=max_wait_us,
             admission=self.admission,
             eval_komi_fn=getattr(self.search, "eval_batch_komi", None),
-            default_komi=self.cfg.komi)
+            default_komi=self.cfg.komi, cache=cache,
+            key_fn=getattr(self.search, "eval_key", None),
+            board=self.board)
         self.warmed = False
         self._lock = lockcheck.make_lock("ServePool._lock")
         self._sessions: dict = {}         # guarded-by: self._lock
@@ -529,6 +563,13 @@ class ServePool:
                 new_states(self.cfg, size))
             jax.block_until_ready(out[0])
         roots = new_states(self.cfg, 1)
+        if self.eval_cache is not None and \
+                hasattr(self.search, "eval_key"):
+            # the cached genmove path signs the root on device —
+            # compile it here so jax_compiles_total stays flat from
+            # the first served move (fleet-size signing compiles in
+            # FleetDriver.warm via its keyless evaluate call)
+            jax.block_until_ready(self.search.eval_key(roots))
         priors, _ = self.evaluator.eval_direct(roots)
         tree = self.search.assemble_tree(roots, priors)
         import jax.numpy as jnp
@@ -565,6 +606,7 @@ class ServePool:
         fields a load balancer keys health on."""
         adm = self.admission.stats()
         ev = self.evaluator.stats()
+        cs = ev["cache"]
         return {
             "sessions": {
                 "live": adm["live_sessions"],
@@ -580,10 +622,22 @@ class ServePool:
                 "batches": ev["batches"],
                 "komi_batches": ev["komi_batches"],
                 "rows": ev["rows"],
+                "unique_rows": ev["unique_rows"],
+                "dedup_saved": ev["dedup_saved"],
                 "failures": ev["failures"],
                 "batch_occupancy": ev["batch_occupancy"],
                 "batch_sizes": ev["batch_sizes"],
                 "max_wait_us": ev["max_wait_us"],
+            },
+            "cache": {
+                "enabled": cs["enabled"],
+                "entries": cs["entries"],
+                "capacity": cs["capacity"],
+                "hits": cs["hits"],
+                "misses": cs["misses"],
+                "evictions": cs["evictions"],
+                "collisions": cs["collisions"],
+                "hit_rate": cs["hit_rate"],
             },
             "params": {
                 "version": ev["params_version"],

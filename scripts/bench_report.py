@@ -75,7 +75,11 @@ def load_records(path: str, date: str, platform: str | None):
                    # recovery A/B axis (bench_zero_scale.py
                    # --kill-actor-at): the killed-actor run and the
                    # fault-free run are separate rows
-                   r.get("kill_at"))
+                   r.get("kill_at"),
+                   # transposition-cache A/B axis (bench_serve.py
+                   # --cache-ab): the cache-off and cache-on arms at
+                   # one session count are separate rows
+                   r.get("cache"))
             prev = latest.get(key)
             if prev is None or str(r.get("date")) >= str(prev.get("date")):
                 latest[key] = r
@@ -88,7 +92,8 @@ def load_records(path: str, date: str, platform: str | None):
 _SKIP_FIELDS = {"metric", "value", "unit", "platform", "date",
                 "vs_baseline", "mfu", "host_gap_frac", "us_per_pos",
                 "sessions", "conns", "actors", "learner_idle_frac",
-                "board", "cap_p", "fullsearch_frac", "mttr_s"}
+                "board", "cap_p", "fullsearch_frac", "mttr_s",
+                "hit_rate"}
 
 
 def render_table(records) -> str:
@@ -127,12 +132,15 @@ def render_table(records) -> str:
     row). The conns column keys the gateway wire-tax sweep
     (``bench_gateway.py``: moves/sec vs concurrent connections, the
     direct/gateway modes A/B'd per count — p50/p99 stay in
-    config)."""
+    config). The hit-rate column renders ``hit_rate`` — the
+    transposition-cache A/B's measured cache hit rate
+    (``bench_serve.py --cache-ab``; the ``cache`` off/on field stays
+    in config and keys the row against its other arm)."""
     lines = ["| metric | value | unit | board | MFU | host gap "
              "| µs/pos | sessions | conns | actors | learner idle "
-             "| cap p | full frac | MTTR | config |",
+             "| cap p | full frac | MTTR | hit rate | config |",
              "|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-             "---|---|"]
+             "---|---|---|"]
     for r in records:
         cfg = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
                         if k not in _SKIP_FIELDS)
@@ -161,10 +169,12 @@ def render_table(records) -> str:
         ff = "—" if ff in (None, "") else f"{100.0 * float(ff):.1f}%"
         mttr = r.get("mttr_s")
         mttr = "—" if mttr in (None, "") else f"{float(mttr):g}s"
+        hr = r.get("hit_rate")
+        hr = "—" if hr in (None, "") else f"{100.0 * float(hr):.1f}%"
         lines.append(f"| {r['metric']} | {r.get('value', '?')}{extra}"
                      f" | {r.get('unit', '?')} | {board} | {u} | {gap}"
                      f" | {upp} | {sess} | {conns} | {act} | {idle}"
-                     f" | {capp} | {ff} | {mttr} | {cfg} |")
+                     f" | {capp} | {ff} | {mttr} | {hr} | {cfg} |")
     return "\n".join(lines)
 
 

@@ -163,6 +163,43 @@ def render_dispatch(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_evalcache(snap: dict) -> str:
+    """Transposition-cache panel (serve/evalcache.py; docs/SERVING.md
+    "Evaluation cache"): hit economics and residency, the in-batch
+    dedup the dispatcher folds on top, and the two safety tallies —
+    version evictions (hot-swap invalidation, correctness under
+    version-number reuse) and verify-mode collisions (each one a
+    silently-wrong answer that wasn't)."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hits = counters.get("eval_cache_hits_total")
+    misses = counters.get("eval_cache_misses_total")
+    uniq = counters.get("serve_unique_rows_total")
+    dedup = counters.get("serve_dedup_rows_saved_total")
+    if hits is None and misses is None and uniq is None:
+        return "(no eval cache records)"
+    lines = []
+    total = (hits or 0) + (misses or 0)
+    if total:
+        entries = gauges.get("eval_cache_entries")
+        res = ("" if entries is None
+               else f", {entries:g} entries resident")
+        lines.append(f"lookups: {hits or 0} hits / {total} "
+                     f"({100.0 * (hits or 0) / total:.1f}% hit rate)"
+                     f"{res}")
+    if uniq is not None or dedup is not None:
+        lines.append(f"device rows: {uniq or 0} unique evaluated, "
+                     f"{dedup or 0} in-batch dupes folded")
+    evc = counters.get(
+        'eval_cache_evictions_total{reason="capacity"}', 0)
+    evv = counters.get(
+        'eval_cache_evictions_total{reason="version"}', 0)
+    coll = counters.get("eval_cache_collisions_total", 0)
+    lines.append(f"evictions: capacity={evc} version={evv}, "
+                 f"collisions detected: {coll}")
+    return "\n".join(lines)
+
+
 def render_encode(stats: dict, snap: dict) -> str:
     """Encode-path table (the encode overhaul's observability leg):
     per-board per-position cost from the ``encode_pos_us`` histograms
@@ -704,6 +741,8 @@ def report(records, top: int | None = None) -> str:
              "## notable events", "", render_events(records), "",
              "## dispatch pipeline (occupancy / host gaps)", "",
              render_dispatch(reg or {}), "",
+             "## eval cache (hits / dedup / evictions / collisions)",
+             "", render_evalcache(reg or {}), "",
              "## actor/learner (replay ingest / learner idle)", "",
              render_actor_learner(reg or {}), "",
              "## fleet health (restarts / parks / MTTR / drain)", "",
@@ -830,6 +869,14 @@ FIXTURE = [
                      'encode_encoders_total{planes="ladder"}': 2,
                      'encode_encoders_total{planes="noladder"}': 1,
                      'encode_cache_resets_total{reason="new_game"}': 2,
+                     "eval_cache_hits_total": 592,
+                     "eval_cache_misses_total": 320,
+                     'eval_cache_evictions_total{reason="capacity"}':
+                         12,
+                     'eval_cache_evictions_total{reason="version"}': 9,
+                     "eval_cache_collisions_total": 0,
+                     "serve_unique_rows_total": 71,
+                     "serve_dedup_rows_saved_total": 249,
                      "replay_ingest_games_total": 64,
                      "replay_evicted_games_total": 8,
                      "learner_steps_total": 7,
@@ -867,6 +914,7 @@ FIXTURE = [
                      "router_failovers_total": 1,
                      "router_retried_genmoves_total": 1},
         "gauges": {"device_mcts_deadline_margin_s": 0.42,
+                   "eval_cache_entries": 71,
                    'device_occupancy{runner="device_mcts"}': 0.983,
                    "replay_fill_games": 6,
                    "replay_ingest_per_min": 480.0,
@@ -916,6 +964,13 @@ def selftest() -> int:
     needed = ("zero.selfplay", "zero.iteration", "76.2%",
               "serve_rung_total", "gtp_genmove_seconds", "compile=1",
               "p99≲2.5", "dispatch pipeline", "98.3%",
+              "eval cache (hits / dedup / evictions / collisions)",
+              "lookups: 592 hits / 912 (64.9% hit rate), "
+              "71 entries resident",
+              "device rows: 71 unique evaluated, "
+              "249 in-batch dupes folded",
+              "evictions: capacity=12 version=9, "
+              "collisions detected: 0",
               "encode path", "≲25000",
               'jax_compiles_total{entry="encode.batch"}=1',
               "incremental encode: 96 delta / 32 full (75% delta)",

@@ -119,7 +119,8 @@ preprocess chase_xla chase_pls encode_base encode_shared4 \
 encode_shared1 encode_shared2 encode_shared8 encode_split4 \
 encode_pallas encode_incr_seq encode_incr_batch encode_incr_selfplay \
 encode_incr_tight encode_noladder_net \
-devmcts9 devmcts_gumbel serve_small serve_fleet multisize_serve \
+devmcts9 devmcts_gumbel serve_small serve_cache serve_fleet \
+multisize_serve \
 zero_actor_learner zero_econ \
 selfplay16 \
 selfplay64 selfplay256 bisect mcts19 mcts19r rl engine_trace \
@@ -191,6 +192,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             # host saturates out of; the threaded latency arm is
             # host-bound, skip on chip time.
             serve_small) run serve_small python benchmarks/bench_serve.py --sessions 1,8 --reps 2 --skip-threaded ;;
+            # serve_cache: the transposition-cache A/B on chip
+            # (bench_serve.py --cache-ab; docs/SERVING.md "Evaluation
+            # cache") — opening-replay fleet moves/s cache off vs on
+            # with the measured hit rate; bench_report keys the rows
+            # by the cache field
+            serve_cache) run serve_cache python benchmarks/bench_serve.py --cache-ab --sessions 16 --reps 3 ;;
             serve_fleet) run serve_fleet python benchmarks/bench_serve.py --sessions 64,256 --reps 2 --skip-threaded ;;
             # multisize_serve: the PR-12 one-checkpoint ladder
             # (bench_multisize.py; docs/MULTISIZE.md) — per-size

@@ -276,6 +276,16 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
                     "unit": "moves/s", "platform": "tpu",
                     "sessions": 64, "mode": "batched",
                     "date": "2026-07-31T01:00:00"}),   # distinct count
+        json.dumps({"metric": "serve_moves_per_s", "value": 44.0,
+                    "unit": "moves/s", "platform": "tpu",
+                    "sessions": 16, "mode": "batched", "cache": "off",
+                    "hit_rate": None,
+                    "date": "2026-07-31T01:00:00"}),   # cache A/B off
+        json.dumps({"metric": "serve_moves_per_s", "value": 175.0,
+                    "unit": "moves/s", "platform": "tpu",
+                    "sessions": 16, "mode": "batched", "cache": "on",
+                    "hit_rate": 0.6491,
+                    "date": "2026-07-31T01:00:00"}),   # cache A/B on
         json.dumps({"metric": "gateway_moves_per_s", "value": 95.0,
                     "unit": "moves/s", "platform": "tpu",
                     "conns": 4, "mode": "gateway", "p50_s": 0.01,
@@ -322,65 +332,75 @@ def test_bench_report_tables_and_probe_stats(tmp_path, monkeypatch):
     # are part of the config key: each A/B side is a distinct row,
     # not a newer duplicate of its sibling
     assert sorted((r["value"], r.get("batch")) for r in recs) \
-        == [(2.0, 64), (3.0, 64), (9.0, 256), (50.0, 16),
-            (52.3, None), (88.0, None), (90.0, None), (95.0, None),
-            (100.0, 16), (120.0, None), (229.3, 8), (310.0, None),
-            (340.0, None), (345.0, None), (582.5, 8)]
+        == [(2.0, 64), (3.0, 64), (9.0, 256), (44.0, None),
+            (50.0, 16), (52.3, None), (88.0, None), (90.0, None),
+            (95.0, None), (100.0, 16), (120.0, None), (175.0, None),
+            (229.3, 8), (310.0, None), (340.0, None), (345.0, None),
+            (582.5, 8)]
     table = bench_report.render_table(recs)
     # board / MFU / host-gap / µs-per-pos / sessions / actors /
     # learner-idle columns: '—' when a record has none, the value
     # when it does
     assert ("| m | 2.0 | u | — | — | — | — | — | — | — | — | — | — | "
-            "— | batch=64 |" in table)
+            "— | — | batch=64 |" in table)
     assert ("| m | 9.0 | u | — | 12.3% | — | — | — | — | — | — | — "
-            "| — | — | batch=256 |" in table)
+            "| — | — | — | batch=256 |" in table)
     assert ("| m | 3.0 | u | — | — | 4.21% | — | — | — | — | — | — "
-            "| — | — | batch=64, pipeline_depth=1 |" in table)
+            "| — | — | — | batch=64, pipeline_depth=1 |" in table)
     assert ("| encode_ab | 100.0 | u | — | — | — | 123.4 | — | — | — "
-            "| — | — | — | — "
+            "| — | — | — | — | — "
             "| batch=16, chase_impl=xla, gating=shared, phase1=4 |"
             in table)
     # the serving sweep keys by session count: both rows survive and
     # the sessions column carries the count (moves/sec-vs-sessions)
     assert ("| serve_moves_per_s | 88.0 | moves/s | — | — | — | — | 8 "
-            "| — | — | — | — | — | — | mode=batched |" in table)
+            "| — | — | — | — | — | — | — | mode=batched |" in table)
     assert ("| serve_moves_per_s | 120.0 | moves/s | — | — | — | — | "
-            "64 | — | — | — | — | — | — | mode=batched |" in table)
+            "64 | — | — | — | — | — | — | — | mode=batched |" in table)
+    # the cache A/B (bench_serve.py --cache-ab) keys by the cache
+    # on/off axis: both arms survive at ONE session count and the hit
+    # rate column renders the on-arm's measured rate
+    assert ("| serve_moves_per_s | 44.0 | moves/s | — | — | — | — | "
+            "16 | — | — | — | — | — | — | — | cache=off, mode=batched |"
+            in table)
+    assert ("| serve_moves_per_s | 175.0 | moves/s | — | — | — | — | "
+            "16 | — | — | — | — | — | — | 64.9% | cache=on, "
+            "mode=batched |" in table)
     # the gateway sweep keys by connection count: both rows survive
     # and the conns column carries the count (bench_gateway.py's
     # wire-tax table; p50 stays in config)
     assert ("| gateway_moves_per_s | 95.0 | moves/s | — | — | — | — "
-            "| — | 4 | — | — | — | — | — | mode=gateway, p50_s=0.01 |"
+            "| — | 4 | — | — | — | — | — | — | mode=gateway, p50_s=0.01 |"
             in table)
     assert ("| gateway_moves_per_s | 90.0 | moves/s | — | — | — | — "
-            "| — | 16 | — | — | — | — | — | mode=gateway, p50_s=0.02 |"
+            "| — | 16 | — | — | — | — | — | — | mode=gateway, p50_s=0.02 |"
             in table)
     # the actor/learner sweep keys by actor count: both rows survive,
     # the actors column carries the count and learner idle renders as
     # a percentage (bench_zero_scale.py's scaling table)
     assert ("| zero_ingest_games_per_min | 340.0 | games/min | — | — "
-            "| — | — | — | — | 2 | 7.1% | — | — | — | mesh_shape=8x1 |"
+            "| — | — | — | — | 2 | 7.1% | — | — | — | — | mesh_shape=8x1 |"
             in table)
     assert ("| zero_ingest_games_per_min | 345.0 | games/min | — | — "
-            "| — | — | — | — | 4 | 5.7% | — | — | — | mesh_shape=8x1 |"
+            "| — | — | — | — | 4 | 5.7% | — | — | — | — | mesh_shape=8x1 |"
             in table)
     # the recovery A/B keys by kill_at: the killed-actor row survives
     # next to its fault-free sibling and the MTTR column carries the
     # kill-to-first-post-restart-game time (--kill-actor-at)
     assert ("| zero_ingest_games_per_min | 310.0 | games/min | — | — "
-            "| — | — | — | — | 2 | 9.0% | — | — | 2.442s | "
+            "| — | — | — | — | 2 | 9.0% | — | — | 2.442s | — | "
             "kill_at=2, mesh_shape=8x1, restarts=1 |" in table)
     # the multi-size sweep keys by board: the board column carries it
     # (bench_multisize.py's size-scaling table)
     assert ("| multisize_moves_per_s | 52.3 | moves/s | 13 | — | — | "
-            "— | 4 | — | — | — | — | — | — | mode=one_pool |" in table)
+            "— | 4 | — | — | — | — | — | — | — | mode=one_pool |" in table)
     # the cap-randomization A/B keys by cap_p: both rows survive, the
     # cap p / full frac columns carry them (bench_selfplay --cap-ab)
     assert ("| selfplay_cap_games_per_min | 229.3 | games/min | 9 | — "
-            "| — | — | — | — | — | — | 1 | 100.0% | — | batch=8 |"
+            "| — | — | — | — | — | — | 1 | 100.0% | — | — | batch=8 |"
             in table)
     assert ("| selfplay_cap_games_per_min | 582.5 | games/min | 9 | — "
-            "| — | — | — | — | — | — | 0.25 | 16.7% | — | batch=8 |"
+            "| — | — | — | — | — | — | 0.25 | 16.7% | — | — | batch=8 |"
             in table)
 
     probe = tmp_path / "probe.log"

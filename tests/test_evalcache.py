@@ -1,0 +1,171 @@
+"""Host-side unit tests for the transposition eval cache
+(``rocalphago_tpu/serve/evalcache.py``): LRU/shard bookkeeping,
+version-keyed eviction, verify-mode collision detection, the env
+knobs, and the dihedral symmetry machinery. Everything device-backed
+(bit-identity against real NN outputs, dedup fan-out, hot-swap
+eviction through the evaluator) lives in ``tests/test_serve.py``
+beside the pool fixtures.
+"""
+
+import numpy as np
+
+from rocalphago_tpu.serve import evalcache
+from rocalphago_tpu.serve.evalcache import EvalCache
+
+
+def _key(n, version=0):
+    """A well-formed cache key: version LAST (evict_version relies
+    on that layout)."""
+    return (n, n + 1, 5, 7.5, version)
+
+
+# ------------------------------------------------------------- basics
+
+def test_miss_then_hit_and_stats():
+    c = EvalCache(capacity=8, shards=1)
+    assert c.lookup(_key(1)) is None
+    c.insert(_key(1), "v1")
+    assert c.lookup(_key(1)) == "v1"
+    s = c.stats()
+    assert s["enabled"] is True
+    assert s["hits"] == 1 and s["misses"] == 1
+    assert s["entries"] == 1 and s["hit_rate"] == 0.5
+    assert set(s) == set(evalcache.disabled_stats())
+
+
+def test_fresh_cache_hit_rate_is_none():
+    assert EvalCache(capacity=4, shards=1).stats()["hit_rate"] is None
+    assert evalcache.disabled_stats()["hit_rate"] is None
+
+
+def test_capacity_evicts_least_recent():
+    c = EvalCache(capacity=4, shards=1)
+    for n in range(4):
+        c.insert(_key(n), n)
+    c.lookup(_key(0))            # refresh 0's recency
+    c.insert(_key(9), 9)         # past capacity: evict LRU = key 1
+    assert len(c) == 4
+    assert c.lookup(_key(0)) == 0
+    assert c.lookup(_key(1)) is None
+    assert c.stats()["evictions"] == 1
+
+
+def test_shards_partition_capacity():
+    c = EvalCache(capacity=8, shards=4)
+    assert c.shards == 4 and c._per_shard == 2
+    for n in range(32):
+        c.insert(_key(n), n)
+    assert len(c) <= 8
+
+
+def test_evict_version_matches_last_tuple_element():
+    c = EvalCache(capacity=16, shards=2)
+    for n in range(3):
+        c.insert(_key(n, version=0), n)
+    for n in range(2):
+        c.insert(_key(n, version=1), n)
+    assert c.evict_version(0) == 3
+    assert len(c) == 2
+    assert c.lookup(_key(0, version=1)) is not None
+    assert c.lookup(_key(0, version=0)) is None
+    assert c.evict_version(0) == 0   # idempotent
+    assert c.stats()["evictions"] == 3
+
+
+def test_clear():
+    c = EvalCache(capacity=8, shards=2)
+    c.insert(_key(1), 1)
+    c.clear()
+    assert len(c) == 0 and c.lookup(_key(1)) is None
+
+
+# ------------------------------------------------- verify (collisions)
+
+def test_verify_detects_board_mismatch_as_collision():
+    c = EvalCache(capacity=8, shards=1, verify=True)
+    c.insert(_key(1), "a", board_bytes=b"AAAA")
+    # same key, different board: a detected hash collision — counted,
+    # served as a miss, and the subsequent insert overwrites
+    assert c.lookup(_key(1), board_bytes=b"BBBB") is None
+    s = c.stats()
+    assert s["collisions"] == 1 and s["misses"] == 1 and s["hits"] == 0
+    assert c.lookup(_key(1), board_bytes=b"AAAA") == "a"
+    c.insert(_key(1), "b", board_bytes=b"BBBB")
+    assert c.lookup(_key(1), board_bytes=b"BBBB") == "b"
+
+
+def test_verify_off_ignores_board_bytes():
+    c = EvalCache(capacity=8, shards=1, verify=False)
+    c.insert(_key(1), "a", board_bytes=b"AAAA")
+    assert c.lookup(_key(1), board_bytes=b"BBBB") == "a"
+    assert c.stats()["collisions"] == 0
+
+
+def test_symmetry_mode_forces_verify_off():
+    # symmetry keys are exact canonical bytes — nothing to verify
+    assert EvalCache(capacity=8, symmetry=True, verify=True).verify \
+        is False
+
+
+# ------------------------------------------------------------ env knobs
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv(evalcache.ENABLE_ENV, "0")
+    assert evalcache.cache_enabled() is False
+    monkeypatch.setenv(evalcache.ENABLE_ENV, "1")
+    assert evalcache.cache_enabled() is True
+    monkeypatch.setenv(evalcache.CAP_ENV, "24")
+    monkeypatch.setenv(evalcache.SHARDS_ENV, "3")
+    monkeypatch.setenv(evalcache.VERIFY_ENV, "1")
+    c = EvalCache()
+    assert c.capacity == 24 and c.shards == 3 and c.verify is True
+    # explicit constructor args beat the env
+    c2 = EvalCache(capacity=5, shards=1, verify=False)
+    assert c2.capacity == 5 and c2.shards == 1 and c2.verify is False
+
+
+# ------------------------------------------------------------ symmetry
+
+def test_dihedral_perms_invert():
+    perms, invs = evalcache.dihedral_perms(5)
+    assert len(perms) == 8
+    field = np.arange(25)
+    for p, inv in zip(perms, invs):
+        assert np.array_equal(field[p][inv], field)
+    # the 8 transforms are distinct permutations
+    assert len({p.tobytes() for p in perms}) == 8
+
+
+def test_canonical_key_is_transform_invariant():
+    size = 5
+    rng = np.random.default_rng(0)
+    board = rng.integers(-1, 2, size * size).astype(np.int8)
+    buckets = rng.integers(-1, 8, size * size).astype(np.int8)
+    ko = 7
+    core0, _ = evalcache.canonical_key(size, board, buckets, ko, 1,
+                                       False)
+    perms, invs = evalcache.dihedral_perms(size)
+    for t in range(8):
+        # transform the position by t: fields permute, the ko POINT
+        # moves to its image under the transform
+        core_t, _ = evalcache.canonical_key(
+            size, board[perms[t]], buckets[perms[t]],
+            int(invs[t][ko]), 1, False)
+        assert core_t == core0, f"canonical key differs under t={t}"
+    # key components that are NOT symmetric must change the key
+    assert evalcache.canonical_key(size, board, buckets, ko, 0,
+                                   False)[0] != core0
+    assert evalcache.canonical_key(size, board, buckets, -1, 1,
+                                   False)[0] != core0
+
+
+def test_priors_canonicalize_orient_roundtrip():
+    size = 5
+    rng = np.random.default_rng(1)
+    priors = rng.normal(size=size * size + 1).astype(np.float32)
+    for t in range(8):
+        canon = evalcache.canonicalize_priors(priors, t, size)
+        back = evalcache.orient_priors(canon, t, size)
+        assert np.array_equal(back, priors)
+        # the pass logit (last slot) never moves
+        assert canon[-1] == priors[-1]

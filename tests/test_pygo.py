@@ -232,3 +232,47 @@ class TestMisc:
     def test_legal_move_count_empty_board(self):
         st = GameState(size=5)
         assert len(st.get_legal_moves()) == 25
+
+
+class TestZobristParity:
+    """The incremental position hash the pure-Python engine carries
+    (superko membership + the serve cache's key source via
+    ``jaxgo.from_pygo``) must equal the device engine's at every step
+    — both build on the shared fixed-seed tables in
+    ``engine/zobrist.py``, so a divergence is an incremental-update
+    bug in one of them."""
+
+    def test_incremental_hash_matches_jaxgo(self):
+        from rocalphago_tpu.engine import jaxgo
+
+        size = 5
+        cfg = jaxgo.GoConfig(size=size, komi=5.5,
+                             enforce_superko=False, max_history=64)
+        eng = jaxgo.GoEngine(cfg)
+        jst = eng.init()
+        pst = make_state(size=size, komi=5.5)
+        assert np.array_equal(np.asarray(jst.hash), pst.zobrist_hash)
+        rng = np.random.default_rng(7)
+        hashes = {pst.zobrist_hash.tobytes()}
+        for move_i in range(40):
+            legal = [(x, y) for x in range(size) for y in range(size)
+                     if pst.is_legal((x, y))]
+            if not legal or rng.random() < 0.05:
+                pst.do_move(PASS_MOVE)
+                action = size * size
+            else:
+                mv = legal[int(rng.integers(len(legal)))]
+                pst.do_move(mv)
+                action = mv[0] * size + mv[1]
+            jst = eng.step(jst, np.int32(action))
+            assert np.array_equal(np.asarray(jst.hash),
+                                  pst.zobrist_hash), (
+                f"hash diverged at move {move_i}\n{pst.board}")
+            hashes.add(pst.zobrist_hash.tobytes())
+            if pst.is_end_of_game:
+                break
+        # the walk must have exercised the interesting increments:
+        # at least one capture (multi-stone XOR) and real movement
+        assert pst.num_black_prisoners + pst.num_white_prisoners > 0, (
+            "replay produced no capture — reseed the walk")
+        assert len(hashes) > 10

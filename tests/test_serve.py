@@ -413,3 +413,232 @@ def test_soak_under_lockcheck_reconciles_static_graph(
         "BatchingEvaluator._cond", "ServePool._lock",
         "AdmissionController._lock", "MetricsLogger._lock",
         "trace._lock", "native._lock"}
+
+
+# ----------------------------------------------- evaluation cache
+
+def _cached_ev(pool, cache, **kw):
+    """A standalone evaluator on the module pool's compiled programs
+    with a transposition cache attached (docs/SERVING.md "Evaluation
+    cache")."""
+    kw.setdefault("batch_sizes", (1, 2, 4))
+    kw.setdefault("max_wait_us", 2000)
+    kw.setdefault("key_fn", pool.search.eval_key)
+    return BatchingEvaluator(
+        pool.search.eval_batch, pool.policy.params, pool.value.params,
+        eval_komi_fn=pool.search.eval_batch_komi,
+        default_komi=float(pool.cfg.komi), cache=cache,
+        board=SIZE, **kw)
+
+
+def _moved_state(cfg, moves):
+    """A batch-1 device state after a scripted pygo opening — a
+    second distinct position for key-isolation tests."""
+    import jax
+
+    from rocalphago_tpu.engine import jaxgo
+
+    st = pygo.GameState(size=cfg.size, komi=cfg.komi)
+    for m in moves:
+        st.do_move(m)
+    return jax.tree.map(lambda x: x[None], jaxgo.from_pygo(cfg, st))
+
+
+def test_cache_hit_is_bit_identical(pool):
+    """A warm lookup replays the EXACT device row: cold eval, warm
+    eval and a direct (uncached) eval are byte-equal."""
+    import jax
+
+    from rocalphago_tpu.serve.evalcache import EvalCache
+
+    ev = _cached_ev(pool, EvalCache(capacity=64, shards=2))
+    try:
+        st = _states(pool.cfg, 1)
+        ref_p, ref_v = jax.device_get(ev.eval_direct(st))
+        p1, v1 = ev.evaluate(st, timeout=30)    # cold: miss + insert
+        p2, v2 = ev.evaluate(st, timeout=30)    # warm: pure hit
+        for p, v in ((p1, v1), (p2, v2)):
+            assert np.array_equal(np.asarray(p), np.asarray(ref_p))
+            assert np.array_equal(np.asarray(v), np.asarray(ref_v))
+        s = ev.cache.stats()
+        assert s["hits"] == 1 and s["misses"] == 1
+        assert s["entries"] == 1
+        # the all-hit batch never touched the device
+        assert ev.rows_total == 2 and ev.unique_rows_total == 1
+    finally:
+        ev.close()
+
+
+def test_in_batch_dedup_fans_out_under_padding(pool):
+    """Duplicate rows in ONE coalesced batch collapse to one device
+    row (here: 4 logical rows, 3 unique, padded to 4) and every
+    requester gets back the exact output of its own position."""
+    import jax
+
+    from rocalphago_tpu.serve.evalcache import EvalCache
+
+    ev = _cached_ev(pool, EvalCache(capacity=64, shards=2),
+                    max_wait_us=200_000)
+    try:
+        sts = [_states(pool.cfg, 1), _states(pool.cfg, 1),
+               _moved_state(pool.cfg, [(2, 2)]),
+               _moved_state(pool.cfg, [(2, 2), (1, 1)])]
+        refs = [jax.device_get(ev.eval_direct(st)) for st in sts]
+        results, ready = [None] * 4, threading.Barrier(4)
+
+        def client(i):
+            ready.wait()
+            results[i] = ev.evaluate(sts[i], timeout=30)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert ev.batches == 1, (
+            f"4 concurrent submits took {ev.batches} batches")
+        assert ev.rows_total == 4 and ev.unique_rows_total == 3
+        assert ev.dedup_rows_saved_total == 1
+        assert ev.padded_total == 4    # 3 unique rows pad to 4
+        for (p, v), (rp, rv) in zip(results, refs):
+            assert np.array_equal(np.asarray(p), np.asarray(rp))
+            assert np.array_equal(np.asarray(v), np.asarray(rv))
+        st = ev.stats()
+        assert st["unique_rows"] == 3 and st["dedup_saved"] == 1
+    finally:
+        ev.close()
+
+
+def test_cache_komi_and_version_isolation(pool):
+    """Komi and params version are key components: a custom-komi row
+    never hits a default-komi entry, a hot swap starts a fresh key
+    space (and evicts the retired version — numbers are REUSED), and
+    a staged version's entries evict when its last pin drops."""
+    from rocalphago_tpu.serve.evalcache import EvalCache
+
+    ev = _cached_ev(pool, EvalCache(capacity=64, shards=1))
+    try:
+        st = _states(pool.cfg, 1)
+        p0, _ = ev.evaluate(st, timeout=30)
+        ev.evaluate(st, komi=9.5, timeout=30)
+        s = ev.cache.stats()
+        assert s["misses"] == 2 and s["hits"] == 0, (
+            "a custom-komi row must not hit the default-komi entry")
+        assert s["entries"] == 2
+        ev.evaluate(st, timeout=30)
+        ev.evaluate(st, komi=9.5, timeout=30)
+        assert ev.cache.stats()["hits"] == 2  # each komi its own entry
+        # hot swap: version 0 retires (unpinned) -> entries evicted
+        ev.set_params(pool.policy.params, pool.value.params)
+        s = ev.cache.stats()
+        assert s["entries"] == 0 and s["evictions"] == 2
+        p1, _ = ev.evaluate(st, timeout=30)   # fresh miss under v1
+        assert ev.cache.stats()["misses"] == 3
+        # same weights under the new version: recomputed, equal
+        assert np.array_equal(np.asarray(p1), np.asarray(p0))
+        # staged version: entries live while pinned, evict on release
+        v = ev.add_version(pool.policy.params, pool.value.params)
+        ev.evaluate(st, version=v, timeout=30)
+        assert ev.cache.stats()["entries"] == 2
+        ev.release(v)                  # stage pin drops -> v retires
+        assert ev.cache.stats()["entries"] == 1
+    finally:
+        ev.close()
+
+
+def test_cache_forced_collision_is_detected(pool):
+    """Verify mode turns a key collision (forced here by a degenerate
+    key_fn mapping EVERY position to one key) into a counted miss —
+    the second position still gets its own exact eval."""
+    import jax
+
+    from rocalphago_tpu.serve.evalcache import EvalCache
+
+    ev = _cached_ev(
+        pool, EvalCache(capacity=16, shards=1, verify=True),
+        key_fn=lambda states: np.zeros(
+            (int(states.board.shape[0]), 2), np.uint32))
+    try:
+        a = _states(pool.cfg, 1)
+        b = _moved_state(pool.cfg, [(2, 2)])
+        ev.evaluate(a, timeout=30)
+        pb, vb = ev.evaluate(b, timeout=30)  # same key, other board
+        ref_p, ref_v = jax.device_get(ev.eval_direct(b))
+        assert np.array_equal(np.asarray(pb), np.asarray(ref_p))
+        assert np.array_equal(np.asarray(vb), np.asarray(ref_v))
+        s = ev.cache.stats()
+        assert s["collisions"] == 1 and s["hits"] == 0
+        assert s["misses"] == 2
+    finally:
+        ev.close()
+
+
+def test_serve_cache_barrier_fails_only_the_batch(pool):
+    """A fault at ``serve.cache`` (docs/RESILIENCE.md) fails exactly
+    that batch's requests; the dispatcher — and the cache — keep
+    serving."""
+    from rocalphago_tpu.serve.evalcache import EvalCache
+
+    ev = _cached_ev(pool, EvalCache(capacity=16, shards=1))
+    try:
+        faults.install("io_error@serve.cache:1")
+        st = _states(pool.cfg, 1)
+        with pytest.raises(InjectedFault):
+            ev.evaluate(st, timeout=30)
+        p, _ = ev.evaluate(st, timeout=30)    # dispatcher survived
+        assert p.shape == (1, SIZE * SIZE + 1)
+        assert ev.failures == 1 and ev.batches == 2
+    finally:
+        ev.close()
+
+
+def test_pool_cache_plumbing(pool, nets, monkeypatch):
+    """``ServePool(eval_cache=...)``: an explicit instance is shared,
+    ``False`` force-disables over the env switch, the env switch
+    builds one, and ``enforce_superko`` refuses one (the sensible
+    mask reads hash HISTORY — NN output is not a pure function of the
+    eval signature there)."""
+    import dataclasses
+
+    from rocalphago_tpu.serve import evalcache
+    from rocalphago_tpu.serve.evalcache import EvalCache
+
+    pol, val = nets
+    assert pool.stats()["cache"]["enabled"] is False  # no cache here
+    cache = EvalCache(capacity=8, shards=1)
+    with ServePool(val, pol, n_sim=4, max_sessions=2,
+                   batch_sizes=(1, 2), max_wait_us=2000,
+                   searcher=pool.search, eval_cache=cache) as p2:
+        assert p2.eval_cache is cache
+        assert p2.evaluator.cache is cache
+        cs = p2.stats()["cache"]
+        assert cs["enabled"] is True and cs["capacity"] == 8
+    monkeypatch.setenv(evalcache.ENABLE_ENV, "1")
+    with ServePool(val, pol, n_sim=4, max_sessions=2,
+                   batch_sizes=(1, 2), max_wait_us=2000,
+                   searcher=pool.search) as p3:
+        assert p3.eval_cache is not None        # env switch builds one
+    with ServePool(val, pol, n_sim=4, max_sessions=2,
+                   batch_sizes=(1, 2), max_wait_us=2000,
+                   searcher=pool.search, eval_cache=False) as p4:
+        assert p4.eval_cache is None            # False beats the env
+        assert p4.stats()["cache"]["enabled"] is False
+
+    class _Superko:
+        """The same net under a superko config (frozen dataclass —
+        wrap rather than mutate)."""
+
+        def __init__(self, net):
+            self.cfg = dataclasses.replace(net.cfg,
+                                           enforce_superko=True)
+            self.board = net.board
+            self.params = net.params
+            self.feature_list = net.feature_list
+            self.module = net.module
+
+    with ServePool(_Superko(val), _Superko(pol), n_sim=4,
+                   max_sessions=2, batch_sizes=(1, 2),
+                   max_wait_us=2000, searcher=pool.search,
+                   eval_cache=EvalCache(capacity=8)) as p5:
+        assert p5.eval_cache is None            # refused under superko
